@@ -1,0 +1,16 @@
+"""routest_tpu — a TPU-native route-optimization & ETA-prediction framework.
+
+Re-founds the capabilities of the ``routest`` reference stack (Flask
+``route_optimizer_twx2`` microservice + Laravel schema + Next.js map app;
+see SURVEY.md) on a JAX/XLA/pjit core:
+
+- ``core``     mesh & sharding runtime, typed config, dtype policy
+- ``data``     12-feature ETA encoding, synthetic delivery data, geo math
+- ``models``   ETA regressors
+- ``train``    pjit train step, eval harness, checkpointing, CPU baseline
+"""
+
+__version__ = "0.1.0"
+
+from routest_tpu.core.config import Config, load_config  # noqa: F401
+from routest_tpu.core.mesh import MeshRuntime  # noqa: F401

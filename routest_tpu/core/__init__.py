@@ -1,0 +1,3 @@
+from routest_tpu.core.config import Config, load_config  # noqa: F401
+from routest_tpu.core.dtypes import Policy  # noqa: F401
+from routest_tpu.core.mesh import MeshRuntime, pad_to_multiple  # noqa: F401

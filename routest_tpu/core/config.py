@@ -1,0 +1,125 @@
+"""Typed, env-layered configuration.
+
+The reference configures everything through bare environment variables
+(SURVEY.md §5.6; reference ``Flaskr/__init__.py``, ``Flaskr/ml.py:7``,
+``Flaskr/routes.py:15-16``). We keep those exact names working — a deploy
+configured for the reference service should boot this one — but layer them
+under a single typed ``Config`` with mesh / batching / dtype knobs added.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional, Sequence, Tuple
+
+
+def _env(env: Mapping[str, str], *names: str, default: Optional[str] = None) -> Optional[str]:
+    for name in names:
+        value = env.get(name)
+        if value:
+            return value
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. ``data`` is the primary throughput axis
+    (OD-pair batches); ``model`` is reserved for tensor-parallel weights
+    (SURVEY.md §2.4). ``-1`` means "all remaining devices".
+    """
+
+    data: int = -1
+    model: int = 1
+    axis_names: Tuple[str, str] = ("data", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    hidden: Tuple[int, ...] = (256, 256, 128)
+    # Path to a serialized parameter file (msgpack). Honors the reference's
+    # ETA_MODEL_PATH override (``Flaskr/ml.py:7``).
+    model_path: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    batch_size: int = 8192
+    learning_rate: float = 3e-3
+    weight_decay: float = 1e-4
+    epochs: int = 30
+    seed: int = 0
+    checkpoint_dir: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    host: str = "127.0.0.1"
+    port: int = 5000
+    # Dynamic batcher: requests coalesce until ``max_batch`` rows or
+    # ``max_wait_ms`` elapse, whichever first (SURVEY.md §7.3 item 4).
+    max_batch: int = 4096
+    max_wait_ms: float = 2.0
+    # Bucketed pad sizes to avoid recompiles.
+    batch_buckets: Tuple[int, ...] = (8, 64, 512, 4096)
+    # External services — all optional; absent ⇒ hermetic in-memory fakes.
+    supabase_url: Optional[str] = None
+    supabase_service_key: Optional[str] = None
+    redis_url: Optional[str] = None
+    ors_api_key: Optional[str] = None
+    version: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+
+
+def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
+    """Build a Config from environment variables.
+
+    Env names mirror the reference service where behavior matches:
+    ``ETA_MODEL_PATH``, ``SUPABASE_URL``, ``SUPABASE_SERVICE_ROLE_KEY``,
+    ``REDIS_URL``, ``ORS_API_KEY``/``OPENROUTESERVICE_API_KEY``,
+    ``RENDER_GIT_COMMIT``/``GIT_COMMIT_SHA`` (health version stamp).
+    New TPU knobs use the ``RTPU_`` prefix.
+    """
+    env = dict(env if env is not None else os.environ)
+
+    def _int(name: str, default: int) -> int:
+        raw = env.get(name)
+        return int(raw) if raw else default
+
+    def _float(name: str, default: float) -> float:
+        raw = env.get(name)
+        return float(raw) if raw else default
+
+    mesh = MeshConfig(
+        data=_int("RTPU_MESH_DATA", -1),
+        model=_int("RTPU_MESH_MODEL", 1),
+    )
+    model = ModelConfig(
+        model_path=_env(env, "ETA_MODEL_PATH", "RTPU_MODEL_PATH"),
+    )
+    train = TrainConfig(
+        batch_size=_int("RTPU_TRAIN_BATCH", 8192),
+        learning_rate=_float("RTPU_LR", 3e-3),
+        epochs=_int("RTPU_EPOCHS", 30),
+        seed=_int("RTPU_SEED", 0),
+        checkpoint_dir=env.get("RTPU_CKPT_DIR"),
+    )
+    serve = ServeConfig(
+        host=env.get("RTPU_HOST", "127.0.0.1"),
+        port=_int("PORT", _int("RTPU_PORT", 5000)),
+        max_batch=_int("RTPU_MAX_BATCH", 4096),
+        max_wait_ms=_float("RTPU_MAX_WAIT_MS", 2.0),
+        supabase_url=env.get("SUPABASE_URL"),
+        supabase_service_key=env.get("SUPABASE_SERVICE_ROLE_KEY"),
+        redis_url=env.get("REDIS_URL"),
+        ors_api_key=_env(env, "ORS_API_KEY", "OPENROUTESERVICE_API_KEY"),
+        version=_env(env, "RENDER_GIT_COMMIT", "GIT_COMMIT_SHA"),
+    )
+    return Config(mesh=mesh, model=model, train=train, serve=serve)

@@ -1,0 +1,37 @@
+"""Dtype policy: f32 parameters, bf16 compute, f32 outputs.
+
+The MXU natively consumes bfloat16; keeping parameters in float32 and
+casting at the matmul boundary is the standard TPU mixed-precision recipe.
+ETA targets are small magnitudes (minutes), so f32 accumulation is plenty.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    output_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+    def cast_to_output(self, x):
+        return x.astype(self.output_dtype)
+
+
+DEFAULT_POLICY = Policy()
+# Full-f32 policy for CPU-emulated meshes and parity tests.
+F32_POLICY = Policy(compute_dtype=jnp.float32)

@@ -1,0 +1,11 @@
+from routest_tpu.data.features import (  # noqa: F401
+    FEATURE_NAMES,
+    N_FEATURES,
+    TRAFFIC_CATEGORIES,
+    WEATHER_CATEGORIES,
+    encode_features,
+    encode_request,
+    encode_requests,
+    vocab_index,
+)
+from routest_tpu.data.locations import SEED_LOCATIONS, locations_table  # noqa: F401

@@ -1,0 +1,139 @@
+"""The 12-feature ETA input encoding, vectorized for TPU.
+
+Feature contract (order and semantics) mirrors the reference's only ground
+truth about its model input, ``Flaskr/ml.py:35-48`` (SURVEY.md Appendix B):
+
+``weather_Cloudy, weather_Stormy, weather_Sunny, weather_Windy,
+traffic_High, traffic_Jam, traffic_Low, traffic_Medium,
+weekday_ordered (0-6), hour_ordered (0-23), distance_km, driver_age``
+
+One-hots encode *unknown* category values (e.g. weather "Fog") as all-zeros
+in their group — ``jax.nn.one_hot`` with index -1 gives exactly that.
+The reference builds one pandas row per HTTP request; here the encoder is a
+pure ``jnp`` transform over whole OD batches so it fuses into the model's
+first matmul under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WEATHER_CATEGORIES: tuple = ("Cloudy", "Stormy", "Sunny", "Windy")
+TRAFFIC_CATEGORIES: tuple = ("High", "Jam", "Low", "Medium")
+
+FEATURE_NAMES: tuple = tuple(
+    [f"weather_{w}" for w in WEATHER_CATEGORIES]
+    + [f"traffic_{t}" for t in TRAFFIC_CATEGORIES]
+    + ["weekday_ordered", "hour_ordered", "distance_km", "driver_age"]
+)
+N_FEATURES = len(FEATURE_NAMES)  # 12
+
+# Defaults match the reference endpoints (``Flaskr/routes.py:103-104,371-372``).
+DEFAULT_WEATHER = "Sunny"
+DEFAULT_TRAFFIC = "Low"
+DEFAULT_DRIVER_AGE = 30.0
+
+
+def vocab_index(values: Iterable[str], vocab: Sequence[str]) -> np.ndarray:
+    """Host-side string→index; unknown values map to -1 (⇒ all-zero one-hot)."""
+    lookup = {v: i for i, v in enumerate(vocab)}
+    return np.asarray([lookup.get(v, -1) for v in values], dtype=np.int32)
+
+
+def encode_features(
+    weather_idx: jax.Array,
+    traffic_idx: jax.Array,
+    weekday: jax.Array,
+    hour: jax.Array,
+    distance_km: jax.Array,
+    driver_age: jax.Array,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """(N,) index/scalar arrays → (N, 12) feature matrix.
+
+    Pure jnp; safe under jit/vmap/pjit. Index -1 in either categorical
+    column produces an all-zero one-hot group, matching the reference's
+    handling of unknown categories.
+    """
+    weather_oh = jax.nn.one_hot(weather_idx, len(WEATHER_CATEGORIES), dtype=dtype)
+    traffic_oh = jax.nn.one_hot(traffic_idx, len(TRAFFIC_CATEGORIES), dtype=dtype)
+    scalars = jnp.stack(
+        [
+            weekday.astype(dtype),
+            hour.astype(dtype),
+            distance_km.astype(dtype),
+            driver_age.astype(dtype),
+        ],
+        axis=-1,
+    )
+    return jnp.concatenate([weather_oh, traffic_oh, scalars], axis=-1)
+
+
+def encode_requests(
+    weather: Sequence[str],
+    traffic: Sequence[str],
+    weekday: Sequence[int],
+    hour: Sequence[int],
+    distance_km: Sequence[float],
+    driver_age: Sequence[float],
+) -> np.ndarray:
+    """Host-side batch encode (numpy in, numpy out) — the serving path's
+    pre-device step. Kept in numpy so the batcher can concatenate cheaply
+    before a single device transfer."""
+    return batch_from_mapping(
+        {
+            "weather_idx": vocab_index(weather, WEATHER_CATEGORIES),
+            "traffic_idx": vocab_index(traffic, TRAFFIC_CATEGORIES),
+            "weekday": weekday,
+            "hour": hour,
+            "distance_km": distance_km,
+            "driver_age": driver_age,
+        }
+    )
+
+
+def encode_request(
+    *,
+    weather: Optional[str] = None,
+    traffic: Optional[str] = None,
+    distance_m: float = 0.0,
+    weekday: int = 0,
+    hour: int = 0,
+    driver_age: Optional[float] = None,
+) -> np.ndarray:
+    """Single request → (1, 12) row, applying the reference's defaults."""
+    return encode_requests(
+        weather=[weather or DEFAULT_WEATHER],
+        traffic=[traffic or DEFAULT_TRAFFIC],
+        weekday=[weekday],
+        hour=[hour],
+        distance_km=[float(distance_m or 0.0) / 1000.0],
+        driver_age=[float(driver_age) if driver_age is not None else DEFAULT_DRIVER_AGE],
+    )
+
+
+def batch_from_mapping(batch: Mapping[str, np.ndarray]) -> np.ndarray:
+    """Dataset-dict (synthetic.py schema) → (N, 12) features.
+
+    Pure numpy: this is the host-side featurization used by the training
+    loop and the CPU baseline — no device round-trip for a one-hot/concat.
+    """
+    w = np.asarray(batch["weather_idx"], dtype=np.int64)
+    t = np.asarray(batch["traffic_idx"], dtype=np.int64)
+    n = len(w)
+    out = np.zeros((n, N_FEATURES), dtype=np.float32)
+    rows = np.arange(n)
+    valid_w = w >= 0
+    out[rows[valid_w], w[valid_w]] = 1.0
+    valid_t = t >= 0
+    out[rows[valid_t], len(WEATHER_CATEGORIES) + t[valid_t]] = 1.0
+    base = len(WEATHER_CATEGORIES) + len(TRAFFIC_CATEGORIES)
+    out[:, base + 0] = np.asarray(batch["weekday"], dtype=np.float32)
+    out[:, base + 1] = np.asarray(batch["hour"], dtype=np.float32)
+    out[:, base + 2] = np.asarray(batch["distance_km"], dtype=np.float32)
+    out[:, base + 3] = np.asarray(batch["driver_age"], dtype=np.float32)
+    return out

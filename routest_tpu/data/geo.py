@@ -1,0 +1,120 @@
+"""On-device geodesic math: haversine matrices, polylines, road heuristics.
+
+The reference outsources all of this to OpenRouteService / OSRM over HTTPS
+(``Flaskr/utils.py:55,97,151``). Here the distance matrix is one fused XLA
+computation on device — the host↔accelerator boundary replaces the
+service↔ORS HTTP boundary (SURVEY.md §5.8) — with per-profile road-factor
+and speed heuristics standing in for real road network traversal (a static
+road graph is the planned upgrade; SURVEY.md §7.3 item 5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+EARTH_RADIUS_M = 6_371_008.8
+
+# Vehicle-type → routing profile, as the reference maps them
+# (``Flaskr/utils.py:22-29``).
+VEHICLE_PROFILES: Dict[str, str] = {
+    "car": "driving-car",
+    "truck": "driving-hgv",
+    "hgv": "driving-hgv",
+    "motorcycle": "driving-car",
+    "bike": "cycling-regular",
+    "roadbike": "cycling-road",
+    "foot": "foot-walking",
+}
+DEFAULT_PROFILE = "driving-car"
+
+# Heuristic stand-ins for a road engine: straight-line→road-network
+# inflation factor and mean speed (m/s) per profile. Metro Manila urban
+# grid detour factors are typically 1.3-1.5.
+PROFILE_ROAD_FACTOR: Dict[str, float] = {
+    "driving-car": 1.42,
+    "driving-hgv": 1.48,
+    "cycling-regular": 1.38,
+    "cycling-road": 1.35,
+    "foot-walking": 1.25,
+}
+PROFILE_SPEED_MPS: Dict[str, float] = {
+    "driving-car": 8.3,      # ~30 km/h urban average
+    "driving-hgv": 6.9,
+    "cycling-regular": 4.2,
+    "cycling-road": 5.5,
+    "foot-walking": 1.4,
+}
+
+
+def profile_for_vehicle(vehicle_type: str) -> str:
+    return VEHICLE_PROFILES.get((vehicle_type or "car").lower().strip(), DEFAULT_PROFILE)
+
+
+def haversine_m(lat1, lon1, lat2, lon2):
+    """Great-circle distance in meters; works elementwise on jnp arrays."""
+    lat1, lon1, lat2, lon2 = (jnp.radians(x) for x in (lat1, lon1, lat2, lon2))
+    dlat = lat2 - lat1
+    dlon = lon2 - lon1
+    a = jnp.sin(dlat / 2.0) ** 2 + jnp.cos(lat1) * jnp.cos(lat2) * jnp.sin(dlon / 2.0) ** 2
+    return 2.0 * EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+def distance_matrix_m(points_latlon: jnp.ndarray, road_factor: float = 1.0) -> jnp.ndarray:
+    """(N, 2) [lat, lon] → (N, N) pairwise road-ish distance in meters.
+
+    One broadcasted haversine — the on-device replacement for the ORS
+    matrix call (``Flaskr/utils.py:97-103``); O(N²) but N is tiny per
+    problem; batching across problems is where the mesh parallelism goes.
+    """
+    lat = points_latlon[:, 0]
+    lon = points_latlon[:, 1]
+    d = haversine_m(lat[:, None], lon[:, None], lat[None, :], lon[None, :])
+    return d * road_factor
+
+
+def great_circle_interpolate(p0: Tuple[float, float], p1: Tuple[float, float],
+                             n_points: int) -> np.ndarray:
+    """Host-side densified polyline between two [lat, lon] points.
+
+    Returns (n_points, 2) as [lon, lat] — GeoJSON coordinate order, which
+    is what the reference's combined Feature geometry uses
+    (``Flaskr/utils.py:162,180``).
+    """
+    lat0, lon0 = np.radians(p0[0]), np.radians(p0[1])
+    lat1, lon1 = np.radians(p1[0]), np.radians(p1[1])
+    d = 2.0 * np.arcsin(
+        np.sqrt(
+            np.clip(
+                np.sin((lat1 - lat0) / 2.0) ** 2
+                + np.cos(lat0) * np.cos(lat1) * np.sin((lon1 - lon0) / 2.0) ** 2,
+                0.0,
+                1.0,
+            )
+        )
+    )
+    t = np.linspace(0.0, 1.0, max(2, n_points))
+    if d < 1e-9:
+        lats = np.full_like(t, p0[0])
+        lons = np.full_like(t, p0[1])
+    else:
+        a = np.sin((1.0 - t) * d) / np.sin(d)
+        b = np.sin(t * d) / np.sin(d)
+        x = a * np.cos(lat0) * np.cos(lon0) + b * np.cos(lat1) * np.cos(lon1)
+        y = a * np.cos(lat0) * np.sin(lon0) + b * np.cos(lat1) * np.sin(lon1)
+        z = a * np.sin(lat0) + b * np.sin(lat1)
+        lats = np.degrees(np.arctan2(z, np.sqrt(x * x + y * y)))
+        lons = np.degrees(np.arctan2(y, x))
+    return np.stack([lons, lats], axis=-1)
+
+
+def bearing_deg(p0: Tuple[float, float], p1: Tuple[float, float]) -> float:
+    """Initial bearing from p0 to p1 (degrees, [lat, lon] inputs)."""
+    lat0, lon0 = np.radians(p0[0]), np.radians(p0[1])
+    lat1, lon1 = np.radians(p1[0]), np.radians(p1[1])
+    dlon = lon1 - lon0
+    x = np.sin(dlon) * np.cos(lat1)
+    y = np.cos(lat0) * np.sin(lat1) - np.sin(lat0) * np.cos(lat1) * np.cos(dlon)
+    return float((np.degrees(np.arctan2(x, y)) + 360.0) % 360.0)

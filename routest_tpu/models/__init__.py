@@ -1,0 +1,1 @@
+from routest_tpu.models.eta_mlp import EtaMLP  # noqa: F401

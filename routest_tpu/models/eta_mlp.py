@@ -1,0 +1,93 @@
+"""ETA regressor: an MLP over the 12-feature encoding.
+
+Replaces the reference's pickled XGBoost booster (``Flaskr/ml.py`` —
+batch-size-1 CPU tree walks) with a model whose inference is pure MXU
+matmuls: (B,12)→(B,H)→…→(B,1) in bfloat16, trivially batched and sharded
+over the mesh data axis. SURVEY.md §7.3 item 2 motivates the MLP-first
+choice (a tensorized tree-ensemble is the planned model-zoo alternative
+for strict parity with tree models).
+
+Parameters are a plain pytree (dict), so pjit/optax/orbax all apply
+directly. A feature normalizer (mean/std fitted on the training set) is
+stored inside the params pytree and applied (with stop_gradient) in
+``apply`` — serving can never skew from training-time normalization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from routest_tpu.core.dtypes import DEFAULT_POLICY, Policy
+from routest_tpu.data.features import N_FEATURES
+
+Params = Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class EtaMLP:
+    """Configured model; ``init``/``apply`` are pure functions of params."""
+
+    hidden: Tuple[int, ...] = (256, 256, 128)
+    n_features: int = N_FEATURES
+    policy: Policy = DEFAULT_POLICY
+
+    @classmethod
+    def from_config(cls, cfg, policy: Policy = DEFAULT_POLICY) -> "EtaMLP":
+        """Build from a core.config.ModelConfig (the env-layered path)."""
+        return cls(hidden=tuple(cfg.hidden), policy=policy)
+
+    def init(self, key: jax.Array,
+             norm_mean: Optional[np.ndarray] = None,
+             norm_std: Optional[np.ndarray] = None) -> Params:
+        dims = (self.n_features,) + tuple(self.hidden) + (1,)
+        params: Params = {"layers": []}
+        for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+            key, sub = jax.random.split(key)
+            scale = jnp.sqrt(2.0 / d_in)
+            params["layers"].append(
+                {
+                    "w": jax.random.normal(sub, (d_in, d_out), self.policy.param_dtype) * scale,
+                    "b": jnp.zeros((d_out,), self.policy.param_dtype),
+                }
+            )
+        mean = np.zeros((self.n_features,), np.float32) if norm_mean is None else norm_mean
+        std = np.ones((self.n_features,), np.float32) if norm_std is None else norm_std
+        # Constant columns (e.g. a one-hot category absent from the training
+        # set) get std≈0; normalize them with identity scale instead of
+        # exploding a future non-zero value by 1/ε.
+        std = np.where(np.asarray(std) < 1e-3, 1.0, std)
+        params["norm"] = {
+            "mean": jnp.asarray(mean, self.policy.param_dtype),
+            "std": jnp.asarray(std, self.policy.param_dtype),
+        }
+        return params
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        """(B, 12) features → (B,) ETA minutes. bf16 compute, f32 out."""
+        norm = params["norm"]
+        x = (x - jax.lax.stop_gradient(norm["mean"])) / jax.lax.stop_gradient(norm["std"])
+        h = x.astype(self.policy.compute_dtype)
+        layers = params["layers"]
+        for layer in layers[:-1]:
+            w = layer["w"].astype(self.policy.compute_dtype)
+            b = layer["b"].astype(self.policy.compute_dtype)
+            h = jax.nn.gelu(h @ w + b)
+        last = layers[-1]
+        out = h @ last["w"].astype(self.policy.compute_dtype) + last["b"].astype(
+            self.policy.compute_dtype
+        )
+        # Softplus keeps ETA strictly positive without clipping gradients the
+        # way relu-at-output would.
+        eta = jax.nn.softplus(out[..., 0].astype(self.policy.output_dtype))
+        return eta
+
+
+def fit_normalizer(features: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Mean/std over the training features. ``init`` replaces near-zero
+    stds (constant columns) with 1.0 so unseen categories can't explode."""
+    return features.mean(axis=0).astype(np.float32), features.std(axis=0).astype(np.float32)

@@ -1,0 +1,1 @@
+from routest_tpu.train.loop import TrainState, fit, make_train_step, rmse  # noqa: F401

@@ -1,0 +1,111 @@
+"""Checkpointing & model-artifact IO.
+
+The reference's entire persistence story for the model is "lazily unpickle
+``xgb_eta_model.pkl``, path overridable via ``ETA_MODEL_PATH``"
+(``Flaskr/ml.py:6-21``; SURVEY.md §5.4). Here:
+
+- training checkpoints (params + optimizer state + step) go through Orbax;
+- the *serving artifact* is a single msgpack file (flax serialization) of
+  the params pytree plus a small JSON header with the model config — no
+  pickle, loadable without trusting the file;
+- ``ETA_MODEL_PATH`` still points at the serving artifact, for env parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from routest_tpu.models.eta_mlp import EtaMLP, Params
+
+_HEADER_KEY = b"__routest_tpu_header__"
+MAGIC = b"RTPU1\n"
+
+
+def save_model(path: str, model: EtaMLP, params: Params) -> None:
+    """Serving artifact: MAGIC + json header line + msgpack params."""
+    header = json.dumps(
+        {
+            "format": "routest_tpu.eta_mlp",
+            "version": 1,
+            "hidden": list(model.hidden),
+            "n_features": model.n_features,
+            "compute_dtype": np.dtype(model.policy.compute_dtype).name,
+        }
+    ).encode() + b"\n"
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    blob = serialization.msgpack_serialize(host_params)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(header)
+        f.write(blob)
+
+
+def load_model(path: str) -> Tuple[EtaMLP, Params]:
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not a routest_tpu model artifact")
+        header = json.loads(f.readline().decode())
+        blob = f.read()
+    if header.get("format") != "routest_tpu.eta_mlp":
+        raise ValueError(f"{path}: unknown artifact format {header.get('format')}")
+    import jax.numpy as jnp
+
+    from routest_tpu.core.dtypes import DEFAULT_POLICY
+    import dataclasses as _dc
+
+    compute = header.get("compute_dtype", "bfloat16")
+    policy = _dc.replace(DEFAULT_POLICY, compute_dtype=jnp.dtype(compute).type)
+    model = EtaMLP(hidden=tuple(header["hidden"]), n_features=header["n_features"],
+                   policy=policy)
+    params = serialization.msgpack_restore(blob)
+    params = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+    return model, params
+
+
+def default_model_path(cfg=None) -> str:
+    """Resolution order: explicit ModelConfig.model_path (set from
+    ETA_MODEL_PATH by ``load_config``), then the env var directly, then the
+    in-repo artifact location (mirrors ``Flaskr/ml.py:6-9`` behavior)."""
+    if cfg is not None and getattr(cfg, "model_path", None):
+        return cfg.model_path
+    return os.getenv("ETA_MODEL_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "artifacts",
+        "eta_mlp.msgpack",
+    )
+
+
+# ── Orbax training checkpoints ────────────────────────────────────────────
+
+def save_checkpoint(ckpt_dir: str, step: int, state) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    ckptr = ocp.StandardCheckpointer()
+    host_state = jax.tree_util.tree_map(np.asarray, state)
+    ckptr.save(path, host_state, force=True)
+    ckptr.wait_until_finished()
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("step_"))
+    return os.path.join(ckpt_dir, steps[-1]) if steps else None
+
+
+def restore_checkpoint(path: str, target):
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    host_target = jax.tree_util.tree_map(np.asarray, target)
+    return ckptr.restore(path, host_target)

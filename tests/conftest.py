@@ -1,0 +1,48 @@
+"""Hermetic test environment: 8 virtual CPU devices emulating a v5e-8 mesh.
+
+The reference's one isolation idea — swap real backends for in-memory
+fakes (its phpunit sqlite-:memory: config, SURVEY.md §4) — generalized:
+tests run on the CPU backend with ``xla_force_host_platform_device_count=8``
+so every sharding/collective path compiles and executes without TPU
+hardware. Must run before any jax backend initialization, hence conftest.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The sandbox pins JAX_PLATFORMS=axon (real TPU tunnel); tests must stay
+# hermetic and fast, so force the CPU backend (env override is ignored
+# because the axon site customization re-exports it — use the config API).
+jax.config.update("jax_platforms", "cpu")
+# Catch NaNs early in the functional core (SURVEY.md §5.2).
+jax.config.update("jax_debug_nans", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh_runtime():
+    from routest_tpu.core.mesh import MeshRuntime
+
+    rt = MeshRuntime.create()
+    assert rt.n_data == 8, f"expected 8 virtual devices, got {rt.n_data}"
+    return rt
+
+
+@pytest.fixture(scope="session")
+def tiny_dataset():
+    from routest_tpu.data.synthetic import generate_dataset, train_eval_split
+
+    data = generate_dataset(4096, seed=42)
+    return train_eval_split(data, eval_frac=0.25)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,80 @@
+"""Feature-encoding parity vs an independent numpy oracle of the
+reference's contract (``Flaskr/ml.py:35-48``, SURVEY.md Appendix B)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from routest_tpu.data.features import (
+    FEATURE_NAMES,
+    N_FEATURES,
+    TRAFFIC_CATEGORIES,
+    WEATHER_CATEGORIES,
+    encode_features,
+    encode_request,
+    encode_requests,
+    vocab_index,
+)
+
+
+def oracle_row(weather, traffic, weekday, hour, distance_km, driver_age):
+    """Straight transcription of the documented 12-feature dict semantics."""
+    return np.asarray(
+        [
+            float(weather == "Cloudy"),
+            float(weather == "Stormy"),
+            float(weather == "Sunny"),
+            float(weather == "Windy"),
+            float(traffic == "High"),
+            float(traffic == "Jam"),
+            float(traffic == "Low"),
+            float(traffic == "Medium"),
+            float(weekday),
+            float(hour),
+            float(distance_km),
+            float(driver_age),
+        ],
+        dtype=np.float32,
+    )
+
+
+def test_feature_names_order():
+    assert N_FEATURES == 12
+    assert FEATURE_NAMES[0] == "weather_Cloudy"
+    assert FEATURE_NAMES[4] == "traffic_High"
+    assert FEATURE_NAMES[8:] == ("weekday_ordered", "hour_ordered", "distance_km", "driver_age")
+
+
+@pytest.mark.parametrize("weather", list(WEATHER_CATEGORIES) + ["Fog", ""])
+@pytest.mark.parametrize("traffic", list(TRAFFIC_CATEGORIES) + ["Gridlock"])
+def test_encode_matches_oracle(weather, traffic):
+    expected = oracle_row(weather, traffic, 3, 17, 12.5, 41.0)
+    got = encode_requests([weather], [traffic], [3], [17], [12.5], [41.0])[0]
+    np.testing.assert_allclose(got, expected, atol=0)
+
+    w = vocab_index([weather], WEATHER_CATEGORIES)
+    t = vocab_index([traffic], TRAFFIC_CATEGORIES)
+    jnp_row = np.asarray(
+        encode_features(
+            jnp.asarray(w), jnp.asarray(t), jnp.asarray([3]), jnp.asarray([17]),
+            jnp.asarray([12.5]), jnp.asarray([41.0])
+        )
+    )[0]
+    np.testing.assert_allclose(jnp_row, expected, atol=1e-6)
+
+
+def test_unknown_category_is_all_zero_group():
+    row = encode_requests(["Fog"], ["Gridlock"], [0], [0], [1.0], [30.0])[0]
+    assert row[:8].sum() == 0.0
+
+
+def test_encode_request_defaults():
+    # Defaults mirror routes.py:103-104,371-372: Sunny / Low / age 30.
+    row = encode_request(distance_m=2500.0, weekday=2, hour=9)[0]
+    expected = oracle_row("Sunny", "Low", 2, 9, 2.5, 30.0)
+    np.testing.assert_allclose(row, expected)
+
+
+def test_distance_meters_to_km():
+    row = encode_request(distance_m=6983.0)[0]
+    assert abs(row[10] - 6.983) < 1e-6

@@ -1,0 +1,46 @@
+"""Synthetic dataset sanity: schema, ranges, learnable signal."""
+
+import numpy as np
+
+from routest_tpu.data.synthetic import generate_dataset, true_eta_minutes
+
+
+def test_schema_and_ranges():
+    d = generate_dataset(1000, seed=7)
+    assert set(d) >= {"weather_idx", "traffic_idx", "weekday", "hour",
+                      "distance_km", "driver_age", "eta_minutes"}
+    assert d["weekday"].min() >= 0 and d["weekday"].max() <= 6
+    assert d["hour"].min() >= 0 and d["hour"].max() <= 23
+    assert d["distance_km"].min() >= 0.3 and d["distance_km"].max() <= 80.0
+    assert (d["eta_minutes"] > 0).all()
+    # a few unknown-category rows exist
+    assert (d["weather_idx"] == -1).any()
+    assert (d["traffic_idx"] == -1).any()
+
+
+def test_deterministic_by_seed():
+    a = generate_dataset(100, seed=3)
+    b = generate_dataset(100, seed=3)
+    np.testing.assert_array_equal(a["eta_minutes"], b["eta_minutes"])
+
+
+def test_traffic_orders_eta():
+    """Jam must be slower than Low traffic, all else equal."""
+    n = 64
+    base = dict(
+        weather_idx=np.full(n, 2), weekday=np.full(n, 2), hour=np.full(n, 13),
+        distance_km=np.linspace(1, 40, n), driver_age=np.full(n, 35.0),
+    )
+    jam = true_eta_minutes(traffic_idx=np.full(n, 1), **base)
+    low = true_eta_minutes(traffic_idx=np.full(n, 2), **base)
+    assert (jam > low).all()
+
+
+def test_distance_monotone():
+    n = 32
+    eta = true_eta_minutes(
+        weather_idx=np.full(n, 2), traffic_idx=np.full(n, 3),
+        weekday=np.full(n, 1), hour=np.full(n, 11),
+        distance_km=np.linspace(0.5, 60, n), driver_age=np.full(n, 35.0),
+    )
+    assert (np.diff(eta) > 0).all()
