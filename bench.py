@@ -1,0 +1,81 @@
+"""Benchmark: OD-pair ETA scoring throughput on the available accelerator.
+
+BASELINE.json config 2 ("route_optimizer_twx2 batch scoring") scaled up:
+HBM-resident OD batches through the jit-compiled ETA model. The reference
+scores one row per HTTP request on CPU (``Flaskr/ml.py:51-53``); the
+north-star target is ≥10,000 preds/sec (v5e-8). Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TARGET_PREDS_PER_SEC = 10_000.0  # BASELINE.json north star
+BATCH = 1 << 17                  # 131,072 OD pairs per device call
+ITERS = 200
+REPEATS = 5
+
+
+def main() -> None:
+    from routest_tpu.data.features import batch_from_mapping
+    from routest_tpu.data.synthetic import generate_dataset
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.train.checkpoint import default_model_path, load_model
+
+    try:
+        model, params = load_model(default_model_path())
+    except Exception:
+        model = EtaMLP()
+        params = model.init(jax.random.PRNGKey(0))
+    # load_model returns host numpy arrays; without an explicit device_put
+    # every jit call re-uploads the params.
+    params = jax.device_put(params)
+
+    data = generate_dataset(BATCH, seed=123)
+    x = jnp.asarray(batch_from_mapping(data))
+    x = jax.device_put(x)
+
+    # Timing on the tunneled TPU platform needs care: block_until_ready
+    # returns before remote execution finishes, and results that are never
+    # fetched are never executed. So (a) each iteration's input depends on
+    # the previous output — no dead code, strict serial execution — and
+    # (b) the clock stops on a device→host fetch, with fixed round-trip
+    # latency removed by differencing two run lengths.
+    @jax.jit
+    def step(p, xx):
+        eta = model.apply(p, xx)
+        return xx.at[:, 10].add(eta * 1e-12), eta
+
+    def timed(iters: int) -> float:
+        xx = x
+        t0 = time.perf_counter()
+        eta = None
+        for _ in range(iters):
+            xx, eta = step(params, xx)
+        np.asarray(eta[:1])  # host fetch = the only real barrier
+        return time.perf_counter() - t0
+
+    timed(2)  # compile + warmup
+    diffs = []
+    for _ in range(REPEATS):
+        t_short = timed(ITERS)
+        t_long = timed(2 * ITERS)
+        diffs.append((t_long - t_short) / ITERS)
+    per_iter = max(float(np.median(diffs)), 1e-9)
+
+    preds_per_sec = BATCH / per_iter
+    print(json.dumps({
+        "metric": "od_eta_preds_per_sec",
+        "value": round(preds_per_sec, 1),
+        "unit": "preds/s",
+        "vs_baseline": round(preds_per_sec / TARGET_PREDS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

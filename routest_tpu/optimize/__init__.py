@@ -1,0 +1,2 @@
+from routest_tpu.optimize.vrp import greedy_vrp, greedy_vrp_batch  # noqa: F401
+from routest_tpu.optimize.engine import optimize_route  # noqa: F401

@@ -1,0 +1,232 @@
+"""The routing engine: ORS-shaped results computed on device.
+
+Where the reference makes 2+N HTTPS calls to OpenRouteService per request
+(matrix + per-trip directions, ``Flaskr/utils.py:94-175``), this engine
+computes the distance matrix and the greedy multi-trip order on the
+accelerator and synthesizes the geometry host-side (great-circle polylines
+with per-profile road factors — a static road-graph engine is the planned
+upgrade, SURVEY.md §7.3 item 5).
+
+Output is wire-ABI compatible with the reference (SURVEY.md Appendix A):
+a GeoJSON Feature with ``properties.optimized_order``, ``source``,
+``destinations``, ``segments[].steps[]``, ``summary{distance,duration
+[,trips]}``, bbox — plus the common annotations (vehicle_type,
+driver_name, engine). Errors are ``{"error": "..."}`` dicts with the same
+messages the frontend already handles.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from routest_tpu.data import geo
+from routest_tpu.optimize.vrp import solve_host
+
+ENGINE_TAG = "backend:jax-tpu"
+
+_COMPASS = ("north", "north-east", "east", "south-east",
+            "south", "south-west", "west", "north-west")
+
+
+def _compass(bearing: float) -> str:
+    return _COMPASS[int(((bearing + 22.5) % 360.0) // 45.0)]
+
+
+def _leg_geometry(p0, p1, n_points: int = 24) -> np.ndarray:
+    return geo.great_circle_interpolate(p0, p1, n_points)
+
+
+def _leg_steps(p0, p1, name: str, distance_m: float, duration_s: float,
+               wp_start: int, wp_end: int) -> List[Dict]:
+    """ORS-shaped step list for one leg: depart instruction + arrival."""
+    bearing = geo.bearing_deg(p0, p1)
+    return [
+        {
+            "distance": round(distance_m, 1),
+            "duration": round(duration_s, 1),
+            "type": 11,  # depart
+            "instruction": f"Head {_compass(bearing)} toward {name}",
+            "name": "-",
+            "way_points": [wp_start, wp_end],
+        },
+        {
+            "distance": 0.0,
+            "duration": 0.0,
+            "type": 10,  # arrive
+            "instruction": f"Arrive at {name}",
+            "name": "-",
+            "way_points": [wp_end, wp_end],
+        },
+    ]
+
+
+def _stop_name(point: Dict, idx: Optional[int]) -> str:
+    if point.get("name"):
+        return str(point["name"])
+    return "origin" if idx is None else f"stop {idx + 1}"
+
+
+def _build_trip_feature_parts(all_points: List[Dict], trip: Sequence[int],
+                              dist: np.ndarray, speed_mps: float):
+    """One trip (origin → stops → origin): geometry, segments, totals."""
+    node_seq = [0] + [i + 1 for i in trip] + [0]
+    coords: List[List[float]] = []
+    segments: List[Dict] = []
+    total_dist = 0.0
+    total_dur = 0.0
+    for a, b in zip(node_seq[:-1], node_seq[1:]):
+        pa, pb = all_points[a], all_points[b]
+        leg_m = float(dist[a, b])
+        leg_s = leg_m / speed_mps
+        g = _leg_geometry((pa["lat"], pa["lon"]), (pb["lat"], pb["lon"]))
+        wp_start = len(coords)
+        pts = g.tolist() if not coords else g.tolist()[1:]
+        coords.extend(pts)
+        wp_end = len(coords) - 1
+        name = _stop_name(pb, b - 1 if b > 0 else None)
+        segments.append(
+            {
+                "distance": round(leg_m, 1),
+                "duration": round(leg_s, 1),
+                "steps": _leg_steps((pa["lat"], pa["lon"]), (pb["lat"], pb["lon"]),
+                                    name, leg_m, leg_s, wp_start, wp_end),
+            }
+        )
+        total_dist += leg_m
+        total_dur += leg_s
+    return coords, segments, total_dist, total_dur
+
+
+def optimize_route(input_data: dict) -> dict:
+    """Drop-in equivalent of the reference's optimizer entry point
+    (``Flaskr/utils.py:10-48``): dict in, GeoJSON Feature (or error) out."""
+    if not input_data or not input_data.get("destination_points"):
+        return {"error": "no destination points specified."}
+    if not input_data.get("source_point"):
+        return {"error": "no source point specified."}
+
+    driver_details = input_data.get("driver_details") or {}
+    vehicle_type = (driver_details.get("vehicle_type") or "car").lower().strip()
+    profile = geo.profile_for_vehicle(vehicle_type)
+    road_factor = geo.PROFILE_ROAD_FACTOR[profile]
+    speed = geo.PROFILE_SPEED_MPS[profile]
+
+    source = input_data["source_point"]
+    destinations = input_data["destination_points"]
+
+    try:
+        cap = float(driver_details.get("vehicle_capacity", 9e12))
+        max_dist = float(driver_details.get("maximum_distance", 9e12))
+    except (TypeError, ValueError):
+        return {"error": "invalid driver_details: vehicle_capacity/maximum_distance must be numeric"}
+
+    all_points = [source] + list(destinations)
+    try:
+        latlon = np.asarray([[float(p["lat"]), float(p["lon"])] for p in all_points],
+                            dtype=np.float32)
+    except (KeyError, TypeError, ValueError):
+        return {"error": "invalid coordinates: each point needs numeric lat/lon"}
+
+    dist = np.asarray(geo.distance_matrix_m(jnp.asarray(latlon), road_factor))
+
+    if len(destinations) == 1:
+        return _point_to_point(source, destinations[0], all_points, dist, speed,
+                               driver_details, vehicle_type, cap, max_dist)
+
+    try:
+        demands = np.asarray([float(p.get("payload", 0) or 0) for p in destinations],
+                             dtype=np.float32)
+    except (TypeError, ValueError):
+        return {"error": "invalid destination payload: must be numeric"}
+    sol = solve_host(dist, demands, cap, max_dist)
+    if sol["unroutable"]:
+        which = ", ".join(str(i) for i in sol["unroutable"])
+        return {"error": f"stops not routable under constraints (indices: {which})"}
+
+    coords: List[List[float]] = []
+    segments: List[Dict] = []
+    total_dist = 0.0
+    total_dur = 0.0
+    for trip in sol["trips"]:
+        c, s, d, t = _build_trip_feature_parts(all_points, trip, dist, speed)
+        coords.extend(c)
+        segments.extend(s)
+        total_dist += d
+        total_dur += t
+
+    lons = [c[0] for c in coords]
+    lats = [c[1] for c in coords]
+    feature = {
+        "bbox": [min(lons), min(lats), max(lons), max(lats)],
+        "type": "Feature",
+        "geometry": {"type": "LineString", "coordinates": coords},
+        "properties": {
+            "source": source,
+            "destinations": list(destinations),
+            "optimized_order": sol["optimized_order"],
+            "segments": segments,
+            "summary": {
+                "distance": round(total_dist, 1),
+                "duration": round(total_dur, 1),
+                "trips": sol["n_trips"],
+            },
+        },
+    }
+    _annotate(feature, driver_details, vehicle_type)
+    return feature
+
+
+def _point_to_point(source, destination, all_points, dist, speed,
+                    driver_details, vehicle_type, cap, max_dist) -> dict:
+    """Single-destination path with the reference's feasibility semantics
+    (``Flaskr/utils.py:53-82``): payload > capacity and distance >
+    maximum_distance produce the same joined error strings."""
+    d_m = float(dist[0, 1])
+    payload = float(destination.get("payload", 0) or 0)
+    errors = []
+    if payload > cap:
+        errors.append("payload exceeds vehicle capacity")
+    if d_m > max_dist:
+        errors.append("route distance exceeds maximum_distance")
+    if errors:
+        return {"error": " | ".join(errors)}
+
+    coords, segments, total_dist, total_dur = _build_trip_feature_parts(
+        all_points, [0], dist, speed
+    )
+    # Reference point-to-point is one-way (no return leg): use only the
+    # outbound segment.
+    out_seg = segments[0]
+    out_coords = coords[: out_seg["steps"][0]["way_points"][1] + 1]
+    lons = [c[0] for c in out_coords]
+    lats = [c[1] for c in out_coords]
+    feature = {
+        "bbox": [min(lons), min(lats), max(lons), max(lats)],
+        "type": "Feature",
+        "geometry": {"type": "LineString", "coordinates": out_coords},
+        "properties": {
+            "segments": [out_seg],
+            "summary": {
+                "distance": round(out_seg["distance"], 1),
+                "duration": round(out_seg["duration"], 1),
+            },
+            "way_points": [0, len(out_coords) - 1],
+            "optimized_order": [0],
+            "source": source,
+            "destinations": [destination],
+        },
+    }
+    _annotate(feature, driver_details, vehicle_type)
+    return feature
+
+
+def _annotate(feature: dict, driver_details: dict, vehicle_type: str) -> None:
+    """Common properties the frontend reads (``Flaskr/utils.py:196-201``)."""
+    p = feature.setdefault("properties", {})
+    p["vehicle_type"] = vehicle_type
+    p["driver_name"] = driver_details.get("driver_name")
+    p["engine"] = ENGINE_TAG

@@ -1,0 +1,1 @@
+from routest_tpu.serve.app import create_app  # noqa: F401

@@ -1,0 +1,46 @@
+"""Dev server entry point: ``python -m routest_tpu.serve``.
+
+Equivalent of the reference's ``app.py`` dev entry (Flask dev server on
+:5000); honors the same PORT env var. If no model artifact exists yet, a
+quick synthetic training run materializes one so the service comes up
+fully functional out of the box.
+"""
+
+from __future__ import annotations
+
+import os
+
+from werkzeug.serving import run_simple
+
+from routest_tpu.core.config import load_config
+from routest_tpu.serve.app import create_app
+from routest_tpu.train.checkpoint import default_model_path
+
+
+def ensure_model(path: str) -> None:
+    if os.path.exists(path):
+        return
+    print(f"[serve] no model artifact at {path}; training a quick one …")
+    from routest_tpu.core.config import TrainConfig
+    from routest_tpu.data.synthetic import generate_dataset, train_eval_split
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.train.checkpoint import save_model
+    from routest_tpu.train.loop import fit
+
+    train, ev = train_eval_split(generate_dataset(200_000, seed=0))
+    model = EtaMLP()
+    result = fit(model, train, ev, TrainConfig(epochs=15))
+    save_model(path, model, result.state.params)
+    print(f"[serve] trained (eval RMSE {result.eval_rmse:.2f} min) → {path}")
+
+
+def main() -> None:
+    config = load_config()
+    ensure_model(default_model_path(config.model))
+    app = create_app(config)
+    print(f"[serve] listening on {config.serve.host}:{config.serve.port}")
+    run_simple(config.serve.host, config.serve.port, app, threaded=True)
+
+
+if __name__ == "__main__":
+    main()

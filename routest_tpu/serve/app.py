@@ -1,0 +1,342 @@
+"""The serving application: the reference's full HTTP ABI, TPU-backed.
+
+Every endpoint of the reference Flask service (SURVEY.md Appendix A,
+``Flaskr/routes.py``) plus Laravel's ``GET /api/locations``, mounted at
+``/api``. Differences under the hood:
+
+- route optimization runs on-device (``optimize.engine``) instead of ORS;
+- ETA prediction goes through the dynamic batcher to a jit-compiled MLP;
+- persistence/SSE default to hermetic in-memory backends, switching to
+  PostgREST/Redis when the reference's env vars are configured;
+- health keeps the degraded-not-down contract (always HTTP 200,
+  ``Flaskr/routes.py:339-363``) and adds TPU gauges (preds/sec, batch
+  fill, devices) under ``checks.tpu`` (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import time
+from typing import Optional
+
+from werkzeug.wrappers import Response
+
+from routest_tpu.core.config import Config, load_config
+from routest_tpu.data.locations import locations_table
+from routest_tpu.optimize.engine import optimize_route
+from routest_tpu.serve import sim
+from routest_tpu.serve.bus import make_bus, sse_stream
+from routest_tpu.serve.ml_service import EtaService
+from routest_tpu.serve.store import make_store
+from routest_tpu.serve.wsgi import App, get_json
+
+
+class ServerState:
+    """Everything the handlers share."""
+
+    def __init__(self, config: Config, eta: EtaService, store, bus,
+                 sim_tick_range=(2.0, 5.0)) -> None:
+        self.config = config
+        self.eta = eta
+        self.store = store
+        self.bus = bus
+        self.sim_tick_range = sim_tick_range
+        self.started = time.time()
+
+
+def create_app(config: Optional[Config] = None,
+               eta_service: Optional[EtaService] = None,
+               store=None, bus=None,
+               sim_tick_range=(2.0, 5.0)) -> App:
+    config = config or load_config()
+    if eta_service is not None:
+        eta = eta_service
+    else:
+        from routest_tpu.train.checkpoint import default_model_path
+
+        eta = EtaService(config.serve,
+                         model_path=default_model_path(config.model))
+    store = store if store is not None else make_store(
+        config.serve.supabase_url, config.serve.supabase_service_key
+    )
+    bus = bus if bus is not None else make_bus(config.serve.redis_url)
+    state = ServerState(config, eta, store, bus, sim_tick_range)
+
+    app = App()
+    app.state = state  # for tests / introspection
+
+    # ── optimization ────────────────────────────────────────────────────
+
+    @app.route("/api/request_route", methods=("POST",))
+    def request_route(request):
+        data = get_json(request)
+        response = optimize_route(data or {})
+        if not response:
+            return {"error": "no response acquired from the optimizer."}, 400
+        if isinstance(response, dict) and response.get("error"):
+            return response, 400
+        return response, 200
+
+    @app.route("/api/optimize_route", methods=("POST",))
+    def optimize_route_endpoint(request):
+        payload = get_json(request) or {}
+        result = optimize_route(payload)
+        if isinstance(result, dict) and result.get("error"):
+            return result, 400
+
+        # Optional ML ETA — computed before persisting, as the reference
+        # does (``Flaskr/routes.py:96-116``).
+        if payload.get("use_ml_eta"):
+            props = result.setdefault("properties", {}) or {}
+            summary = props.get("summary", {}) or {}
+            ctx = payload.get("context") or {}
+            eta_min, eta_iso = state.eta.predict_eta_minutes(
+                weather=ctx.get("weather", "Sunny"),
+                traffic=ctx.get("traffic", "Low"),
+                distance_m=float(summary.get("distance") or 0),
+                pickup_time=dt.datetime.now(),
+                driver_age=float((payload.get("driver_details") or {})
+                                 .get("driver_age", 30) or 30),
+            )
+            if eta_min is not None:
+                props["eta_minutes_ml"] = eta_min
+                props["eta_completion_time_ml"] = eta_iso
+
+        # Best-effort persistence: failures are logged, never fatal
+        # (``Flaskr/routes.py:118-125``).
+        try:
+            req_id = _persist(state, payload, result)
+            if req_id:
+                result.setdefault("properties", {})["request_id"] = req_id
+                result["properties"]["saved"] = True
+        except Exception as e:
+            print("Persist failed:", e)
+
+        return result, 200
+
+    # ── prediction ─────────────────────────────────────────────────────
+
+    @app.route("/api/predict_eta", methods=("POST",))
+    def predict_eta(request):
+        body = get_json(request) or {}
+        summary = body.get("summary") or {}
+        eta_min, eta_iso = state.eta.predict_eta_minutes(
+            weather=body.get("weather", "Sunny"),
+            traffic=body.get("traffic", "Low"),
+            distance_m=float(summary.get("distance") or 0),
+            pickup_time=body.get("pickup_time") or dt.datetime.now().isoformat(),
+            driver_age=float(body.get("driver_age", 30) or 30),
+        )
+        if eta_min is None:
+            return {"error": "model unavailable"}, 503
+        return {"eta_minutes_ml": eta_min, "eta_completion_time_ml": eta_iso}, 200
+
+    # ── live tracking ──────────────────────────────────────────────────
+
+    @app.route("/api/confirm_route", methods=("POST",))
+    def confirm_route(request):
+        data = get_json(request)
+        if not data or "route_details" not in data or "driver_details" not in data:
+            return {"error": "driver_details and route_details required"}, 400
+        # Validate the structure the simulator dereferences up front —
+        # a daemon thread dying on KeyError would 200 then go silent.
+        route = data["route_details"]
+        driver = data["driver_details"]
+        coords = ((route.get("geometry") or {}).get("coordinates"))
+        summary = ((route.get("properties") or {}).get("summary"))
+        if not isinstance(coords, list) or not coords or not isinstance(summary, dict):
+            return {"error": "route_details must carry geometry.coordinates and properties.summary"}, 400
+        if not driver.get("driver_name") or not driver.get("vehicle_type"):
+            return {"error": "driver_details must carry driver_name and vehicle_type"}, 400
+        if "destinations" not in (route.get("properties") or {}):
+            return {"error": "route_details.properties.destinations required"}, 400
+        sim.start_simulation(data, state.bus.publish, state.sim_tick_range)
+        return {"status": "route simulation initialized."}, 200
+
+    @app.route("/api/update_tracker", methods=("POST",))
+    def update_tracker(request):
+        data = get_json(request)
+        if not data:
+            return {"error": "no data provided in the publish request."}, 400
+        try:
+            event = sim.format_sse_data(data)
+        except (KeyError, ValueError) as e:
+            return {"error": f"malformed tracker payload: {e}"}, 400
+        state.bus.publish(str(data.get("route_id")), event)
+        return {"status": "published"}, 200
+
+    @app.route("/api/realtime_feed", methods=("GET",))
+    def realtime_feed(request):
+        channel = request.args.get("channel", "sse")
+        try:
+            max_events = int(request.args["max_events"]) \
+                if "max_events" in request.args else None
+        except ValueError:
+            max_events = None
+        subscription = state.bus.subscribe(channel)
+        return Response(
+            sse_stream(subscription, max_events=max_events),
+            mimetype="text/event-stream",
+            headers={"Cache-Control": "no-cache", "X-Accel-Buffering": "no"},
+        )
+
+    # ── history ────────────────────────────────────────────────────────
+
+    @app.route("/api/history", methods=("GET",))
+    def history(request):
+        try:
+            limit = int(request.args.get("limit", 20))
+        except ValueError:
+            limit = 20
+        limit = max(1, min(limit, 100))
+        try:
+            rows = state.store.list_history(limit)
+        except Exception as e:
+            return {"error": f"history fetch failed: {e}"}, 500
+
+        items = []
+        for rr in rows:
+            res = rr.get("route_results") or []
+            first = res[0] if res else {}
+            stops = rr.get("stops") or {}
+            dest_ids = stops.get("destination_ids") or []
+            items.append({
+                "request_id": rr["id"],
+                "created_at": rr.get("request_time"),
+                "origin_id": rr.get("origin_id"),
+                "dest_count": len(dest_ids),
+                "total_distance": first.get("total_distance"),
+                "total_duration": first.get("total_duration"),
+                "optimized": bool(first.get("optimized_order") or []),
+                "engine": rr.get("engine") or "default",
+                "vehicle_id": rr.get("vehicle_id"),
+                "eta_minutes_ml": first.get("eta_minutes_ml"),
+                "eta_completion_time_ml": first.get("eta_completion_time_ml"),
+            })
+        return {"items": items}, 200
+
+    @app.route("/api/history/<req_id>", methods=("GET",))
+    def history_detail(request, req_id):
+        try:
+            row = state.store.get_request(req_id)
+        except Exception as e:
+            return {"error": f"history fetch failed: {e}"}, 500
+        if row is None:
+            return {"error": "not found"}, 404
+        results = row.get("route_results") or []
+        return {
+            "request": {
+                "id": row["id"],
+                "origin_id": row.get("origin_id"),
+                "stops": row.get("stops") or {},
+                "status": row.get("status"),
+                "request_time": row.get("request_time"),
+                "engine": row.get("engine") or "default",
+                "vehicle_id": row.get("vehicle_id"),
+                "driver_age": row.get("driver_age"),
+            },
+            "result": results[0] if results else None,
+        }, 200
+
+    @app.route("/api/history/<req_id>", methods=("DELETE",))
+    def delete_history(request, req_id):
+        try:
+            deleted = state.store.delete_request(req_id)
+        except Exception as e:
+            return {"error": f"delete failed: {e}"}, 500
+        if not deleted:
+            return {"error": "not found"}, 404
+        return Response("", 204)
+
+    # ── meta ───────────────────────────────────────────────────────────
+
+    @app.route("/api/locations", methods=("GET",))
+    def locations(request):
+        # Laravel parity (``routes/api.php:7-9``): plain array of rows.
+        return locations_table(), 200
+
+    @app.route("/api/ping", methods=("GET",))
+    def ping(request):
+        return {"ok": True, "service": "route-optimizer"}, 200
+
+    @app.route("/api/health", methods=("GET",))
+    def health(request):
+        t0 = time.time()
+        bus_ok = state.bus.ping()
+        bus_res = {"status": "ok" if bus_ok else "error",
+                   "latency_ms": int((time.time() - t0) * 1000),
+                   "backend": state.bus.kind}
+        t0 = time.time()
+        store_ok = state.store.ping()
+        store_res = {"status": "ok" if store_ok else "error",
+                     "latency_ms": int((time.time() - t0) * 1000),
+                     "backend": state.store.kind}
+        # The routing engine is in-process now: report it with a trivial
+        # self-check instead of probing ORS over the internet.
+        engine_res = {"status": "ok" if state.eta is not None else "error",
+                      "latency_ms": 0, "engine": "jax-tpu"}
+        model_res = {"status": "ok" if state.eta.available else "degraded",
+                     **({"error": state.eta.load_error}
+                        if state.eta.load_error else {})}
+
+        parts = (bus_res["status"], store_res["status"], engine_res["status"],
+                 model_res["status"])
+        overall = "ok" if all(s == "ok" for s in parts) else "degraded"
+
+        import jax
+
+        payload = {
+            "backend": True,
+            "checks": {
+                "engine": engine_res,
+                "redis": bus_res,
+                "supabase": store_res,
+                "model": model_res,
+                "tpu": {
+                    "devices": [str(d) for d in jax.devices()],
+                    "batcher": state.eta.stats,
+                    "uptime_s": int(time.time() - state.started),
+                },
+            },
+            "db": store_ok,
+            "osrm": engine_res["status"] in ("ok", "degraded"),
+            "redis": bus_ok,
+            "tiles": True,
+            "status": overall,
+            "version": state.config.serve.version,
+        }
+        return payload, 200  # always 200: degraded-not-down
+
+    return app
+
+
+def _persist(state: ServerState, payload: dict, feature: dict) -> Optional[str]:
+    """Write request+result rows (``Flaskr/routes.py:134-182`` shape)."""
+    meta = payload.get("meta") or {}
+    driver = payload.get("driver_details") or {}
+    req_row = {
+        "origin_id": meta.get("origin_id"),
+        "stops": {
+            "destination_ids": meta.get("destination_ids") or [],
+            "destination_points": payload.get("destination_points") or [],
+        },
+        "status": "completed",
+        "engine": "ml" if payload.get("use_ml_eta") else "default",
+        "vehicle_id": driver.get("driver_name"),
+        "driver_age": driver.get("driver_age"),
+    }
+    request_id = state.store.insert_request(req_row)
+
+    props = (feature or {}).get("properties", {}) or {}
+    summary = props.get("summary", {}) or {}
+    state.store.insert_result({
+        "request_id": request_id,
+        "total_distance": float(summary.get("distance") or 0),
+        "total_duration": float(summary.get("duration") or 0),
+        "optimized_order": props.get("optimized_order") or [],
+        "legs": props.get("segments", []) or [],
+        "geometry": feature.get("geometry") or None,
+        "eta_minutes_ml": props.get("eta_minutes_ml"),
+        "eta_completion_time_ml": props.get("eta_completion_time_ml"),
+    })
+    return request_id

@@ -1,0 +1,162 @@
+"""SSE event bus: per-channel pub/sub feeding ``GET /api/realtime_feed``.
+
+The reference publishes tracker updates through flask-sse → Redis
+(``Flaskr/routes.py:86``, ``__init__.py:25-28``). Redis exists to fan out
+across processes; a single-process server gets identical semantics from an
+in-memory bus. ``RedisBus`` keeps the cross-process path when a
+``REDIS_URL`` is configured and the redis client is importable — the same
+degraded-not-down behavior the reference's health check reports when Redis
+is absent.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional
+
+
+class InMemoryBus:
+    """Per-channel fan-out with bounded subscriber queues."""
+
+    def __init__(self, max_queue: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._subscribers: Dict[str, List[queue.Queue]] = {}
+        self._max_queue = max_queue
+
+    def publish(self, channel: str, data: dict) -> int:
+        with self._lock:
+            subs = list(self._subscribers.get(channel, ()))
+        delivered = 0
+        for q in subs:
+            try:
+                q.put_nowait(data)
+                delivered += 1
+            except queue.Full:
+                # Slow consumer: drop oldest, keep the stream live.
+                try:
+                    q.get_nowait()
+                    q.put_nowait(data)
+                    delivered += 1
+                except (queue.Empty, queue.Full):
+                    pass
+        return delivered
+
+    def subscribe(self, channel: str) -> "Subscription":
+        q: queue.Queue = queue.Queue(maxsize=self._max_queue)
+        with self._lock:
+            self._subscribers.setdefault(channel, []).append(q)
+        return Subscription(self, channel, q)
+
+    def _unsubscribe(self, channel: str, q: queue.Queue) -> None:
+        with self._lock:
+            subs = self._subscribers.get(channel)
+            if subs and q in subs:
+                subs.remove(q)
+                if not subs:
+                    del self._subscribers[channel]
+
+    def ping(self) -> bool:
+        return True
+
+    @property
+    def kind(self) -> str:
+        return "memory"
+
+
+class Subscription:
+    def __init__(self, bus: InMemoryBus, channel: str, q: queue.Queue) -> None:
+        self._bus = bus
+        self.channel = channel
+        self._queue = q
+
+    def get(self, timeout: Optional[float] = None) -> Optional[dict]:
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._bus._unsubscribe(self.channel, self._queue)
+
+    def __enter__(self) -> "Subscription":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RedisBus:
+    """Redis-backed bus with the same interface (used when REDIS_URL is set
+    and the redis client is available — optional dependency)."""
+
+    def __init__(self, url: str) -> None:
+        import redis  # gated import: not in the base environment
+
+        self._redis = redis.Redis.from_url(url, socket_timeout=2,
+                                           socket_connect_timeout=2)
+
+    def publish(self, channel: str, data: dict) -> int:
+        return int(self._redis.publish(channel, json.dumps(data)))
+
+    def subscribe(self, channel: str):
+        pubsub = self._redis.pubsub()
+        pubsub.subscribe(channel)
+        return _RedisSubscription(pubsub)
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._redis.ping())
+        except Exception:
+            return False
+
+    @property
+    def kind(self) -> str:
+        return "redis"
+
+
+class _RedisSubscription:
+    def __init__(self, pubsub) -> None:
+        self._pubsub = pubsub
+
+    def get(self, timeout: Optional[float] = None) -> Optional[dict]:
+        msg = self._pubsub.get_message(ignore_subscribe_messages=True,
+                                       timeout=timeout or 0)
+        if msg and msg.get("type") == "message":
+            return json.loads(msg["data"])
+        return None
+
+    def close(self) -> None:
+        self._pubsub.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def make_bus(redis_url: Optional[str]):
+    if redis_url:
+        try:
+            bus = RedisBus(redis_url)
+            if bus.ping():
+                return bus
+        except Exception:
+            pass
+    return InMemoryBus()
+
+
+def sse_stream(subscription, keepalive_s: float = 15.0,
+               max_events: Optional[int] = None) -> Iterator[bytes]:
+    """Subscription → text/event-stream byte chunks (SSE wire format)."""
+    sent = 0
+    with subscription:
+        while max_events is None or sent < max_events:
+            data = subscription.get(timeout=keepalive_s)
+            if data is None:
+                yield b": keepalive\n\n"
+                continue
+            yield f"data: {json.dumps(data)}\n\n".encode()
+            sent += 1

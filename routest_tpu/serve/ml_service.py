@@ -1,0 +1,219 @@
+"""ETA inference service: request-coalescing dynamic batcher → one jit call.
+
+The reference runs one CPU tree-walk per HTTP request
+(``Flaskr/ml.py:51-53`` — batch size 1, no batching layer at all). The
+10k preds/sec target (BASELINE.json) is won here: concurrent requests
+coalesce into one device batch, padded to a small set of bucket sizes so
+XLA compiles each shape once (SURVEY.md §7.3 item 4).
+
+Failure semantics mirror the reference: a missing/broken model artifact
+makes ``predict`` return ``(None, None)`` and the caller degrades
+gracefully (route still served without ML fields; ``/predict_eta``
+surfaces 503).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from routest_tpu.core.config import ServeConfig
+from routest_tpu.core.mesh import MeshRuntime, pad_rows
+from routest_tpu.data.features import encode_requests
+from routest_tpu.models.eta_mlp import EtaMLP, Params
+from routest_tpu.train.checkpoint import default_model_path, load_model
+
+
+class _Pending:
+    __slots__ = ("rows", "event", "result")
+
+    def __init__(self, rows: np.ndarray) -> None:
+        self.rows = rows
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+
+
+class DynamicBatcher:
+    """Coalesce concurrent scoring requests into bucket-padded device calls.
+
+    Requests enqueue feature rows and block; a flusher drains the queue
+    whenever ``max_batch`` rows are waiting or the oldest request has
+    waited ``max_wait_ms``. Flushing happens on the caller thread that
+    triggers the condition — no dedicated thread, no idle spinning.
+    """
+
+    def __init__(self, score_fn, buckets: Sequence[int], max_batch: int,
+                 max_wait_ms: float, align: int = 1) -> None:
+        self._score = score_fn
+        # ``align`` = mesh data-shard count: every device batch must divide
+        # evenly across the data axis, so bucket sizes round up to multiples.
+        self._align = max(1, align)
+        self._buckets = sorted(
+            {((b + self._align - 1) // self._align) * self._align for b in buckets}
+        )
+        self._max_batch = max_batch
+        self._max_wait = max_wait_ms / 1000.0
+        self._lock = threading.Lock()
+        self._queue: List[_Pending] = []
+        self._queued_rows = 0
+        self._flushing = False
+        self.stats = {"flushes": 0, "rows": 0, "max_batch_seen": 0}
+
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        # oversized: exact shape, rounded up to the shard multiple
+        return ((n + self._align - 1) // self._align) * self._align
+
+    def submit(self, rows: np.ndarray) -> np.ndarray:
+        pending = _Pending(rows)
+        with self._lock:
+            self._queue.append(pending)
+            self._queued_rows += len(rows)
+            should_flush = (self._queued_rows >= self._max_batch
+                            and not self._flushing)
+        if should_flush:
+            self._flush()
+        deadline = time.monotonic() + self._max_wait
+        while True:
+            # Oldest-waiter timeout: whoever wakes first drains the queue.
+            # After the deadline, keep a 1 ms wait in the loop so a flush
+            # in flight on another thread isn't hot-spun against.
+            remaining = deadline - time.monotonic()
+            if pending.event.wait(timeout=max(remaining, 0.001)):
+                break
+            self._flush()
+        assert pending.result is not None
+        return pending.result
+
+    def _flush(self) -> None:
+        with self._lock:
+            if self._flushing or not self._queue:
+                return
+            self._flushing = True
+            batch = self._queue
+            self._queue = []
+            self._queued_rows = 0
+        try:
+            rows = np.concatenate([p.rows for p in batch], axis=0)
+            n = len(rows)
+            preds = np.asarray(self._score(pad_rows(rows, self._bucket(n))))[:n]
+            self.stats["flushes"] += 1
+            self.stats["rows"] += n
+            self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], n)
+            offset = 0
+            for p in batch:
+                p.result = preds[offset: offset + len(p.rows)]
+                offset += len(p.rows)
+                p.event.set()
+        except Exception:
+            for p in batch:
+                p.result = np.full((len(p.rows),), np.nan, np.float32)
+                p.event.set()
+            raise
+        finally:
+            with self._lock:
+                self._flushing = False
+
+
+class EtaService:
+    """Model lifecycle + prediction API for the serving layer."""
+
+    def __init__(self, cfg: Optional[ServeConfig] = None,
+                 model_path: Optional[str] = None,
+                 runtime: Optional[MeshRuntime] = None) -> None:
+        cfg = cfg or ServeConfig()
+        self._cfg = cfg
+        self._runtime = runtime
+        self._model: Optional[EtaMLP] = None
+        self._params: Optional[Params] = None
+        self._error: Optional[str] = None
+        self._load(model_path or default_model_path())
+        self._batcher: Optional[DynamicBatcher] = None
+        if self.available:
+            apply_jit = jax.jit(self._model.apply)
+            # load_model returns host numpy arrays; pin them on device once
+            # or every scoring call re-uploads the whole param tree.
+            if runtime is not None:
+                params = runtime.replicate(self._params)
+
+                def score(x: np.ndarray) -> np.ndarray:
+                    return apply_jit(params, runtime.shard_batch(jax.numpy.asarray(x)))
+            else:
+                params = jax.device_put(self._params)
+
+                def score(x: np.ndarray) -> np.ndarray:
+                    return apply_jit(params, x)
+
+            self._score = score
+            self._batcher = DynamicBatcher(
+                score, cfg.batch_buckets, cfg.max_batch, cfg.max_wait_ms,
+                align=runtime.n_data if runtime is not None else 1,
+            )
+
+    def _load(self, path: str) -> None:
+        try:
+            self._model, self._params = load_model(path)
+        except Exception as e:
+            self._error = f"{type(e).__name__}: {e}"
+
+    @property
+    def available(self) -> bool:
+        return self._model is not None
+
+    @property
+    def load_error(self) -> Optional[str]:
+        return self._error
+
+    def predict_batch(self, rows: np.ndarray) -> Optional[np.ndarray]:
+        if not self.available or self._batcher is None:
+            return None
+        return self._batcher.submit(np.asarray(rows, np.float32))
+
+    def predict_eta_minutes(
+        self, *, weather: str, traffic: str, distance_m: float,
+        pickup_time, driver_age: float = 30.0,
+    ) -> Tuple[Optional[float], Optional[str]]:
+        """Reference-signature single prediction (``Flaskr/ml.py:23``):
+        returns (eta_minutes, completion_iso) or (None, None)."""
+        if not self.available:
+            return None, None
+        if isinstance(pickup_time, str):
+            try:
+                pickup_dt = dt.datetime.fromisoformat(pickup_time)
+            except ValueError:
+                pickup_dt = dt.datetime.now()
+        elif isinstance(pickup_time, dt.datetime):
+            pickup_dt = pickup_time
+        else:
+            pickup_dt = dt.datetime.now()
+
+        rows = encode_requests(
+            weather=[weather], traffic=[traffic],
+            weekday=[pickup_dt.weekday()], hour=[pickup_dt.hour],
+            distance_km=[float(distance_m or 0) / 1000.0],
+            driver_age=[float(driver_age or 30.0)],
+        )
+        try:
+            preds = self.predict_batch(rows)
+        except Exception:
+            return None, None
+        if preds is None or not np.isfinite(preds[0]):
+            return None, None
+        eta_minutes = float(preds[0])
+        eta_ts = (pickup_dt + dt.timedelta(minutes=eta_minutes)).isoformat()
+        return eta_minutes, eta_ts
+
+    @property
+    def stats(self) -> dict:
+        base = {"available": self.available, "error": self._error}
+        if self._batcher is not None:
+            base.update(self._batcher.stats)
+        return base

@@ -1,0 +1,192 @@
+"""Persistence: PostgREST-shaped stores for route requests/results.
+
+Schema follows the Laravel migrations plus the runtime drift the Flask
+service writes (SURVEY.md §2.2): ``route_requests`` (origin_id, stops
+jsonb, status, engine, vehicle_id, driver_age, request_time) and
+``route_results`` (request_id FK-cascade, total_distance, total_duration,
+optimized_order, legs, geometry, eta_minutes_ml, eta_completion_time_ml).
+
+Two implementations behind one interface:
+
+- ``InMemoryStore`` — hermetic default (the generalization of the
+  reference's sqlite-:memory: test trick, SURVEY.md §4); also what makes
+  history work out of the box with no Supabase account.
+- ``PostgRESTStore`` — the reference's runtime path (Supabase service-role
+  writes, embedded-resource selects, FK-cascade delete,
+  ``Flaskr/routes.py:134-182,193-250,386-405``).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import threading
+import uuid
+from typing import Dict, List, Optional, Protocol
+
+
+class Store(Protocol):
+    def insert_request(self, row: Dict) -> str: ...
+    def insert_result(self, row: Dict) -> None: ...
+    def list_history(self, limit: int) -> List[Dict]: ...
+    def get_request(self, req_id: str) -> Optional[Dict]: ...
+    def delete_request(self, req_id: str) -> bool: ...
+    def ping(self) -> bool: ...
+    @property
+    def kind(self) -> str: ...
+
+
+def _now_iso() -> str:
+    return dt.datetime.now(dt.timezone.utc).isoformat()
+
+
+class InMemoryStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._requests: Dict[str, Dict] = {}
+        self._results: Dict[str, List[Dict]] = {}
+
+    def insert_request(self, row: Dict) -> str:
+        req_id = str(uuid.uuid4())
+        with self._lock:
+            self._requests[req_id] = {
+                "id": req_id,
+                "request_time": _now_iso(),
+                **row,
+            }
+        return req_id
+
+    def insert_result(self, row: Dict) -> None:
+        result = {"id": str(uuid.uuid4()), "created_at": _now_iso(), **row}
+        with self._lock:
+            req_id = row.get("request_id")
+            if req_id not in self._requests:
+                raise KeyError(f"route_requests.{req_id} does not exist")
+            self._results.setdefault(req_id, []).append(result)
+
+    def list_history(self, limit: int) -> List[Dict]:
+        with self._lock:
+            rows = sorted(self._requests.values(),
+                          key=lambda r: r["request_time"], reverse=True)[:limit]
+            return [
+                {**r, "route_results": list(self._results.get(r["id"], ()))}
+                for r in rows
+            ]
+
+    def get_request(self, req_id: str) -> Optional[Dict]:
+        with self._lock:
+            r = self._requests.get(req_id)
+            if r is None:
+                return None
+            return {**r, "route_results": list(self._results.get(req_id, ()))}
+
+    def delete_request(self, req_id: str) -> bool:
+        with self._lock:
+            existed = req_id in self._requests
+            self._requests.pop(req_id, None)
+            self._results.pop(req_id, None)  # FK cascade
+            return existed
+
+    def ping(self) -> bool:
+        return True
+
+    @property
+    def kind(self) -> str:
+        return "memory"
+
+
+class PostgRESTStore:
+    """Supabase PostgREST client, request-shape compatible with the
+    reference service."""
+
+    def __init__(self, url: str, service_key: str, timeout: float = 20.0) -> None:
+        import requests  # gated: serving extra
+
+        self._requests_lib = requests
+        self._rest = f"{url.rstrip('/')}/rest/v1"
+        self._headers = {
+            "apikey": service_key,
+            "Authorization": f"Bearer {service_key}",
+            "Content-Type": "application/json",
+            "Prefer": "return=representation",
+        }
+        self._timeout = timeout
+
+    def insert_request(self, row: Dict) -> str:
+        r = self._requests_lib.post(f"{self._rest}/route_requests",
+                                    headers=self._headers, json=row,
+                                    timeout=self._timeout)
+        r.raise_for_status()
+        return r.json()[0]["id"]
+
+    def insert_result(self, row: Dict) -> None:
+        r = self._requests_lib.post(f"{self._rest}/route_results",
+                                    headers=self._headers, json=row,
+                                    timeout=self._timeout)
+        r.raise_for_status()
+
+    _HISTORY_SELECT = (
+        "id,request_time,origin_id,stops,engine,vehicle_id,driver_age,"
+        "route_results(id,total_distance,total_duration,optimized_order,"
+        "created_at,eta_minutes_ml,eta_completion_time_ml)"
+    )
+    _DETAIL_SELECT = (
+        "id,origin_id,stops,status,request_time,engine,vehicle_id,driver_age,"
+        "route_results(id,total_distance,total_duration,optimized_order,legs,"
+        "created_at,eta_minutes_ml,eta_completion_time_ml,geometry)"
+    )
+
+    def list_history(self, limit: int) -> List[Dict]:
+        r = self._requests_lib.get(
+            f"{self._rest}/route_requests", headers=self._headers,
+            params={"select": self._HISTORY_SELECT,
+                    "order": "request_time.desc", "limit": str(limit)},
+            timeout=self._timeout,
+        )
+        r.raise_for_status()
+        return r.json()
+
+    def get_request(self, req_id: str) -> Optional[Dict]:
+        r = self._requests_lib.get(
+            f"{self._rest}/route_requests", headers=self._headers,
+            params={"select": self._DETAIL_SELECT, "id": f"eq.{req_id}",
+                    "limit": "1"},
+            timeout=self._timeout,
+        )
+        r.raise_for_status()
+        rows = r.json()
+        return rows[0] if rows else None
+
+    def delete_request(self, req_id: str) -> bool:
+        # Keep Prefer: return=representation so PostgREST returns the
+        # deleted rows — a 204/empty body means nothing matched, which must
+        # surface as not-found (parity with InMemoryStore).
+        r = self._requests_lib.delete(
+            f"{self._rest}/route_requests", headers=self._headers,
+            params={"id": f"eq.{req_id}"}, timeout=10,
+        )
+        if r.status_code not in (200, 204):
+            return False
+        try:
+            return bool(r.json())
+        except ValueError:
+            return False
+
+    def ping(self) -> bool:
+        try:
+            r = self._requests_lib.get(
+                f"{self._rest}/route_requests", headers=self._headers,
+                params={"select": "id", "limit": "1"}, timeout=3,
+            )
+            return 200 <= r.status_code < 300
+        except Exception:
+            return False
+
+    @property
+    def kind(self) -> str:
+        return "postgrest"
+
+
+def make_store(supabase_url: Optional[str], service_key: Optional[str]) -> Store:
+    if supabase_url and service_key:
+        return PostgRESTStore(supabase_url, service_key)
+    return InMemoryStore()

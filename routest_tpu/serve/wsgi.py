@@ -1,0 +1,108 @@
+"""Minimal WSGI micro-framework (werkzeug-based).
+
+The reference serves its API with Flask + flask-cors + flask-sse. This
+framework provides the same surface area in ~150 lines: method+path
+routing with ``<param>`` captures, JSON request/response helpers, the
+reference's CORS policy (localhost:3000 + ``*.vercel.app``,
+``Flaskr/__init__.py:14-23``), and streaming responses for SSE.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from werkzeug.wrappers import Request, Response
+
+_PARAM_RE = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
+
+# Origins the reference allows (Flaskr/__init__.py CORS config).
+_ALLOWED_ORIGIN_RE = re.compile(
+    r"^https?://localhost:3000$|^https?://127\.0\.0\.1:3000$|^https://[a-z0-9-]+\.vercel\.app$"
+)
+
+
+def json_response(payload: Any, status: int = 200,
+                  headers: Optional[Dict[str, str]] = None) -> Response:
+    return Response(
+        json.dumps(payload), status=status, mimetype="application/json",
+        headers=headers,
+    )
+
+
+class App:
+    """Route table + WSGI callable."""
+
+    def __init__(self) -> None:
+        self._routes: List[Tuple[str, re.Pattern, Callable]] = []
+
+    def route(self, path: str, methods: Tuple[str, ...] = ("GET",)):
+        pattern = re.compile(
+            "^" + _PARAM_RE.sub(r"(?P<\1>[^/]+)", path) + "$"
+        )
+
+        def register(fn: Callable) -> Callable:
+            for m in methods:
+                self._routes.append((m.upper(), pattern, fn))
+            return fn
+
+        return register
+
+    def _match(self, method: str, path: str):
+        allowed: List[str] = []
+        for m, pattern, fn in self._routes:
+            match = pattern.match(path)
+            if match:
+                if m == method:
+                    return fn, match.groupdict(), None
+                allowed.append(m)
+        return None, {}, allowed
+
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        try:
+            response = self._dispatch(request)
+        except Exception as e:  # pragma: no cover - last-resort handler
+            response = json_response({"error": f"internal error: {e}"}, 500)
+        self._apply_cors(request, response)
+        return response(environ, start_response)
+
+    def _dispatch(self, request: Request) -> Response:
+        if request.method == "OPTIONS":
+            return Response("", 204)
+        fn, kwargs, allowed = self._match(request.method, request.path)
+        if fn is None:
+            if allowed:
+                return json_response({"error": "method not allowed"}, 405,
+                                     {"Allow": ", ".join(sorted(set(allowed)))})
+            return json_response({"error": "not found"}, 404)
+        result = fn(request, **kwargs)
+        if isinstance(result, Response):
+            return result
+        if isinstance(result, tuple):
+            payload, status = result
+            return json_response(payload, status)
+        return json_response(result)
+
+    @staticmethod
+    def _apply_cors(request: Request, response: Response) -> None:
+        origin = request.headers.get("Origin", "")
+        if origin and _ALLOWED_ORIGIN_RE.match(origin):
+            response.headers["Access-Control-Allow-Origin"] = origin
+            response.headers["Vary"] = "Origin"
+            response.headers["Access-Control-Allow-Headers"] = "Content-Type, Authorization"
+            response.headers["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
+
+
+def get_json(request: Request, silent: bool = True) -> Optional[dict]:
+    """Parse the request body as JSON (mirrors flask's get_json(silent=True))."""
+    try:
+        raw = request.get_data(as_text=True)
+        if not raw:
+            return None
+        return json.loads(raw)
+    except (ValueError, UnicodeDecodeError):
+        if silent:
+            return None
+        raise

@@ -24,7 +24,6 @@ from flax import serialization
 
 from routest_tpu.models.eta_mlp import EtaMLP, Params
 
-_HEADER_KEY = b"__routest_tpu_header__"
 MAGIC = b"RTPU1\n"
 
 

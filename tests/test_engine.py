@@ -1,0 +1,121 @@
+"""Routing-engine wire-ABI tests: the GeoJSON Feature shape the frontend
+consumes (SURVEY.md Appendix A, ``sample_get_route_response.json`` schema)."""
+
+import numpy as np
+
+from routest_tpu.data.locations import SEED_LOCATIONS
+from routest_tpu.optimize.engine import ENGINE_TAG, optimize_route
+
+
+def _pt(i, payload=1):
+    name, lat, lon = SEED_LOCATIONS[i]
+    return {"lat": lat, "lon": lon, "payload": payload, "name": name}
+
+
+def _req(n_dests=3, **driver):
+    details = {"driver_name": "Kai", "vehicle_type": "car",
+               "vehicle_capacity": 9999, "maximum_distance": 100_000.0}
+    details.update(driver)
+    return {
+        "source_point": {"lat": SEED_LOCATIONS[0][1], "lon": SEED_LOCATIONS[0][2]},
+        "destination_points": [_pt(i + 1) for i in range(n_dests)],
+        "driver_details": details,
+    }
+
+
+def test_multi_stop_feature_shape():
+    feature = optimize_route(_req(4))
+    assert feature["type"] == "Feature"
+    assert feature["geometry"]["type"] == "LineString"
+    assert len(feature["bbox"]) == 4
+    props = feature["properties"]
+    assert sorted(props["optimized_order"]) == [0, 1, 2, 3]
+    assert props["engine"] == ENGINE_TAG
+    assert props["driver_name"] == "Kai"
+    assert props["vehicle_type"] == "car"
+    summary = props["summary"]
+    assert summary["distance"] > 0 and summary["duration"] > 0
+    assert summary["trips"] >= 1
+    assert len(props["segments"]) >= 1
+    step = props["segments"][0]["steps"][0]
+    assert {"distance", "duration", "type", "instruction", "name", "way_points"} <= set(step)
+    # geometry coordinates are [lon, lat] within Metro Manila bounds
+    lon, lat = feature["geometry"]["coordinates"][0]
+    assert 120 < lon < 122 and 14 < lat < 15
+
+
+def test_point_to_point_shape():
+    feature = optimize_route(_req(1))
+    props = feature["properties"]
+    assert props["optimized_order"] == [0]
+    assert "trips" not in props["summary"]  # reference p2p summary has no trips
+    assert props["engine"] == ENGINE_TAG
+    assert len(props["segments"]) == 1
+
+
+def test_point_to_point_feasibility_errors():
+    r = _req(1, vehicle_capacity=0)
+    r["destination_points"][0]["payload"] = 5
+    out = optimize_route(r)
+    assert out["error"] == "payload exceeds vehicle capacity"
+
+    r = _req(1, vehicle_capacity=0, maximum_distance=1.0)
+    r["destination_points"][0]["payload"] = 5
+    out = optimize_route(r)
+    assert out["error"] == "payload exceeds vehicle capacity | route distance exceeds maximum_distance"
+
+
+def test_no_destinations_error():
+    assert optimize_route({}) == {"error": "no destination points specified."}
+    assert optimize_route({"source_point": {"lat": 0, "lon": 0},
+                           "destination_points": []}) \
+        == {"error": "no destination points specified."}
+
+
+def test_malformed_coordinates_error():
+    r = _req(2)
+    r["destination_points"][0] = {"lat": "not-a-number", "lon": 121.0}
+    out = optimize_route(r)
+    assert "invalid coordinates" in out["error"]
+
+
+def test_capacity_splits_trips():
+    r = _req(6)
+    for p in r["destination_points"]:
+        p["payload"] = 10
+    r["driver_details"]["vehicle_capacity"] = 20  # 2 stops per trip
+    feature = optimize_route(r)
+    assert feature["properties"]["summary"]["trips"] == 3
+    assert sorted(feature["properties"]["optimized_order"]) == list(range(6))
+
+
+def test_unroutable_multi_stop_errors():
+    r = _req(3)
+    r["destination_points"][1]["payload"] = 10_000
+    r["driver_details"]["vehicle_capacity"] = 50
+    out = optimize_route(r)
+    assert "not routable" in out["error"] and "1" in out["error"]
+
+
+def test_distances_are_road_scaled_haversine():
+    """driving-car road factor 1.42 over the warehouse→Megamall leg."""
+    from routest_tpu.data.geo import haversine_m
+
+    r = _req(1)
+    feature = optimize_route(r)
+    gc = float(haversine_m(SEED_LOCATIONS[0][1], SEED_LOCATIONS[0][2],
+                           SEED_LOCATIONS[1][1], SEED_LOCATIONS[1][2]))
+    got = feature["properties"]["summary"]["distance"]
+    assert abs(got - gc * 1.42) / got < 0.01
+
+
+def test_missing_source_point_is_clean_error():
+    out = optimize_route({"destination_points": [{"lat": 14.5, "lon": 121.0}]})
+    assert out == {"error": "no source point specified."}
+
+
+def test_non_numeric_payload_is_clean_error():
+    r = _req(2)
+    r["destination_points"][0]["payload"] = "heavy"
+    out = optimize_route(r)
+    assert "payload" in out["error"]

@@ -1,0 +1,268 @@
+"""Serving-layer ABI tests via the WSGI test client — hermetic (in-memory
+store + bus, tiny model artifact). Response shapes per SURVEY.md
+Appendix A."""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from routest_tpu.core.config import Config, ServeConfig
+from routest_tpu.core.dtypes import F32_POLICY
+from routest_tpu.data.locations import SEED_LOCATIONS
+from routest_tpu.models.eta_mlp import EtaMLP
+from routest_tpu.serve.app import create_app
+from routest_tpu.serve.ml_service import EtaService
+from routest_tpu.train.checkpoint import save_model
+
+
+@pytest.fixture(scope="module")
+def model_artifact(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("model") / "eta.msgpack")
+    model = EtaMLP(hidden=(16, 16), policy=F32_POLICY)
+    params = model.init(jax.random.PRNGKey(0))
+    save_model(path, model, params)
+    return path
+
+
+@pytest.fixture(scope="module")
+def app(model_artifact):
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    return create_app(Config(), eta_service=eta, sim_tick_range=(0.001, 0.002))
+
+
+@pytest.fixture(scope="module")
+def client(app):
+    return Client(app)
+
+
+def _route_payload(n=3, use_ml=False):
+    dests = [
+        {"lat": SEED_LOCATIONS[i + 1][1], "lon": SEED_LOCATIONS[i + 1][2], "payload": 1}
+        for i in range(n)
+    ]
+    body = {
+        "source_point": {"lat": SEED_LOCATIONS[0][1], "lon": SEED_LOCATIONS[0][2]},
+        "destination_points": dests,
+        "driver_details": {"driver_name": "Kai", "vehicle_type": "car",
+                           "vehicle_capacity": 9999, "maximum_distance": 100000,
+                           "driver_age": 33},
+        "meta": {"origin_id": "o-1", "destination_ids": [f"d-{i}" for i in range(n)]},
+    }
+    if use_ml:
+        body["use_ml_eta"] = True
+        body["context"] = {"weather": "Sunny", "traffic": "Medium"}
+    return body
+
+
+def test_ping(client):
+    r = client.get("/api/ping")
+    assert r.status_code == 200
+    assert r.get_json() == {"ok": True, "service": "route-optimizer"}
+
+
+def test_health_shape_and_always_200(client):
+    r = client.get("/api/health")
+    assert r.status_code == 200
+    body = r.get_json()
+    assert {"backend", "checks", "db", "osrm", "redis", "tiles", "status"} <= set(body)
+    assert {"engine", "redis", "supabase", "model", "tpu"} <= set(body["checks"])
+    assert body["status"] in ("ok", "degraded")
+    assert body["checks"]["tpu"]["devices"]
+
+
+def test_locations_laravel_shape(client):
+    r = client.get("/api/locations")
+    rows = r.get_json()
+    assert len(rows) == 21
+    assert {"id", "name", "latitude", "longitude", "created_at"} <= set(rows[0])
+    assert rows[0]["name"] == "Main Warehouse - Mandaluyong"
+
+
+def test_predict_eta(client):
+    r = client.post("/api/predict_eta", json={
+        "summary": {"distance": 6983.0}, "driver_age": 40,
+        "weather": "Stormy", "traffic": "Jam",
+        "pickup_time": "2026-07-29T18:00:00",
+    })
+    assert r.status_code == 200
+    body = r.get_json()
+    assert body["eta_minutes_ml"] > 0
+    assert body["eta_completion_time_ml"].startswith("2026-07-29T")
+
+
+def test_predict_eta_model_unavailable(model_artifact):
+    eta = EtaService(ServeConfig(), model_path="/nonexistent/model.msgpack")
+    app = create_app(Config(), eta_service=eta)
+    client = Client(app)
+    r = client.post("/api/predict_eta", json={"summary": {"distance": 1000}})
+    assert r.status_code == 503
+    assert r.get_json() == {"error": "model unavailable"}
+    # health degrades but stays 200
+    h = client.get("/api/health")
+    assert h.status_code == 200
+    assert h.get_json()["status"] == "degraded"
+
+
+def test_request_route_shape(client):
+    r = client.post("/api/request_route", json=_route_payload(2))
+    assert r.status_code == 200
+    feature = r.get_json()
+    assert feature["type"] == "Feature"
+    assert sorted(feature["properties"]["optimized_order"]) == [0, 1]
+
+
+def test_request_route_error_codes(client):
+    assert client.post("/api/request_route", json={}).status_code == 400
+    r = client.post("/api/request_route", data="not json at all",
+                    content_type="application/json")
+    assert r.status_code == 400
+
+
+def test_optimize_route_ml_and_history_roundtrip(client):
+    r = client.post("/api/optimize_route", json=_route_payload(3, use_ml=True))
+    assert r.status_code == 200
+    props = r.get_json()["properties"]
+    assert props["saved"] is True
+    assert props["eta_minutes_ml"] > 0
+    req_id = props["request_id"]
+
+    # list
+    items = client.get("/api/history?limit=5").get_json()["items"]
+    assert any(i["request_id"] == req_id for i in items)
+    mine = next(i for i in items if i["request_id"] == req_id)
+    assert mine["engine"] == "ml"
+    assert mine["dest_count"] == 3
+    assert mine["optimized"] is True
+    assert mine["eta_minutes_ml"] == props["eta_minutes_ml"]
+
+    # detail
+    detail = client.get(f"/api/history/{req_id}").get_json()
+    assert detail["request"]["id"] == req_id
+    assert detail["request"]["vehicle_id"] == "Kai"
+    assert detail["result"]["geometry"]["type"] == "LineString"
+    assert detail["result"]["total_distance"] > 0
+
+    # delete (FK cascade) then 404
+    assert client.delete(f"/api/history/{req_id}").status_code == 204
+    assert client.get(f"/api/history/{req_id}").status_code == 404
+    assert client.delete(f"/api/history/{req_id}").status_code == 404
+
+
+def test_history_limit_clamped(client):
+    for _ in range(3):
+        client.post("/api/optimize_route", json=_route_payload(1))
+    r = client.get("/api/history?limit=99999")
+    assert r.status_code == 200
+    r = client.get("/api/history?limit=not-a-number")
+    assert r.status_code == 200
+
+
+def test_update_tracker_and_sse_feed(app, client):
+    payload = {
+        "route_id": "driver-7",
+        "route": [[121.0, 14.5], [121.01, 14.51]],
+        "destinations": [{"lat": 14.51, "lon": 121.01}],
+        "driver_name": "driver-7",
+        "vehicle_type": "car",
+        "duration": 600.0,
+        "distance": 5000.0,
+        "trips": 1,
+        "pickup_time": "2026-07-29T08:00:00",
+    }
+    # subscribe first, then publish from another thread
+    results = {}
+
+    def reader():
+        r = client.get("/api/realtime_feed?channel=driver-7&max_events=1")
+        results["body"] = r.get_data(as_text=True)
+        results["ct"] = r.headers["Content-Type"]
+
+    t = threading.Thread(target=reader)
+    t.start()
+    import time
+
+    time.sleep(0.2)
+    r = client.post("/api/update_tracker", json=payload)
+    assert r.status_code == 200
+    assert r.get_json() == {"status": "published"}
+    t.join(timeout=10)
+    assert "text/event-stream" in results["ct"]
+    event = json.loads(results["body"].split("data: ", 1)[1].split("\n\n")[0])
+    assert event["remaining_routes"] == payload["route"]
+    assert event["assigned_driver"] == "driver-7"
+    assert event["overall_estimated_completion_time"] == "2026-07-29T08:10:00"
+
+
+def test_update_tracker_malformed(client):
+    assert client.post("/api/update_tracker", json=None).status_code == 400
+    r = client.post("/api/update_tracker", json={"route_id": "x"})
+    assert r.status_code == 400
+    assert "malformed" in r.get_json()["error"]
+
+
+def test_confirm_route_runs_simulation(app, client):
+    feature = client.post("/api/request_route", json=_route_payload(1)).get_json()
+    results = {}
+
+    def reader():
+        r = client.get("/api/realtime_feed?channel=Sim&max_events=2")
+        results["events"] = r.get_data(as_text=True).count("data: ")
+
+    t = threading.Thread(target=reader)
+    t.start()
+    import time
+
+    time.sleep(0.2)
+    r = client.post("/api/confirm_route", json={
+        "driver_details": {"driver_name": "Sim", "vehicle_type": "car"},
+        "route_details": feature,
+    })
+    assert r.status_code == 200
+    assert r.get_json()["status"] == "route simulation initialized."
+    t.join(timeout=15)
+    assert results["events"] == 2
+
+
+def test_confirm_route_missing_fields(client):
+    assert client.post("/api/confirm_route", json={}).status_code == 400
+
+
+def test_cors_headers(client):
+    r = client.get("/api/ping", headers={"Origin": "http://localhost:3000"})
+    assert r.headers.get("Access-Control-Allow-Origin") == "http://localhost:3000"
+    r = client.get("/api/ping", headers={"Origin": "https://evil.example.com"})
+    assert "Access-Control-Allow-Origin" not in r.headers
+    r = client.get("/api/ping", headers={"Origin": "https://my-app.vercel.app"})
+    assert r.headers.get("Access-Control-Allow-Origin") == "https://my-app.vercel.app"
+
+
+def test_method_not_allowed(client):
+    r = client.get("/api/predict_eta")
+    assert r.status_code == 405
+    assert "POST" in r.headers["Allow"]
+
+
+def test_unknown_route_404(client):
+    assert client.get("/api/nope").status_code == 404
+
+
+def test_confirm_route_malformed_structures_rejected(client):
+    r = client.post("/api/confirm_route", json={
+        "driver_details": {}, "route_details": {}})
+    assert r.status_code == 400
+    r = client.post("/api/confirm_route", json={
+        "driver_details": {"driver_name": "X", "vehicle_type": "car"},
+        "route_details": {"geometry": {"coordinates": []},
+                          "properties": {"summary": {}}}})
+    assert r.status_code == 400
+
+
+def test_missing_source_point_400(client):
+    r = client.post("/api/request_route",
+                    json={"destination_points": [{"lat": 14.5, "lon": 121.0}]})
+    assert r.status_code == 400
+    assert "source point" in r.get_json()["error"]

@@ -1,0 +1,103 @@
+"""VRP parity vs an independent python oracle of the documented greedy
+semantics (SURVEY.md §2.1 "Route optimizer" row / §7.3 item 3):
+origin-sorted candidate scan, capacity + (leg + return ≤ max_distance)
+acceptance, only the leg accumulates, multi-trip spill."""
+
+import numpy as np
+import pytest
+
+from routest_tpu.optimize.vrp import greedy_vrp_batch, solve_host
+
+
+def oracle(dist, demands, cap, maxd):
+    n = dist.shape[0] - 1
+    unvisited = [i for i in range(n)
+                 if demands[i] <= cap and dist[0, i + 1] + dist[i + 1, 0] <= maxd]
+    scan = sorted(range(n), key=lambda i: dist[0, i + 1])
+    trips = []
+    while unvisited:
+        current, load, tdist, trip = 0, 0.0, 0.0, []
+        for j in scan:
+            if j not in unvisited:
+                continue
+            node = j + 1
+            if load + demands[j] <= cap and tdist + dist[current, node] + dist[node, 0] <= maxd:
+                trip.append(j)
+                load += demands[j]
+                tdist += dist[current, node]
+                current = node
+        for j in trip:
+            unvisited.remove(j)
+        trips.append(trip)
+    return trips
+
+
+def random_problem(rng, n):
+    pts = rng.uniform(0, 100, size=(n + 1, 2))
+    dist = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1).astype(np.float32)
+    demands = rng.uniform(0, 10, size=n).astype(np.float32)
+    return dist, demands
+
+
+@pytest.mark.parametrize("n,cap,maxd", [
+    (5, 1e12, 1e12),       # unconstrained single trip
+    (8, 15.0, 1e12),       # capacity-bound multi-trip
+    (8, 1e12, 260.0),      # range-bound multi-trip
+    (10, 18.0, 300.0),     # both constraints
+])
+def test_matches_oracle(rng, n, cap, maxd):
+    for trial in range(5):
+        dist, demands = random_problem(rng, n)
+        expected = oracle(dist, demands, cap, maxd)
+        got = solve_host(dist, demands, cap, maxd)
+        assert got["trips"] == expected
+        flat = [j for t in expected for j in t]
+        assert got["optimized_order"] == flat
+        assert got["n_trips"] == len(expected)
+
+
+def test_unroutable_stops_reported(rng):
+    dist, demands = random_problem(rng, 6)
+    demands[2] = 1000.0  # exceeds any reasonable capacity
+    got = solve_host(dist, demands, capacity=50.0, max_distance=1e12)
+    assert 2 in got["unroutable"]
+    assert 2 not in got["optimized_order"]
+    # all other stops still routed
+    assert sorted(got["optimized_order"]) == [0, 1, 3, 4, 5]
+
+
+def test_far_stop_unroutable(rng):
+    dist, demands = random_problem(rng, 4)
+    dist[0, 3] = dist[3, 0] = 1e6
+    got = solve_host(dist, demands, capacity=1e12, max_distance=500.0)
+    assert 2 in got["unroutable"]  # destination index 2 == node 3
+
+
+def test_batched_solve_matches_host(rng):
+    import jax.numpy as jnp
+
+    problems = [random_problem(rng, 7) for _ in range(6)]
+    dists = np.stack([p[0] for p in problems])
+    demands = np.stack([p[1] for p in problems])
+    caps = np.full(6, 20.0, np.float32)
+    maxds = np.full(6, 400.0, np.float32)
+    sols = greedy_vrp_batch(
+        jnp.asarray(dists), jnp.asarray(demands), jnp.asarray(caps), jnp.asarray(maxds)
+    )
+    for b in range(6):
+        single = solve_host(dists[b], demands[b], 20.0, 400.0)
+        n_routed = int(sols.n_routed[b])
+        assert [int(x) for x in np.asarray(sols.order[b])[:n_routed]] \
+            == single["optimized_order"]
+        assert int(sols.n_trips[b]) == single["n_trips"]
+
+
+def test_empty_after_masking_terminates():
+    """All stops unroutable must not hang (the reference would spin)."""
+    dist = np.full((4, 4), 10.0, np.float32)
+    np.fill_diagonal(dist, 0.0)
+    demands = np.full(3, 99.0, np.float32)
+    got = solve_host(dist, demands, capacity=1.0, max_distance=1e12)
+    assert got["trips"] == []
+    assert got["optimized_order"] == []
+    assert got["unroutable"] == [0, 1, 2]
