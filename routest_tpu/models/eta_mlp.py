@@ -2,21 +2,33 @@
 
 Replaces the reference's pickled XGBoost booster (``Flaskr/ml.py`` —
 batch-size-1 CPU tree walks) with a model whose inference is pure MXU
-matmuls: (B,12)→(B,H)→…→(B,1) in bfloat16, trivially batched and sharded
-over the mesh data axis. SURVEY.md §7.3 item 2 motivates the MLP-first
-choice (a tensorized tree-ensemble is the planned model-zoo alternative
-for strict parity with tree models).
+matmuls, trivially batched and sharded over the mesh data axis.
+SURVEY.md §7.3 item 2 motivates the MLP-first choice (``models/gbdt.py``
+is the tensorized tree-ensemble alternative for tree-model parity).
+
+The external contract stays the reference's 12 features (Appendix B), but
+internally the model expands them into TPU-friendly bases and applies a
+physical inductive bias:
+
+- ``weekday``/``hour`` scalars → one-hots (7 + 24): travel-time structure
+  over hours (rush peaks, night discount) is sharp and non-monotone —
+  one-hot bases capture it where a scalar input forces the net to carve
+  step functions out of gelus;
+- two heads: predicted **pace** (min/km) and **overhead** (min), combined
+  as ``eta = pace · distance + overhead`` — ETAs are near-affine in
+  distance with context-dependent slope, so the net only has to learn the
+  slope/intercept surfaces.
 
 Parameters are a plain pytree (dict), so pjit/optax/orbax all apply
-directly. A feature normalizer (mean/std fitted on the training set) is
-stored inside the params pytree and applied (with stop_gradient) in
-``apply`` — serving can never skew from training-time normalization.
+directly. The feature normalizer (training-set mean/std for the scalar
+columns) lives inside the params pytree and is applied under
+stop_gradient — serving can never skew from training-time normalization.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +38,12 @@ from routest_tpu.core.dtypes import DEFAULT_POLICY, Policy
 from routest_tpu.data.features import N_FEATURES
 
 Params = Dict
+
+_N_HOURS = 24
+_N_WEEKDAYS = 7
+# internal width: weather(4) + traffic(4) + weekday_oh(7) + hour_oh(24)
+# + [dist_norm, log_dist, age_norm]
+_INTERNAL_FEATURES = 4 + 4 + _N_WEEKDAYS + _N_HOURS + 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,9 +62,9 @@ class EtaMLP:
     def init(self, key: jax.Array,
              norm_mean: Optional[np.ndarray] = None,
              norm_std: Optional[np.ndarray] = None) -> Params:
-        dims = (self.n_features,) + tuple(self.hidden) + (1,)
+        dims = (_INTERNAL_FEATURES,) + tuple(self.hidden) + (2,)  # pace, overhead
         params: Params = {"layers": []}
-        for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        for d_in, d_out in zip(dims[:-1], dims[1:]):
             key, sub = jax.random.split(key)
             scale = jnp.sqrt(2.0 / d_in)
             params["layers"].append(
@@ -57,9 +75,10 @@ class EtaMLP:
             )
         mean = np.zeros((self.n_features,), np.float32) if norm_mean is None else norm_mean
         std = np.ones((self.n_features,), np.float32) if norm_std is None else norm_std
-        # Constant columns (e.g. a one-hot category absent from the training
-        # set) get std≈0; normalize them with identity scale instead of
-        # exploding a future non-zero value by 1/ε.
+        # Stats are stored for all 12 ABI columns (stable artifact shape) but
+        # ``_expand`` only consumes indices 10-11 (distance, age) — the
+        # categorical/ordinal columns become one-hots instead. The std floor
+        # guards constant columns (e.g. all-same driver_age) from 1/ε blowup.
         std = np.where(np.asarray(std) < 1e-3, 1.0, std)
         params["norm"] = {
             "mean": jnp.asarray(mean, self.policy.param_dtype),
@@ -67,11 +86,32 @@ class EtaMLP:
         }
         return params
 
+    def _expand(self, params: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """ABI features (B,12) → internal bases (B,42) + distance_km (B,)."""
+        norm = jax.lax.stop_gradient(params["norm"])
+        cat = x[..., 0:8]
+        weekday = x[..., 8].astype(jnp.int32)
+        hour = x[..., 9].astype(jnp.int32)
+        # Clamp distance once: a negative distance from a malformed request
+        # must not produce a negative ETA downstream.
+        dist_km = jnp.maximum(x[..., 10], 0.0)
+        age = x[..., 11]
+        wd_oh = jax.nn.one_hot(weekday, _N_WEEKDAYS, dtype=x.dtype)
+        hr_oh = jax.nn.one_hot(hour, _N_HOURS, dtype=x.dtype)
+        dist_n = (dist_km - norm["mean"][10]) / norm["std"][10]
+        age_n = (age - norm["mean"][11]) / norm["std"][11]
+        log_dist = jnp.log1p(dist_km)
+        feats = jnp.concatenate(
+            [cat, wd_oh, hr_oh,
+             dist_n[..., None], log_dist[..., None], age_n[..., None]],
+            axis=-1,
+        )
+        return feats, dist_km
+
     def apply(self, params: Params, x: jax.Array) -> jax.Array:
-        """(B, 12) features → (B,) ETA minutes. bf16 compute, f32 out."""
-        norm = params["norm"]
-        x = (x - jax.lax.stop_gradient(norm["mean"])) / jax.lax.stop_gradient(norm["std"])
-        h = x.astype(self.policy.compute_dtype)
+        """(B, 12) ABI features → (B,) ETA minutes. bf16 trunk, f32 out."""
+        feats, dist_km = self._expand(params, x)
+        h = feats.astype(self.policy.compute_dtype)
         layers = params["layers"]
         for layer in layers[:-1]:
             w = layer["w"].astype(self.policy.compute_dtype)
@@ -81,10 +121,10 @@ class EtaMLP:
         out = h @ last["w"].astype(self.policy.compute_dtype) + last["b"].astype(
             self.policy.compute_dtype
         )
-        # Softplus keeps ETA strictly positive without clipping gradients the
-        # way relu-at-output would.
-        eta = jax.nn.softplus(out[..., 0].astype(self.policy.output_dtype))
-        return eta
+        out = out.astype(self.policy.output_dtype)
+        pace = jax.nn.softplus(out[..., 0])       # min/km, positive
+        overhead = jax.nn.softplus(out[..., 1])   # min, positive
+        return pace * dist_km.astype(self.policy.output_dtype) + overhead
 
 
 def fit_normalizer(features: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,109 @@
+"""Tensorized GBDT inference: tree ensembles as fused TPU gather chains.
+
+The reference's production model is a pickled XGBoost regressor
+(``xgb_eta_model.pkl``, ``Flaskr/ml.py``) walked one row at a time on
+CPU. Trees don't map onto the MXU, but they map fine onto the VPU as
+data-parallel gathers (oblivious-tree style — SURVEY.md §7.3 item 2b):
+
+- the fitted ensemble (sklearn HistGradientBoosting — the CPU-baseline
+  model family) is exported once into padded arrays
+  ``feature/threshold/left/right/value/is_leaf`` of shape (T, max_nodes);
+- inference keeps a (B, T) cursor of current node per (row, tree) and
+  runs ``max_depth`` rounds of ``cursor = select(x[f] <= thr, left,
+  right)``; leaves self-loop, so over-iterating is harmless;
+- prediction = baseline + Σ_t leaf value — one jit, batched over rows,
+  shardable over the mesh data axis like any other model here.
+
+This gives exact parity with the CPU baseline model (same trees, same
+splits) at TPU batch throughput — the strict-parity alternative to the
+MLP when "the same model class as the reference" matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class GBDT:
+    """Static config for a tensorized tree ensemble."""
+
+    n_trees: int
+    max_nodes: int
+    max_depth: int
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        """(B, F) float32 features → (B,) predictions."""
+        feature = params["feature"]      # (T, N) int32
+        threshold = params["threshold"]  # (T, N) f32
+        left = params["left"]            # (T, N) int32
+        right = params["right"]          # (T, N) int32
+        value = params["value"]          # (T, N) f32
+        t_idx = jnp.arange(self.n_trees)[None, :]  # (1, T)
+
+        cursor = jnp.zeros((x.shape[0], self.n_trees), jnp.int32)
+
+        missing_left = params["missing_left"]  # (T, N) bool
+
+        def descend(_, cur):
+            f = feature[t_idx, cur]                       # (B, T)
+            thr = threshold[t_idx, cur]
+            xv = jnp.take_along_axis(x, f.reshape(x.shape[0], -1), axis=1)
+            xv = xv.reshape(cur.shape)
+            # sklearn routes missing (NaN) values per-node via
+            # missing_go_to_left; plain `NaN <= thr` would always go right.
+            go_left = jnp.where(jnp.isnan(xv), missing_left[t_idx, cur],
+                                xv <= thr)
+            nxt = jnp.where(go_left, left[t_idx, cur], right[t_idx, cur])
+            return nxt  # leaves self-loop (left == right == own index)
+
+        cursor = jax.lax.fori_loop(0, self.max_depth, descend, cursor)
+        leaf_values = value[t_idx, cursor]                # (B, T)
+        return params["baseline"] + leaf_values.sum(axis=1)
+
+
+def from_sklearn(model) -> Tuple[GBDT, Params]:
+    """Export a fitted sklearn HistGradientBoostingRegressor."""
+    predictors = [p[0] for p in model._predictors]
+    n_trees = len(predictors)
+    max_nodes = max(len(p.nodes) for p in predictors)
+    max_depth = int(max(p.nodes["depth"].max() for p in predictors)) + 1
+
+    feature = np.zeros((n_trees, max_nodes), np.int32)
+    threshold = np.full((n_trees, max_nodes), np.inf, np.float32)
+    left = np.zeros((n_trees, max_nodes), np.int32)
+    right = np.zeros((n_trees, max_nodes), np.int32)
+    value = np.zeros((n_trees, max_nodes), np.float32)
+    missing_left = np.zeros((n_trees, max_nodes), bool)
+
+    for t, p in enumerate(predictors):
+        nodes = p.nodes
+        n = len(nodes)
+        is_leaf = nodes["is_leaf"].astype(bool)
+        feature[t, :n] = np.where(is_leaf, 0, nodes["feature_idx"])
+        threshold[t, :n] = np.where(is_leaf, np.inf, nodes["num_threshold"])
+        idx = np.arange(n, dtype=np.int32)
+        # leaves self-loop so extra descent rounds are no-ops
+        left[t, :n] = np.where(is_leaf, idx, nodes["left"])
+        right[t, :n] = np.where(is_leaf, idx, nodes["right"])
+        value[t, :n] = np.where(is_leaf, nodes["value"], 0.0)
+        missing_left[t, :n] = nodes["missing_go_to_left"].astype(bool)
+
+    params: Params = {
+        "feature": jnp.asarray(feature),
+        "threshold": jnp.asarray(threshold),
+        "left": jnp.asarray(left),
+        "right": jnp.asarray(right),
+        "value": jnp.asarray(value),
+        "missing_left": jnp.asarray(missing_left),
+        "baseline": jnp.asarray(float(np.ravel(model._baseline_prediction)[0]),
+                                jnp.float32),
+    }
+    return GBDT(n_trees=n_trees, max_nodes=max_nodes, max_depth=max_depth), params

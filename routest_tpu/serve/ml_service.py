@@ -157,6 +157,23 @@ class EtaService:
                 score, cfg.batch_buckets, cfg.max_batch, cfg.max_wait_ms,
                 align=runtime.n_data if runtime is not None else 1,
             )
+            # Self-check: an artifact can deserialize fine yet be unusable
+            # (e.g. stale layer shapes). Run one dummy row now so breakage
+            # surfaces in health as model:degraded instead of per-request
+            # 503s with health claiming ok. Also pre-compiles the smallest
+            # bucket, so the first real request is fast.
+            try:
+                probe = np.zeros((1, self._model.n_features), np.float32)
+                if not np.isfinite(self._batcher.submit(probe)).all():
+                    raise ValueError("self-check produced non-finite output")
+            except Exception as e:
+                self._error = f"model self-check failed: {type(e).__name__}: {e}"
+                self._model = None
+                self._params = None
+                self._batcher = None
+                # drop the score closure too — it captures the device-pinned
+                # param tree and would hold device memory forever
+                self._score = None
 
     def _load(self, path: str) -> None:
         try:

@@ -25,6 +25,7 @@ from flax import serialization
 from routest_tpu.models.eta_mlp import EtaMLP, Params
 
 MAGIC = b"RTPU1\n"
+ARTIFACT_VERSION = 2
 
 
 def save_model(path: str, model: EtaMLP, params: Params) -> None:
@@ -32,7 +33,10 @@ def save_model(path: str, model: EtaMLP, params: Params) -> None:
     header = json.dumps(
         {
             "format": "routest_tpu.eta_mlp",
-            "version": 1,
+            # v2: internal one-hot expansion + [pace, overhead] heads
+            # (first layer is 42-wide, output is 2-wide). v1 artifacts
+            # (12-wide input, 1 head) are incompatible and rejected on load.
+            "version": ARTIFACT_VERSION,
             "hidden": list(model.hidden),
             "n_features": model.n_features,
             "compute_dtype": np.dtype(model.policy.compute_dtype).name,
@@ -56,6 +60,12 @@ def load_model(path: str) -> Tuple[EtaMLP, Params]:
         blob = f.read()
     if header.get("format") != "routest_tpu.eta_mlp":
         raise ValueError(f"{path}: unknown artifact format {header.get('format')}")
+    version = header.get("version")
+    if version != ARTIFACT_VERSION:
+        raise ValueError(
+            f"{path}: artifact version {version} is incompatible with this "
+            f"build (expects v{ARTIFACT_VERSION}); retrain via scripts/train_eta.py"
+        )
     import jax.numpy as jnp
 
     from routest_tpu.core.dtypes import DEFAULT_POLICY

@@ -1,0 +1,92 @@
+"""Train the ETA models and freeze the CPU-baseline golden RMSE.
+
+This is the ``notebooks/`` training pipeline the reference promised but
+never committed (README "Coming Soon", empty notebooks/ — SURVEY.md §0):
+
+1. generate the delivery dataset (schema of ``Flaskr/ml.py:35-48``);
+2. train the CPU baseline (sklearn HistGradientBoosting — same model
+   family as the reference's pickled XGBoost) → ``artifacts/baseline.json``;
+3. train the JAX MLP on the accelerator → ``artifacts/eta_mlp.msgpack``;
+4. assert the TPU model meets the CPU-baseline RMSE (BASELINE.json
+   acceptance bar) and write ``artifacts/training_report.json``.
+
+Usage: python scripts/train_eta.py [--n 500000] [--epochs 30] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=500_000)
+    parser.add_argument("--epochs", type=int, default=30)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--quick", action="store_true",
+                        help="small run for smoke testing")
+    args = parser.parse_args()
+    if args.quick:
+        args.n, args.epochs = 50_000, 8
+
+    import numpy as np
+
+    from routest_tpu.core.config import TrainConfig
+    from routest_tpu.data.synthetic import generate_dataset, train_eval_split
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.train.baseline import save_baseline, train_cpu_baseline
+    from routest_tpu.train.checkpoint import default_model_path, save_model
+    from routest_tpu.train.loop import fit
+
+    print(f"[1/4] dataset: n={args.n}")
+    data = generate_dataset(args.n, seed=args.seed)
+    train, ev = train_eval_split(data)
+    print(f"      train={len(train['eta_minutes'])} eval={len(ev['eta_minutes'])} "
+          f"target std={float(np.std(ev['eta_minutes'])):.2f} min")
+
+    print("[2/4] CPU baseline (HistGradientBoosting)…")
+    baseline = train_cpu_baseline(train, ev)
+    path = save_baseline(baseline)
+    print(f"      RMSE={baseline['rmse_minutes']:.3f} min  "
+          f"single-row={baseline['single_row_preds_per_sec']:.0f}/s  "
+          f"bulk={baseline['bulk_preds_per_sec']:.0f}/s → {path}")
+
+    print(f"[3/4] JAX MLP: epochs={args.epochs}")
+    model = EtaMLP()
+    t0 = time.time()
+    result = fit(model, train, ev, TrainConfig(epochs=args.epochs, seed=args.seed),
+                 log_every=max(1, args.epochs // 5))
+    fit_s = time.time() - t0
+    print(f"      RMSE={result.eval_rmse:.3f} min in {fit_s:.1f}s")
+
+    model_path = default_model_path()
+    save_model(model_path, model, result.state.params)
+    print(f"      artifact → {model_path}")
+
+    print("[4/4] acceptance: TPU RMSE ≤ CPU baseline RMSE × 1.02")
+    ok = result.eval_rmse <= baseline["rmse_minutes"] * 1.02
+    report = {
+        "n": args.n,
+        "epochs": args.epochs,
+        "cpu_baseline_rmse_minutes": baseline["rmse_minutes"],
+        "mlp_rmse_minutes": result.eval_rmse,
+        "rmse_ratio": result.eval_rmse / baseline["rmse_minutes"],
+        "mlp_fit_seconds": fit_s,
+        "passed": bool(ok),
+    }
+    report_path = os.path.join(os.path.dirname(path), "training_report.json")
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"      {'PASS' if ok else 'FAIL'} "
+          f"(ratio {report['rmse_ratio']:.4f}) → {report_path}")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

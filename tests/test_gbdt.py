@@ -1,0 +1,67 @@
+"""Tensorized GBDT must reproduce sklearn's predictions exactly."""
+
+import jax
+import numpy as np
+
+from routest_tpu.data.features import batch_from_mapping
+from routest_tpu.data.synthetic import generate_dataset, train_eval_split
+from routest_tpu.models.gbdt import from_sklearn
+
+
+def _fit_sklearn(n=5000, max_iter=40):
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    train, ev = train_eval_split(generate_dataset(n, seed=9))
+    x = batch_from_mapping(train).astype(np.float64)
+    y = np.asarray(train["eta_minutes"], np.float64)
+    m = HistGradientBoostingRegressor(max_iter=max_iter, random_state=0).fit(x, y)
+    return m, batch_from_mapping(ev)
+
+
+def test_parity_with_sklearn():
+    m, x_eval = _fit_sklearn()
+    gbdt, params = from_sklearn(m)
+    expected = m.predict(x_eval.astype(np.float64))
+    got = np.asarray(jax.jit(gbdt.apply)(params, x_eval))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)
+
+
+def test_batch_invariance():
+    m, x_eval = _fit_sklearn(n=2000, max_iter=10)
+    gbdt, params = from_sklearn(m)
+    apply = jax.jit(gbdt.apply)
+    full = np.asarray(apply(params, x_eval))
+    one = np.asarray(apply(params, x_eval[:1]))
+    np.testing.assert_allclose(full[:1], one, rtol=1e-6)
+
+
+def test_reasonable_rmse():
+    """The tensorized ensemble inherits the CPU baseline's accuracy."""
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    train, ev = train_eval_split(generate_dataset(20000, seed=11))
+    x = batch_from_mapping(train).astype(np.float64)
+    y = np.asarray(train["eta_minutes"], np.float64)
+    m = HistGradientBoostingRegressor(max_iter=100, random_state=0).fit(x, y)
+    gbdt, params = from_sklearn(m)
+    pred = np.asarray(jax.jit(gbdt.apply)(params, batch_from_mapping(ev)))
+    rmse = float(np.sqrt(np.mean((pred - ev["eta_minutes"]) ** 2)))
+    assert rmse < float(np.std(ev["eta_minutes"])) * 0.4
+
+
+def test_nan_routing_matches_sklearn():
+    """Missing (NaN) features must follow sklearn's missing_go_to_left."""
+    import jax as _jax
+
+    m, x_eval = _fit_sklearn(n=3000, max_iter=20)
+    x_nan = x_eval[:64].copy()
+    x_nan[::2, 10] = np.nan  # distance missing in half the rows
+    x_nan[1::3, 9] = np.nan  # hour missing in a third
+    expected = m.predict(x_nan.astype(np.float64))
+    gbdt, params = from_sklearn(m)
+    _jax.config.update("jax_debug_nans", False)  # NaN inputs are the point
+    try:
+        got = np.asarray(_jax.jit(gbdt.apply)(params, x_nan))
+    finally:
+        _jax.config.update("jax_debug_nans", True)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)

@@ -111,3 +111,31 @@ def test_weight_decay_does_not_erode_normalizer(tiny_dataset):
         np.asarray(res.state.params["norm"]["mean"]), mean, rtol=1e-6,
         err_msg="normalizer stats must stay frozen through training",
     )
+
+
+def test_negative_distance_clamped_nonnegative_eta():
+    model = EtaMLP(hidden=(16,), policy=F32_POLICY)
+    params = model.init(jax.random.PRNGKey(7))
+    x = np.zeros((1, 12), np.float32)
+    x[0, 10] = -50.0  # malformed negative distance
+    eta = float(model.apply(params, jnp.asarray(x))[0])
+    assert eta >= 0.0
+
+
+def test_v1_artifact_rejected(tmp_path):
+    import json as _json
+
+    from flax import serialization
+
+    path = str(tmp_path / "v1.msgpack")
+    header = _json.dumps({"format": "routest_tpu.eta_mlp", "version": 1,
+                          "hidden": [16], "n_features": 12}).encode() + b"\n"
+    with open(path, "wb") as f:
+        f.write(b"RTPU1\n")
+        f.write(header)
+        f.write(serialization.msgpack_serialize({"layers": []}))
+    try:
+        load_model(path)
+        assert False, "v1 artifact must be rejected"
+    except ValueError as e:
+        assert "version" in str(e)

@@ -266,3 +266,37 @@ def test_missing_source_point_400(client):
                     json={"destination_points": [{"lat": 14.5, "lon": 121.0}]})
     assert r.status_code == 400
     assert "source point" in r.get_json()["error"]
+
+
+def test_stale_artifact_degrades_health(tmp_path):
+    """An artifact that loads but can't run (stale layer shapes) must mark
+    the model degraded, not 503 per-request while health says ok."""
+    import json as _json
+
+    from flax import serialization
+
+    params = {"layers": [{"w": np.zeros((12, 16), np.float32),
+                          "b": np.zeros(16, np.float32)},
+                         {"w": np.zeros((16, 1), np.float32),
+                          "b": np.zeros(1, np.float32)}],
+              "norm": {"mean": np.zeros(12, np.float32),
+                       "std": np.ones(12, np.float32)}}
+    from routest_tpu.train.checkpoint import ARTIFACT_VERSION
+
+    header = _json.dumps({"format": "routest_tpu.eta_mlp",
+                          "version": ARTIFACT_VERSION,
+                          "hidden": [16], "n_features": 12,
+                          "compute_dtype": "float32"}).encode() + b"\n"
+    path = str(tmp_path / "stale.msgpack")
+    with open(path, "wb") as f:
+        f.write(b"RTPU1\n")
+        f.write(header)
+        f.write(serialization.msgpack_serialize(params))
+
+    eta = EtaService(ServeConfig(), model_path=path)
+    assert not eta.available
+    assert "self-check" in (eta.load_error or "")
+    app2 = create_app(Config(), eta_service=eta)
+    c = Client(app2)
+    assert c.post("/api/predict_eta", json={"summary": {"distance": 1}}).status_code == 503
+    assert c.get("/api/health").get_json()["checks"]["model"]["status"] == "degraded"
