@@ -1,0 +1,116 @@
+"""Synthetic Metro Manila road graph for GNN leg-cost learning.
+
+BASELINE.json config 4 calls for "road-graph GNN training over the full
+data/raw/ network" — but the reference's ``data/raw/`` is empty
+(SURVEY.md §0), so the graph, like the delivery dataset, must be
+generated. The generator produces a road network with the right
+statistics for an urban grid:
+
+- intersection nodes sampled over the Metro Manila bounding box, with
+  density clustered around the 21 seed sites (``data/locations.py``);
+- edges from k-nearest-neighbor connection (symmetrized), giving mean
+  degree ≈ 2k — arterial-plus-side-street territory;
+- per-edge features: length (haversine), road class (one-hot of
+  arterial/collector/local), speed limit;
+- per-edge observed travel time from a ground-truth congestion model
+  (length / class-speed, rush-hour and class interactions) with
+  log-normal noise — the learning target.
+
+Everything is flat numpy arrays (senders/receivers/features), ready to
+shard across the mesh edge-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from routest_tpu.data.locations import coords_array
+
+# Metro Manila bounding box (covers all 21 seed sites with margin).
+LAT_RANGE = (14.38, 14.70)
+LON_RANGE = (120.94, 121.12)
+
+ROAD_CLASSES = ("arterial", "collector", "local")
+_CLASS_SPEED_MPS = np.asarray([11.1, 8.3, 5.6])   # 40 / 30 / 20 km/h
+_CLASS_RUSH_SENSITIVITY = np.asarray([0.8, 0.5, 0.25])
+
+
+def _haversine_np(lat1, lon1, lat2, lon2):
+    r = 6_371_008.8
+    lat1, lon1, lat2, lon2 = map(np.radians, (lat1, lon1, lat2, lon2))
+    a = (np.sin((lat2 - lat1) / 2) ** 2
+         + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2) ** 2)
+    return 2 * r * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+def true_edge_time_s(length_m: np.ndarray, road_class: np.ndarray,
+                     hour: np.ndarray) -> np.ndarray:
+    """Ground-truth travel time per edge (no noise)."""
+    base = length_m / _CLASS_SPEED_MPS[road_class]
+    h = hour.astype(np.float64)
+    rush = (np.exp(-0.5 * ((h - 8.0) / 1.6) ** 2)
+            + np.exp(-0.5 * ((h - 18.0) / 1.8) ** 2))
+    congestion = 1.0 + _CLASS_RUSH_SENSITIVITY[road_class] * rush
+    night = np.where((h >= 22) | (h <= 5), 0.85, 1.0)
+    return base * congestion * night + 4.0  # signalized-intersection overhead
+
+
+def generate_road_graph(n_nodes: int = 4096, k: int = 4, seed: int = 0,
+                        noise_sigma: float = 0.06) -> Dict[str, np.ndarray]:
+    """Graph dict: node_coords (N,2), senders/receivers (E,), edge feature
+    arrays, observed times, plus a train-time ``hour`` per edge sample."""
+    rng = np.random.default_rng(seed)
+
+    # Node positions: 70% clustered around seed sites, 30% uniform fill.
+    sites = coords_array()
+    n_cluster = int(n_nodes * 0.7)
+    centers = sites[rng.integers(0, len(sites), n_cluster)]
+    cluster = centers + rng.normal(0, 0.012, size=(n_cluster, 2))
+    uniform = np.stack([
+        rng.uniform(*LAT_RANGE, n_nodes - n_cluster),
+        rng.uniform(*LON_RANGE, n_nodes - n_cluster),
+    ], axis=1)
+    coords = np.concatenate([cluster, uniform]).astype(np.float32)
+    coords[:, 0] = np.clip(coords[:, 0], *LAT_RANGE)
+    coords[:, 1] = np.clip(coords[:, 1], *LON_RANGE)
+
+    # kNN edges (approximate urban grid). Brute-force is fine at this size.
+    d2 = ((coords[:, None, :] - coords[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    nbrs = np.argsort(d2, axis=1)[:, :k]
+    senders = np.repeat(np.arange(n_nodes), k)
+    receivers = nbrs.reshape(-1)
+    # symmetrize + dedupe
+    pairs = np.stack([np.minimum(senders, receivers),
+                      np.maximum(senders, receivers)], axis=1)
+    pairs = np.unique(pairs, axis=0)
+    senders = np.concatenate([pairs[:, 0], pairs[:, 1]]).astype(np.int32)
+    receivers = np.concatenate([pairs[:, 1], pairs[:, 0]]).astype(np.int32)
+
+    length_m = _haversine_np(
+        coords[senders, 0], coords[senders, 1],
+        coords[receivers, 0], coords[receivers, 1],
+    ).astype(np.float32) * 1.2  # street grid vs straight line
+
+    n_edges = len(senders)
+    road_class = rng.choice(len(ROAD_CLASSES), size=n_edges,
+                            p=[0.2, 0.35, 0.45]).astype(np.int32)
+    speed_limit = _CLASS_SPEED_MPS[road_class].astype(np.float32)
+    hour = rng.integers(0, 24, size=n_edges).astype(np.int32)
+
+    t_true = true_edge_time_s(length_m, road_class, hour)
+    time_s = (t_true * rng.lognormal(0.0, noise_sigma, n_edges)).astype(np.float32)
+
+    return {
+        "node_coords": coords,
+        "senders": senders,
+        "receivers": receivers,
+        "length_m": length_m,
+        "road_class": road_class,
+        "speed_limit": speed_limit,
+        "hour": hour,
+        "time_s": time_s,
+        "time_true_s": t_true.astype(np.float32),
+    }

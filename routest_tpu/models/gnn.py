@@ -1,0 +1,213 @@
+"""Road-graph GNN: learned leg costs via message passing, edge-sharded.
+
+BASELINE.json config 4. The reference has no graph model at all (ORS owns
+the road network); here a message-passing GNN learns per-edge travel
+times from the road graph (``data/road_graph.py``), the on-device
+replacement for "ask ORS how long this leg takes".
+
+Distribution design (SURVEY.md §5.7 — the long-sequence analog): the
+**edge set** is the long axis. Edges shard across the mesh ``data`` axis
+under ``shard_map``; node states are replicated. Each round:
+
+1. every device computes messages for its edge shard (dense matmuls —
+   MXU work, fully parallel);
+2. per-device ``segment_sum`` scatters messages into a full-size node
+   accumulator — the *partial* aggregation over local edges;
+3. one ``psum`` over the data axis combines partials into the global
+   neighborhood aggregation (the halo exchange, batched into a single
+   all-reduce over ICI);
+4. the (replicated) node update runs identically everywhere.
+
+Gradients flow through the psum (XLA differentiates collectives), so the
+same shard_map program is the training step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from routest_tpu.core.dtypes import DEFAULT_POLICY, Policy
+
+Params = Dict
+
+_N_CLASSES = 3
+_N_HOURS = 24
+# [log_length, speed_limit/10] + class one-hot + hour one-hot
+N_EDGE_FEATURES = 2 + _N_CLASSES + _N_HOURS
+
+
+class GraphBatch(NamedTuple):
+    senders: jax.Array     # (E,) int32
+    receivers: jax.Array   # (E,) int32
+    edge_feats: jax.Array  # (E, F)
+    length_m: jax.Array    # (E,)
+    speed_limit: jax.Array  # (E,) m/s
+    targets: jax.Array     # (E,) observed seconds
+    weights: jax.Array     # (E,) 0/1 (padding mask)
+
+
+def edge_features(graph: Dict[str, np.ndarray]) -> np.ndarray:
+    e = len(graph["senders"])
+    out = np.zeros((e, N_EDGE_FEATURES), np.float32)
+    out[:, 0] = np.log1p(graph["length_m"])
+    out[:, 1] = graph["speed_limit"] / 10.0
+    out[np.arange(e), 2 + graph["road_class"]] = 1.0
+    out[np.arange(e), 2 + _N_CLASSES + graph["hour"]] = 1.0
+    return out
+
+
+def graph_batch(graph: Dict[str, np.ndarray], pad_to: int = 0) -> GraphBatch:
+    """Pack a road-graph dict into a GraphBatch, optionally padded so the
+    edge count divides the mesh data axis. Padded edges self-loop node 0
+    with zero weight."""
+    e = len(graph["senders"])
+    target_e = max(e, pad_to) if pad_to else e
+    if pad_to and target_e % pad_to:
+        target_e = ((target_e + pad_to - 1) // pad_to) * pad_to
+
+    def pad(x, fill=0):
+        if len(x) == target_e:
+            return x
+        return np.concatenate([x, np.full((target_e - len(x),) + x.shape[1:],
+                                          fill, x.dtype)])
+
+    return GraphBatch(
+        senders=jnp.asarray(pad(graph["senders"])),
+        receivers=jnp.asarray(pad(graph["receivers"])),
+        edge_feats=jnp.asarray(pad(edge_features(graph))),
+        length_m=jnp.asarray(pad(graph["length_m"])),
+        speed_limit=jnp.asarray(pad(graph["speed_limit"], 1.0)),
+        targets=jnp.asarray(pad(graph["time_s"])),
+        weights=jnp.asarray(pad(np.ones(e, np.float32))),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RoadGNN:
+    n_nodes: int
+    hidden: int = 64
+    n_rounds: int = 2
+    policy: Policy = DEFAULT_POLICY
+
+    def _mlp_init(self, key, dims):
+        layers = []
+        for d_in, d_out in zip(dims[:-1], dims[1:]):
+            key, sub = jax.random.split(key)
+            layers.append({
+                "w": jax.random.normal(sub, (d_in, d_out),
+                                       self.policy.param_dtype)
+                * jnp.sqrt(2.0 / d_in),
+                "b": jnp.zeros((d_out,), self.policy.param_dtype),
+            })
+        return key, layers
+
+    def init(self, key: jax.Array) -> Params:
+        h = self.hidden
+        key, embed = self._mlp_init(key, (2, h))
+        key, msg = self._mlp_init(key, (2 * h + N_EDGE_FEATURES, h, h))
+        key, upd = self._mlp_init(key, (2 * h, h))
+        key, readout = self._mlp_init(key, (2 * h + N_EDGE_FEATURES, h, 2))
+        return {"embed": embed, "msg": msg, "upd": upd, "readout": readout}
+
+    def _mlp(self, layers, x):
+        c = self.policy.compute_dtype
+        for layer in layers[:-1]:
+            x = jax.nn.gelu(x @ layer["w"].astype(c) + layer["b"].astype(c))
+        return x @ layers[-1]["w"].astype(c) + layers[-1]["b"].astype(c)
+
+    def _forward(self, params: Params, node_coords: jax.Array,
+                 batch: GraphBatch, combine) -> jax.Array:
+        """Per-edge predicted seconds. ``combine`` merges per-shard node
+        aggregations (identity on one device; psum under shard_map)."""
+        c = self.policy.compute_dtype
+        coords_n = ((node_coords
+                     - jnp.asarray([14.54, 121.03], node_coords.dtype))
+                    * 50.0).astype(c)
+        h = jax.nn.gelu(self._mlp(params["embed"], coords_n))
+        ef = batch.edge_feats.astype(c)
+        w = batch.weights.astype(c)
+        # in-degree for mean aggregation (hub nodes would otherwise blow up
+        # activations through the rounds and destabilize training)
+        degree = combine(jax.ops.segment_sum(w, batch.receivers,
+                                             num_segments=self.n_nodes))
+        inv_deg = (1.0 / jnp.maximum(degree, 1.0))[:, None]
+        for _ in range(self.n_rounds):
+            m_in = jnp.concatenate(
+                [h[batch.senders], h[batch.receivers], ef], axis=-1
+            )
+            # padded edges (weight 0) must not inject messages
+            messages = self._mlp(params["msg"], m_in) * w[:, None]
+            agg = jax.ops.segment_sum(messages, batch.receivers,
+                                      num_segments=self.n_nodes)
+            agg = combine(agg) * inv_deg
+            h = h + jax.nn.gelu(
+                self._mlp(params["upd"], jnp.concatenate([h, agg], axis=-1))
+            )
+            # parameter-free layer norm keeps round-over-round scale stable
+            h = (h - h.mean(-1, keepdims=True)) / jnp.sqrt(
+                h.var(-1, keepdims=True) + 1e-6)
+        r_in = jnp.concatenate([h[batch.senders], h[batch.receivers], ef],
+                               axis=-1)
+        out = self._mlp(params["readout"], r_in).astype(self.policy.output_dtype)
+        # Physical decomposition, as in the ETA model: free-flow time scaled
+        # by a learned congestion factor, plus learned fixed overhead.
+        freeflow = batch.length_m / jnp.maximum(batch.speed_limit, 0.1)
+        return (freeflow * jax.nn.softplus(out[..., 0])
+                + jax.nn.softplus(out[..., 1]))
+
+    def apply(self, params: Params, node_coords: jax.Array,
+              batch: GraphBatch) -> jax.Array:
+        """Single-device forward: (E,) predicted seconds."""
+        return self._forward(params, node_coords, batch, combine=lambda x: x)
+
+    def loss(self, params: Params, node_coords: jax.Array,
+             batch: GraphBatch, combine=lambda x: x,
+             reduce=lambda x: x) -> jax.Array:
+        pred = self._forward(params, node_coords, batch, combine)
+        err = (pred - batch.targets) ** 2 * batch.weights
+        total = reduce(err.sum())
+        count = reduce(batch.weights.sum())
+        return total / jnp.maximum(count, 1.0)
+
+    # ── mesh-parallel build ────────────────────────────────────────────
+
+    def make_sharded_loss(self, mesh, data_axis: str = "data"):
+        """Loss with edges sharded over the mesh data axis: senders/
+        receivers/features split per device, node states replicated, one
+        psum per round combining neighborhood aggregations."""
+        batch_spec = GraphBatch(*([P(data_axis)] * 7))
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(), P(), batch_spec),
+            out_specs=P(),
+            check_rep=False,
+        )
+        def sharded_loss(params, node_coords, batch):
+            combine = functools.partial(jax.lax.psum, axis_name=data_axis)
+            return self.loss(params, node_coords, batch,
+                             combine=combine, reduce=combine)
+
+        return sharded_loss
+
+    def make_sharded_train_step(self, mesh, optimizer, data_axis: str = "data"):
+        loss_fn = self.make_sharded_loss(mesh, data_axis)
+
+        @jax.jit
+        def step(params, opt_state, node_coords, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, node_coords, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            import optax
+
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step

@@ -29,6 +29,9 @@ from routest_tpu.serve.bus import make_bus, sse_stream
 from routest_tpu.serve.ml_service import EtaService
 from routest_tpu.serve.store import make_store
 from routest_tpu.serve.wsgi import App, get_json
+from routest_tpu.utils.logging import get_logger
+
+_log = get_logger("routest_tpu.serve")
 
 
 class ServerState:
@@ -110,7 +113,8 @@ def create_app(config: Optional[Config] = None,
                 result.setdefault("properties", {})["request_id"] = req_id
                 result["properties"]["saved"] = True
         except Exception as e:
-            print("Persist failed:", e)
+            _log.error("persist_failed", error=str(e),
+                       store=state.store.kind)
 
         return result, 200
 
@@ -258,6 +262,15 @@ def create_app(config: Optional[Config] = None,
     @app.route("/api/ping", methods=("GET",))
     def ping(request):
         return {"ok": True, "service": "route-optimizer"}, 200
+
+    @app.route("/api/metrics", methods=("GET",))
+    def metrics(request):
+        # TPU-era observability (SURVEY.md §5.5): per-route latency
+        # percentiles + batcher gauges, additive to the reference ABI.
+        return {
+            "http": app.request_stats.snapshot(),
+            "batcher": state.eta.stats,
+        }, 200
 
     @app.route("/api/health", methods=("GET",))
     def health(request):

@@ -78,7 +78,10 @@ def start_simulation(data: dict, publish, tick_range_s: tuple = (2.0, 5.0)) -> t
         try:
             simulate_route(data, publish, tick_range_s)
         except Exception as e:  # daemon thread: never die silently
-            print(f"simulate_route failed: {type(e).__name__}: {e}")
+            from routest_tpu.utils.logging import get_logger
+
+            get_logger("routest_tpu.sim").error(
+                "simulate_route_failed", error=f"{type(e).__name__}: {e}")
 
     thread = threading.Thread(target=run, daemon=True)
     thread.start()

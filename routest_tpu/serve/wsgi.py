@@ -11,9 +11,12 @@ from __future__ import annotations
 
 import json
 import re
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from werkzeug.wrappers import Request, Response
+
+from routest_tpu.utils.profiling import RequestStats
 
 _PARAM_RE = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
 
@@ -32,10 +35,11 @@ def json_response(payload: Any, status: int = 200,
 
 
 class App:
-    """Route table + WSGI callable."""
+    """Route table + WSGI callable with per-route latency stats."""
 
     def __init__(self) -> None:
-        self._routes: List[Tuple[str, re.Pattern, Callable]] = []
+        self._routes: List[Tuple[str, str, re.Pattern, Callable]] = []
+        self.request_stats = RequestStats()
 
     def route(self, path: str, methods: Tuple[str, ...] = ("GET",)):
         pattern = re.compile(
@@ -44,20 +48,20 @@ class App:
 
         def register(fn: Callable) -> Callable:
             for m in methods:
-                self._routes.append((m.upper(), pattern, fn))
+                self._routes.append((m.upper(), path, pattern, fn))
             return fn
 
         return register
 
     def _match(self, method: str, path: str):
         allowed: List[str] = []
-        for m, pattern, fn in self._routes:
+        for m, template, pattern, fn in self._routes:
             match = pattern.match(path)
             if match:
                 if m == method:
-                    return fn, match.groupdict(), None
+                    return fn, template, match.groupdict(), None
                 allowed.append(m)
-        return None, {}, allowed
+        return None, None, {}, allowed
 
     def __call__(self, environ, start_response):
         request = Request(environ)
@@ -71,19 +75,32 @@ class App:
     def _dispatch(self, request: Request) -> Response:
         if request.method == "OPTIONS":
             return Response("", 204)
-        fn, kwargs, allowed = self._match(request.method, request.path)
+        fn, template, kwargs, allowed = self._match(request.method, request.path)
         if fn is None:
             if allowed:
                 return json_response({"error": "method not allowed"}, 405,
                                      {"Allow": ", ".join(sorted(set(allowed)))})
             return json_response({"error": "not found"}, 404)
-        result = fn(request, **kwargs)
-        if isinstance(result, Response):
-            return result
-        if isinstance(result, tuple):
-            payload, status = result
-            return json_response(payload, status)
-        return json_response(result)
+        t0 = time.perf_counter()
+        response: Optional[Response] = None
+        try:
+            result = fn(request, **kwargs)
+            if isinstance(result, Response):
+                response = result
+            elif isinstance(result, tuple):
+                payload, status = result
+                response = json_response(payload, status)
+            else:
+                response = json_response(result)
+            return response
+        finally:
+            # Unhandled exceptions (→ 500 in __call__) must count too.
+            # Streaming responses (SSE) are long-lived; their duration is
+            # connection time, not handler latency — skip them.
+            if response is None or not response.is_streamed:
+                error = response is None or response.status_code >= 500
+                self.request_stats.add(f"{request.method} {template}",
+                                       time.perf_counter() - t0, error=error)
 
     @staticmethod
     def _apply_cors(request: Request, response: Response) -> None:

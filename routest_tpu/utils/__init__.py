@@ -1,0 +1,2 @@
+from routest_tpu.utils.logging import get_logger  # noqa: F401
+from routest_tpu.utils.profiling import RequestStats, device_trace  # noqa: F401

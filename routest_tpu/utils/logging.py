@@ -1,0 +1,63 @@
+"""Structured logging: JSON lines instead of the reference's bare prints.
+
+The reference logs failures with ``print()`` (``Flaskr/routes.py:125,158``,
+``Flaskr/utils.py:223-225`` — SURVEY.md §5.5). Here every event is one
+JSON object on stderr: machine-parseable, with logger name, level,
+monotonic-ordered wall time, and free-form fields.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import sys
+import threading
+from typing import Any, Optional, TextIO
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
+
+
+class JsonLogger:
+    def __init__(self, name: str, stream: Optional[TextIO] = None,
+                 level: str = "info") -> None:
+        self.name = name
+        self._stream = stream if stream is not None else sys.stderr
+        self._min = _LEVELS[level]
+        self._lock = threading.Lock()
+
+    def _emit(self, level: str, event: str, **fields: Any) -> None:
+        if _LEVELS[level] < self._min:
+            return
+        record = {
+            "ts": dt.datetime.now(dt.timezone.utc).isoformat(),
+            "level": level,
+            "logger": self.name,
+            "event": event,
+            **fields,
+        }
+        line = json.dumps(record, default=str)
+        with self._lock:
+            print(line, file=self._stream, flush=True)
+
+    def debug(self, event: str, **fields: Any) -> None:
+        self._emit("debug", event, **fields)
+
+    def info(self, event: str, **fields: Any) -> None:
+        self._emit("info", event, **fields)
+
+    def warning(self, event: str, **fields: Any) -> None:
+        self._emit("warning", event, **fields)
+
+    def error(self, event: str, **fields: Any) -> None:
+        self._emit("error", event, **fields)
+
+
+_loggers: dict = {}
+_lock = threading.Lock()
+
+
+def get_logger(name: str) -> JsonLogger:
+    with _lock:
+        if name not in _loggers:
+            _loggers[name] = JsonLogger(name)
+        return _loggers[name]

@@ -1,0 +1,103 @@
+"""Profiling: per-request latency stats + JAX device traces.
+
+The reference's only timing is wall-clock deltas inside health probes
+(``Flaskr/routes.py:285,300,331`` — SURVEY.md §5.1). This module adds:
+
+- ``RequestStats``: lock-protected per-route latency accumulators
+  (count, errors, mean, p50/p95/p99 from a bounded reservoir) that the
+  serving layer samples into and ``/api/metrics`` reports;
+- ``device_trace``: context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace of device execution.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Dict, Iterator, List
+
+
+class _RouteStats:
+    __slots__ = ("count", "errors", "total_s", "reservoir", "_rng")
+    RESERVOIR = 512
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.errors = 0
+        self.total_s = 0.0
+        self.reservoir: List[float] = []
+        self._rng = random.Random(0)
+
+    def add(self, seconds: float, error: bool) -> None:
+        self.count += 1
+        self.errors += int(error)
+        self.total_s += seconds
+        if len(self.reservoir) < self.RESERVOIR:
+            self.reservoir.append(seconds)
+        else:  # reservoir sampling keeps percentiles unbiased over time
+            j = self._rng.randrange(self.count)
+            if j < self.RESERVOIR:
+                self.reservoir[j] = seconds
+
+    def summary(self) -> Dict:
+        if not self.count:
+            return {"count": 0}
+        ordered = sorted(self.reservoir)
+
+        def pct(p: float) -> float:
+            return ordered[min(len(ordered) - 1, int(p * len(ordered)))]
+
+        return {
+            "count": self.count,
+            "errors": self.errors,
+            "mean_ms": round(1000.0 * self.total_s / self.count, 3),
+            "p50_ms": round(1000.0 * pct(0.50), 3),
+            "p95_ms": round(1000.0 * pct(0.95), 3),
+            "p99_ms": round(1000.0 * pct(0.99), 3),
+        }
+
+
+class RequestStats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._routes: Dict[str, _RouteStats] = {}
+        self.started = time.time()
+
+    @contextlib.contextmanager
+    def measure(self, route: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        error = False
+        try:
+            yield
+        except Exception:
+            error = True
+            raise
+        finally:
+            self.add(route, time.perf_counter() - t0, error)
+
+    def add(self, route: str, seconds: float, error: bool = False) -> None:
+        with self._lock:
+            if route not in self._routes:
+                self._routes[route] = _RouteStats()
+            self._routes[route].add(seconds, error)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "uptime_s": round(time.time() - self.started, 1),
+                "routes": {r: s.summary() for r, s in self._routes.items()},
+            }
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """TensorBoard-loadable device trace (xplane) around a code region."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

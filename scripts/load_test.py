@@ -1,0 +1,174 @@
+"""Config-5 workload: the serving API under concurrent map-app-style load.
+
+Emulates the Laravel-proxy scenario of BASELINE.json config 5: many
+concurrent clients calling ``/api/predict_eta`` (the batched hot path)
+and a sprinkling of ``/api/optimize_route`` (the heavier VRP+geometry
+path), against a server that is by default spawned in-process here.
+Reports RPS and latency percentiles per endpoint, plus the server's own
+``/api/metrics`` view (batcher coalescing stats).
+
+Usage: python scripts/load_test.py [--threads 32] [--requests 50]
+       [--base-url http://host:port]  (target an already-running server)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import random
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _post(base: str, path: str, payload: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = resp.read()
+        return time.perf_counter() - t0, resp.status, body
+
+
+def _get(base: str, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _percentiles(samples):
+    ordered = sorted(samples)
+
+    def pct(p):
+        return ordered[min(len(ordered) - 1, int(p * len(ordered)))] * 1000
+
+    return {"p50_ms": round(pct(0.5), 2), "p95_ms": round(pct(0.95), 2),
+            "p99_ms": round(pct(0.99), 2), "mean_ms":
+            round(1000 * sum(samples) / len(samples), 2)}
+
+
+def run_load(base: str, n_threads: int, n_requests: int):
+    from routest_tpu.data.locations import SEED_LOCATIONS
+
+    eta_lat: list = []
+    opt_lat: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def eta_payload(rng):
+        return {
+            "summary": {"distance": rng.uniform(500, 40_000)},
+            "weather": rng.choice(["Sunny", "Cloudy", "Stormy", "Windy", "Fog"]),
+            "traffic": rng.choice(["Low", "Medium", "High", "Jam"]),
+            "driver_age": rng.uniform(19, 60),
+            "pickup_time": "2026-07-29T18:00:00",
+        }
+
+    def opt_payload(rng):
+        picks = rng.sample(range(1, len(SEED_LOCATIONS)), 3)
+        return {
+            "source_point": {"lat": SEED_LOCATIONS[0][1], "lon": SEED_LOCATIONS[0][2]},
+            "destination_points": [
+                {"lat": SEED_LOCATIONS[i][1], "lon": SEED_LOCATIONS[i][2], "payload": 1}
+                for i in picks
+            ],
+            "driver_details": {"driver_name": f"lt-{rng.random():.4f}",
+                               "vehicle_type": "car",
+                               "vehicle_capacity": 100,
+                               "maximum_distance": 200_000},
+            "use_ml_eta": True,
+            "context": {"weather": "Sunny", "traffic": "Medium"},
+        }
+
+    def worker(seed: int):
+        rng = random.Random(seed)
+        for i in range(n_requests):
+            try:
+                if i % 10 == 9:  # 10% heavy optimize calls
+                    dt_s, status, _ = _post(base, "/api/optimize_route",
+                                            opt_payload(rng))
+                    with lock:
+                        opt_lat.append(dt_s)
+                else:
+                    dt_s, status, _ = _post(base, "/api/predict_eta",
+                                            eta_payload(rng))
+                    with lock:
+                        eta_lat.append(dt_s)
+                if status != 200:
+                    with lock:
+                        errors.append(status)
+            except Exception as e:
+                with lock:
+                    errors.append(str(e)[:80])
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    total = len(eta_lat) + len(opt_lat)
+    report = {
+        "threads": n_threads,
+        "requests": total,
+        "wall_seconds": round(wall, 2),
+        "rps": round(total / wall, 1),
+        "errors": len(errors),
+        "predict_eta": _percentiles(eta_lat) if eta_lat else {},
+        "optimize_route": _percentiles(opt_lat) if opt_lat else {},
+    }
+    try:
+        report["server_metrics"] = _get(base, "/api/metrics")
+    except Exception:
+        pass
+    return report, errors
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--threads", type=int, default=32)
+    parser.add_argument("--requests", type=int, default=50,
+                        help="requests per thread")
+    parser.add_argument("--base-url", default=None,
+                        help="target a running server instead of self-spawning")
+    args = parser.parse_args()
+
+    if args.base_url:
+        base = args.base_url.rstrip("/")
+    else:
+        # self-spawn on a free port with an in-memory stack
+        from werkzeug.serving import make_server
+
+        from routest_tpu.serve.__main__ import ensure_model
+        from routest_tpu.serve.app import create_app
+        from routest_tpu.train.checkpoint import default_model_path
+
+        ensure_model(default_model_path())
+        app = create_app()
+        server = make_server("127.0.0.1", 0, app, threaded=True)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_port}"
+        print(f"[load_test] self-spawned server at {base}")
+
+    report, errors = run_load(base, args.threads, args.requests)
+    print(json.dumps(report, indent=2))
+    if errors:
+        print(f"first errors: {errors[:5]}", file=sys.stderr)
+    out = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "artifacts", "load_test.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    sys.exit(1 if errors else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -300,3 +300,20 @@ def test_stale_artifact_degrades_health(tmp_path):
     c = Client(app2)
     assert c.post("/api/predict_eta", json={"summary": {"distance": 1}}).status_code == 503
     assert c.get("/api/health").get_json()["checks"]["model"]["status"] == "degraded"
+
+
+def test_metrics_counts_unhandled_exceptions(model_artifact):
+    """A handler that raises must still be counted (as an error) in
+    /api/metrics — failing routes showing count 0 would hide outages."""
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    app2 = create_app(Config(), eta_service=eta)
+
+    @app2.route("/api/boom", methods=("GET",))
+    def boom(request):
+        raise RuntimeError("kaboom")
+
+    c = Client(app2)
+    assert c.get("/api/boom").status_code == 500
+    routes = c.get("/api/metrics").get_json()["http"]["routes"]
+    assert routes["GET /api/boom"]["count"] == 1
+    assert routes["GET /api/boom"]["errors"] == 1
