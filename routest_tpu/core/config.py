@@ -49,7 +49,11 @@ class TrainConfig:
     weight_decay: float = 1e-4
     epochs: int = 30
     seed: int = 0
+    # Periodic Orbax checkpointing: set a directory to enable. ``fit``
+    # resumes from the latest checkpoint found there (elastic recovery —
+    # the capability SURVEY.md §5.3/5.4 records as absent upstream).
     checkpoint_dir: Optional[str] = None
+    checkpoint_every_epochs: int = 5
 
 
 @dataclasses.dataclass(frozen=True)

@@ -73,16 +73,41 @@ def rank_routes(
     max_candidates: int = 4096,
     greedy_order: Optional[np.ndarray] = None,
     return_to_origin: bool = True,
+    runtime=None,
 ) -> RankedRoutes:
     """Score candidates and return the k best.
 
     Ranking key: model ETA when a model is given (the ML engine path),
     else path duration at profile speed. ``context`` carries the
     weather/traffic/weekday/hour/driver_age the 12-feature encoding needs.
+
+    With a ``MeshRuntime``, the candidate axis shards over the mesh
+    ``data`` axis (SURVEY.md §5.7: the candidate-set axis is this
+    framework's long-sequence analog) — XLA propagates the sharding
+    through the gathers, the model matmuls, and the final top_k, which
+    becomes a per-shard top-k plus an all-gather of the survivors.
+    Padded candidates get +inf scores so they can never surface.
     """
     n = dist.shape[0] - 1
     perms = candidate_permutations(n, max_candidates, greedy_order=greedy_order)
-    d = path_distances(jnp.asarray(dist, jnp.float32), jnp.asarray(perms),
+    n_real = perms.shape[0]
+    pad_penalty = None
+    if runtime is not None:
+        from routest_tpu.core.mesh import pad_to_multiple
+
+        padded_k = pad_to_multiple(n_real, runtime.n_data)
+        if padded_k != n_real:
+            perms = np.concatenate(
+                [perms, np.repeat(perms[:1], padded_k - n_real, axis=0)]
+            )
+            penalty = np.zeros(padded_k, np.float32)
+            penalty[n_real:] = np.float32(3.4e38)
+            pad_penalty = jax.device_put(jnp.asarray(penalty),
+                                         runtime.batch_sharding())
+        perms_dev = jax.device_put(jnp.asarray(perms), runtime.batch_sharding())
+    else:
+        perms_dev = jnp.asarray(perms)
+    d = path_distances(jnp.asarray(dist, jnp.float32), perms_dev,
                        return_to_origin)
 
     if model is not None and params is not None:
@@ -103,7 +128,10 @@ def rank_routes(
         etas = np.full(d.shape, np.nan, np.float32)
         score = d / speed_mps
 
-    k = min(k, perms.shape[0])
+    if pad_penalty is not None:
+        score = score + pad_penalty
+
+    k = min(k, n_real)
     _, best = jax.lax.top_k(-score, k)
     best = np.asarray(best)
     return RankedRoutes(

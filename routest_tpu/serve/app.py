@@ -16,6 +16,7 @@ Every endpoint of the reference Flask service (SURVEY.md Appendix A,
 from __future__ import annotations
 
 import datetime as dt
+import os
 import time
 from typing import Optional
 
@@ -258,6 +259,18 @@ def create_app(config: Optional[Config] = None,
     def locations(request):
         # Laravel parity (``routes/api.php:7-9``): plain array of rows.
         return locations_table(), 200
+
+    # ── dashboard (the map-app capability, served hermetically) ────────
+
+    _dashboard_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "static", "dashboard.html")
+    with open(_dashboard_path, "rb") as f:
+        _dashboard_html = f.read()  # immutable asset: read once, serve cached
+
+    @app.route("/ui", methods=("GET",))
+    @app.route("/", methods=("GET",))
+    def dashboard(request):
+        return Response(_dashboard_html, mimetype="text/html")
 
     @app.route("/api/ping", methods=("GET",))
     def ping(request):

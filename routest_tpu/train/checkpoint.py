@@ -106,10 +106,22 @@ def save_checkpoint(ckpt_dir: str, step: int, state) -> None:
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Newest COMPLETE checkpoint. A crash mid-save leaves Orbax tmp dirs
+    (``step_N.orbax-checkpoint-tmp-*``) behind — exactly the scenario
+    resume exists for — so only cleanly-named numeric steps count."""
     if not os.path.isdir(ckpt_dir):
         return None
-    steps = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("step_"))
-    return os.path.join(ckpt_dir, steps[-1]) if steps else None
+    best: Optional[Tuple[int, str]] = None
+    for d in os.listdir(ckpt_dir):
+        if not d.startswith("step_"):
+            continue
+        suffix = d[len("step_"):]
+        if not suffix.isdigit():
+            continue  # tmp/incomplete entries
+        step = int(suffix)
+        if best is None or step > best[0]:
+            best = (step, d)
+    return os.path.join(ckpt_dir, best[1]) if best else None
 
 
 def restore_checkpoint(path: str, target):

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Callable, Dict, Iterator, NamedTuple, Optional, Tuple
 
 import jax
@@ -194,12 +195,27 @@ def fit(
     if runtime is not None:
         state = TrainState(*runtime.replicate(tuple(state)))
 
+    start_epoch = 0
+    if cfg.checkpoint_dir:
+        from routest_tpu.train import checkpoint as ckpt
+
+        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+        if latest is not None:
+            state = TrainState(*ckpt.restore_checkpoint(latest, tuple(state)))
+            start_epoch = int(os.path.basename(latest).split("_")[-1])
+            if runtime is not None:
+                state = TrainState(*runtime.replicate(tuple(state)))
+            if log_every:
+                print(f"resumed from {latest} (epoch {start_epoch})")
+
     step_fn = make_train_step(model, optimizer, runtime)
-    rng = np.random.default_rng(cfg.seed + 1)
     n_shards = runtime.n_data if runtime else 1
 
     losses = []
-    for epoch in range(cfg.epochs):
+    for epoch in range(start_epoch, cfg.epochs):
+        # per-epoch rng: deterministic shuffles that are stable across a
+        # resume (epoch k shuffles identically whether or not we restarted)
+        rng = np.random.default_rng(cfg.seed + 1 + epoch)
         for batch in _minibatches(features, targets, cfg.batch_size, rng, n_shards):
             if runtime is not None:
                 batch = Batch(*runtime.shard_batch(tuple(batch)))
@@ -207,6 +223,11 @@ def fit(
         losses.append(float(loss))
         if log_every and (epoch + 1) % log_every == 0:
             print(f"epoch {epoch + 1}/{cfg.epochs} loss={losses[-1]:.4f}")
+        if (cfg.checkpoint_dir and cfg.checkpoint_every_epochs
+                and (epoch + 1) % cfg.checkpoint_every_epochs == 0):
+            from routest_tpu.train import checkpoint as ckpt
+
+            ckpt.save_checkpoint(cfg.checkpoint_dir, epoch + 1, tuple(state))
 
     eval_rmse = rmse(model, state.params, eval_data, runtime)
     return FitResult(state=state, train_losses=losses, eval_rmse=eval_rmse)

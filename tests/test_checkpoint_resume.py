@@ -1,0 +1,56 @@
+"""Checkpoint/resume: interrupted training continues, not restarts."""
+
+import os
+
+import numpy as np
+
+from routest_tpu.core.config import TrainConfig
+from routest_tpu.core.dtypes import F32_POLICY
+from routest_tpu.models.eta_mlp import EtaMLP
+from routest_tpu.train.checkpoint import latest_checkpoint
+from routest_tpu.train.loop import fit
+
+
+def test_resume_continues_from_checkpoint(tiny_dataset, tmp_path):
+    train, ev = tiny_dataset
+    model = EtaMLP(hidden=(16,), policy=F32_POLICY)
+    ckpt_dir = str(tmp_path / "ckpts")
+
+    # "crash" after 4 epochs (checkpoint every 2)
+    cfg1 = TrainConfig(batch_size=1024, epochs=4, checkpoint_dir=ckpt_dir,
+                       checkpoint_every_epochs=2)
+    res1 = fit(model, train, ev, cfg1)
+    saved = latest_checkpoint(ckpt_dir)
+    assert saved is not None and saved.endswith("step_00000004")
+
+    # resume with a larger epoch budget: must pick up at epoch 4
+    cfg2 = TrainConfig(batch_size=1024, epochs=8, checkpoint_dir=ckpt_dir,
+                       checkpoint_every_epochs=2)
+    res2 = fit(model, train, ev, cfg2)
+    # only epochs 4..8 ran → 4 loss entries, and training improved
+    assert len(res2.train_losses) == 4
+    assert res2.eval_rmse <= res1.eval_rmse * 1.05
+    assert latest_checkpoint(ckpt_dir).endswith("step_00000008")
+
+
+def test_fresh_run_without_dir_unaffected(tiny_dataset):
+    train, ev = tiny_dataset
+    model = EtaMLP(hidden=(16,), policy=F32_POLICY)
+    res = fit(model, train, ev, TrainConfig(batch_size=1024, epochs=2))
+    assert len(res.train_losses) == 2
+
+
+def test_orbax_tmp_dirs_ignored(tiny_dataset, tmp_path):
+    """A crash mid-save leaves step_N.orbax-checkpoint-tmp-* dirs; resume
+    must skip them and use the newest complete checkpoint."""
+    train, ev = tiny_dataset
+    model = EtaMLP(hidden=(16,), policy=F32_POLICY)
+    ckpt_dir = str(tmp_path / "ckpts")
+    fit(model, train, ev, TrainConfig(batch_size=1024, epochs=2,
+        checkpoint_dir=ckpt_dir, checkpoint_every_epochs=2))
+    # simulate an interrupted save AFTER the good one
+    os.makedirs(os.path.join(ckpt_dir, "step_00000004.orbax-checkpoint-tmp-99"))
+    assert latest_checkpoint(ckpt_dir).endswith("step_00000002")
+    res = fit(model, train, ev, TrainConfig(batch_size=1024, epochs=3,
+              checkpoint_dir=ckpt_dir, checkpoint_every_epochs=2))
+    assert len(res.train_losses) == 1  # resumed at epoch 2, ran epoch 3 only

@@ -67,3 +67,24 @@ def test_model_ranking_returns_etas_sorted(rng):
     assert ranked.orders.shape == (6, 5)
     assert np.isfinite(ranked.etas_min).all()
     assert (np.diff(ranked.etas_min) >= -1e-4).all()
+
+
+def test_sharded_ranking_matches_single(rng, mesh_runtime):
+    """Candidate-sharded ranking must return the same top-k as single-device."""
+    dist = _random_dist(rng, 6) * 1000.0
+    single = rank_routes(dist, k=5)
+    sharded = rank_routes(dist, k=5, runtime=mesh_runtime)
+    np.testing.assert_array_equal(single.orders, sharded.orders)
+    np.testing.assert_allclose(single.distances_m, sharded.distances_m, rtol=1e-5)
+
+
+def test_sharded_ranking_pads_awkward_candidate_counts(rng, mesh_runtime):
+    """With sampled candidates not divisible by the shard count, padding
+    must never surface in the top-k."""
+    dist = _random_dist(rng, 8) * 1000.0
+    ranked = rank_routes(dist, k=10, max_candidates=30, runtime=mesh_runtime)
+    assert ranked.orders.shape == (10, 8)
+    # all returned orders are valid permutations
+    for order in ranked.orders:
+        assert sorted(order.tolist()) == list(range(8))
+    assert (ranked.distances_m < 1e30).all()

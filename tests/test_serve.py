@@ -317,3 +317,12 @@ def test_metrics_counts_unhandled_exceptions(model_artifact):
     routes = c.get("/api/metrics").get_json()["http"]["routes"]
     assert routes["GET /api/boom"]["count"] == 1
     assert routes["GET /api/boom"]["errors"] == 1
+
+
+def test_dashboard_served(client):
+    for path in ("/", "/ui"):
+        r = client.get(path)
+        assert r.status_code == 200
+        assert "text/html" in r.headers["Content-Type"]
+        body = r.get_data(as_text=True)
+        assert "routest-tpu" in body and "realtime_feed" in body
