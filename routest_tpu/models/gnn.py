@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+from routest_tpu.core.smap import shard_map
 
 from routest_tpu.core.dtypes import DEFAULT_POLICY, Policy
 
@@ -189,7 +190,7 @@ class RoadGNN:
             shard_map, mesh=mesh,
             in_specs=(P(), P(), batch_spec),
             out_specs=P(),
-            check_rep=False,
+
         )
         def sharded_loss(params, node_coords, batch):
             combine = functools.partial(jax.lax.psum, axis_name=data_axis)

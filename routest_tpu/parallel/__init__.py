@@ -1,0 +1,28 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no sequence models and no parallelism of any kind
+(SURVEY.md §5.7 — its longest "sequence" is a polyline walked in Python
+lists, reference ``Flaskr/utils.py:162-167``). Here the long axis is a
+route: a delivery run expressed as a sequence of legs/polyline points,
+potentially far longer than one chip's HBM wants to hold at attention's
+O(S²) cost. This package scales that axis across the mesh:
+
+- :mod:`routest_tpu.parallel.ring` — ring attention: K/V blocks rotate
+  around the ICI ring via ``lax.ppermute`` while each device accumulates
+  its queries' attention with a running (online) softmax;
+- :mod:`routest_tpu.parallel.ulysses` — all-to-all sequence parallelism:
+  ``lax.all_to_all`` re-shards sequence↔heads so every device runs full
+  attention over a head shard.
+
+Both are pure shard_map programs — XLA emits the collectives over ICI;
+gradients flow through them, so the same code paths train.
+"""
+
+from routest_tpu.parallel.ring import ring_attention, ring_attention_sharded
+from routest_tpu.parallel.ulysses import ulysses_attention_sharded
+
+__all__ = [
+    "ring_attention",
+    "ring_attention_sharded",
+    "ulysses_attention_sharded",
+]

@@ -1,0 +1,143 @@
+"""Ring attention: exact attention over a mesh-sharded sequence axis.
+
+Each device holds one block of the sequence. K/V blocks travel around the
+ICI ring (``lax.ppermute``) while every device accumulates attention for
+its resident queries with an online softmax — the running max/denominator
+rescaling that makes blockwise attention exact, not approximate. After
+``axis_size`` hops every query has seen every key, yet no device ever
+materialized more than a (local_q × local_k) score tile: O(S²) compute,
+O(S²/n²) memory per step, O(S/n) activation residency.
+
+The reference has nothing like this (no attention, no collectives —
+SURVEY.md §5.7/§5.8); this is the TPU-native scaling path for
+long-route sequence models (``routest_tpu/models/routeformer.py``).
+
+Layouts: q/k/v are (B, S, H, D); masks are (B, S) with 1.0 = real token.
+``ring_attention`` is the per-device program (call it inside shard_map
+with the sequence axis sharded); ``ring_attention_sharded`` wraps it for
+callers holding unsharded arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from routest_tpu.core.smap import shard_map
+
+_NEG = -1e30  # finite "minus infinity": keeps exp() NaN-free on all-masked tiles
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   key_mask: Optional[jax.Array] = None,
+                   causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Single-device reference: (B, S, H, D) → (B, S, H, D).
+
+    The oracle ring/Ulysses must match bit-for-bit in f32 (up to summation
+    order); also the fallback when the mesh has one device on the axis.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.ones(s.shape[-1], bool)[None, None, None, :]
+    if key_mask is not None:
+        mask = mask & (key_mask[:, None, None, :] > 0)
+    if causal:
+        q_pos = jnp.arange(q.shape[1])[:, None]
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        mask = mask & (q_pos >= k_pos)[None, None]
+    s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1) * mask
+    denom = jnp.maximum(p.sum(-1, keepdims=True), 1e-30)
+    p = p / denom * jnp.clip(mask.sum(-1, keepdims=True), 0, 1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   *, axis_name: str, axis_size: int,
+                   key_mask: Optional[jax.Array] = None,
+                   causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Per-device ring attention. Call inside shard_map.
+
+    q/k/v: (B, S_local, H, D) — this device's sequence block.
+    key_mask: (B, S_local) for the local key block (rotates with K/V).
+    Returns (B, S_local, H, D) for the resident queries.
+    """
+    if axis_size == 1:
+        return full_attention(q, k, v, key_mask, causal, scale)
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    b, s_q, h, _ = q.shape
+    s_k = k.shape[1]
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    kmask = (jnp.ones((b, s_k), q.dtype) if key_mask is None
+             else key_mask.astype(q.dtype))
+    q_pos = my * s_q + jnp.arange(s_q)
+
+    acc = jnp.zeros((b, h, s_q, q.shape[-1]), jnp.float32)
+    m = jnp.full((b, h, s_q), _NEG, jnp.float32)
+    denom = jnp.zeros((b, h, s_q), jnp.float32)
+
+    def hop(carry, step):
+        k_blk, v_blk, km, acc, m, denom = carry
+        # after `step` clockwise hops we hold the block born on device my-step
+        src = (my - step) % axis_size
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        tile_mask = km[:, None, None, :] > 0
+        if causal:
+            k_pos = src * s_k + jnp.arange(s_k)
+            tile_mask = tile_mask & (q_pos[:, None] >= k_pos[None, :])[None, None]
+        s = jnp.where(tile_mask, s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1))
+        # explicit mask multiply: on an all-masked tile exp(NEG-NEG)=1 would
+        # otherwise inject phantom probability mass
+        p = jnp.exp(s - m_new[..., None]) * tile_mask
+        correction = jnp.exp(m - m_new)
+        denom = denom * correction + p.sum(-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        rotate = functools.partial(jax.lax.ppermute, axis_name=axis_name,
+                                   perm=perm)
+        k_blk, v_blk, km = rotate(k_blk), rotate(v_blk), rotate(km)
+        return (k_blk, v_blk, km, acc, m_new, denom), None
+
+    (_, _, _, acc, _, denom), _ = jax.lax.scan(
+        hop, (k, v, kmask, acc, m, denom), jnp.arange(axis_size))
+    out = acc / jnp.maximum(denom, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh, seq_axis: str = "seq",
+                           data_axis: Optional[str] = None,
+                           key_mask: Optional[jax.Array] = None,
+                           causal: bool = False) -> jax.Array:
+    """Shard the sequence axis of full (B, S, H, D) arrays and run the ring.
+
+    The mesh's ``seq_axis`` size must divide S; batch optionally shards
+    over ``data_axis``. This is the convenience wrapper — models compose
+    :func:`ring_attention` directly inside their own shard_map programs.
+    """
+    axis_size = mesh.shape[seq_axis]
+    qkv_spec = P(data_axis, seq_axis, None, None)
+    mask_spec = P(data_axis, seq_axis)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec)
+    def run(q, k, v, km):
+        return ring_attention(q, k, v, axis_name=seq_axis,
+                              axis_size=axis_size, key_mask=km,
+                              causal=causal)
+
+    if key_mask is None:
+        key_mask = jnp.ones(q.shape[:2], q.dtype)
+    return run(q, k, v, key_mask)

@@ -1,0 +1,81 @@
+"""Ulysses-style sequence parallelism: all-to-all seq↔head re-sharding.
+
+The alternative long-context strategy to the ring (DeepSpeed-Ulysses
+pattern): instead of rotating K/V blocks, one ``lax.all_to_all`` converts
+the sequence sharding into a head sharding — every device then runs
+ordinary full attention over the whole sequence for its slice of heads,
+and a second all-to-all restores the sequence sharding. Two collectives
+total (vs ``n-1`` ppermute hops), at the cost of requiring
+``n_heads % axis_size == 0`` and O(S²) score tiles per device.
+
+Ring wins when S is huge (smaller tiles, overlappable hops); Ulysses wins
+at moderate S where collective count dominates. Both are exposed so a
+sequence model can pick per workload (``routest_tpu/models/routeformer.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from routest_tpu.core.smap import shard_map
+from routest_tpu.parallel.ring import full_attention
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      *, axis_name: str, axis_size: int,
+                      key_mask: Optional[jax.Array] = None,
+                      causal: bool = False) -> jax.Array:
+    """Per-device program: (B, S_local, H, D) in, same shape out.
+
+    Call inside shard_map with the sequence axis sharded over
+    ``axis_name``. Requires H % axis_size == 0.
+    """
+    if axis_size == 1:
+        return full_attention(q, k, v, key_mask, causal)
+    if q.shape[2] % axis_size:
+        raise ValueError(
+            f"n_heads={q.shape[2]} not divisible by axis_size={axis_size}")
+
+    def seq_to_heads(x):  # (B, S/n, H, D) → (B, S, H/n, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):  # (B, S, H/n, D) → (B, S/n, H, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    q_h, k_h, v_h = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    full_mask = None
+    if key_mask is not None:
+        full_mask = jax.lax.all_gather(key_mask, axis_name, axis=1, tiled=True)
+    out = full_attention(q_h, k_h, v_h, full_mask, causal)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                              mesh: Mesh, seq_axis: str = "seq",
+                              data_axis: Optional[str] = None,
+                              key_mask: Optional[jax.Array] = None,
+                              causal: bool = False) -> jax.Array:
+    """Convenience wrapper over full (B, S, H, D) arrays (cf. ring)."""
+    axis_size = mesh.shape[seq_axis]
+    qkv_spec = P(data_axis, seq_axis, None, None)
+    mask_spec = P(data_axis, seq_axis)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec)
+    def run(q, k, v, km):
+        return ulysses_attention(q, k, v, axis_name=seq_axis,
+                                 axis_size=axis_size, key_mask=km,
+                                 causal=causal)
+
+    if key_mask is None:
+        key_mask = jnp.ones(q.shape[:2], q.dtype)
+    return run(q, k, v, key_mask)

@@ -105,10 +105,12 @@ def save_checkpoint(ckpt_dir: str, step: int, state) -> None:
     ckptr.wait_until_finished()
 
 
-def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
-    """Newest COMPLETE checkpoint. A crash mid-save leaves Orbax tmp dirs
-    (``step_N.orbax-checkpoint-tmp-*``) behind — exactly the scenario
-    resume exists for — so only cleanly-named numeric steps count."""
+def latest_checkpoint_step(ckpt_dir: str) -> Optional[Tuple[int, str]]:
+    """Newest COMPLETE checkpoint as ``(step, path)``. A crash mid-save
+    leaves Orbax tmp dirs (``step_N.orbax-checkpoint-tmp-*``) behind —
+    exactly the scenario resume exists for — so only cleanly-named
+    numeric steps count. The step number is parsed here, the one place
+    that owns the ``step_%08d`` naming scheme."""
     if not os.path.isdir(ckpt_dir):
         return None
     best: Optional[Tuple[int, str]] = None
@@ -121,7 +123,12 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
         step = int(suffix)
         if best is None or step > best[0]:
             best = (step, d)
-    return os.path.join(ckpt_dir, best[1]) if best else None
+    return (best[0], os.path.join(ckpt_dir, best[1])) if best else None
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    found = latest_checkpoint_step(ckpt_dir)
+    return found[1] if found else None
 
 
 def restore_checkpoint(path: str, target):

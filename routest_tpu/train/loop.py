@@ -199,10 +199,10 @@ def fit(
     if cfg.checkpoint_dir:
         from routest_tpu.train import checkpoint as ckpt
 
-        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
-        if latest is not None:
+        found = ckpt.latest_checkpoint_step(cfg.checkpoint_dir)
+        if found is not None:
+            start_epoch, latest = found
             state = TrainState(*ckpt.restore_checkpoint(latest, tuple(state)))
-            start_epoch = int(os.path.basename(latest).split("_")[-1])
             if runtime is not None:
                 state = TrainState(*runtime.replicate(tuple(state)))
             if log_every:
