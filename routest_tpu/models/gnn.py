@@ -190,7 +190,6 @@ class RoadGNN:
             shard_map, mesh=mesh,
             in_specs=(P(), P(), batch_spec),
             out_specs=P(),
-
         )
         def sharded_loss(params, node_coords, batch):
             combine = functools.partial(jax.lax.psum, axis_name=data_axis)

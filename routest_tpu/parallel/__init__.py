@@ -19,10 +19,11 @@ gradients flow through them, so the same code paths train.
 """
 
 from routest_tpu.parallel.ring import ring_attention, ring_attention_sharded
-from routest_tpu.parallel.ulysses import ulysses_attention_sharded
+from routest_tpu.parallel.ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
     "ulysses_attention_sharded",
 ]

@@ -10,7 +10,7 @@ O(S²/n²) memory per step, O(S/n) activation residency.
 
 The reference has nothing like this (no attention, no collectives —
 SURVEY.md §5.7/§5.8); this is the TPU-native scaling path for
-long-route sequence models (``routest_tpu/models/routeformer.py``).
+long-route sequence models built on this package.
 
 Layouts: q/k/v are (B, S, H, D); masks are (B, S) with 1.0 = real token.
 ``ring_attention`` is the per-device program (call it inside shard_map
@@ -76,40 +76,63 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     s_k = k.shape[1]
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-    kmask = (jnp.ones((b, s_k), q.dtype) if key_mask is None
-             else key_mask.astype(q.dtype))
+    kmask = None if key_mask is None else key_mask.astype(q.dtype)
     q_pos = my * s_q + jnp.arange(s_q)
 
     acc = jnp.zeros((b, h, s_q, q.shape[-1]), jnp.float32)
     m = jnp.full((b, h, s_q), _NEG, jnp.float32)
     denom = jnp.zeros((b, h, s_q), jnp.float32)
 
-    def hop(carry, step):
-        k_blk, v_blk, km, acc, m, denom = carry
+    def tile_update(acc, m, denom, k_blk, v_blk, km, step):
         # after `step` clockwise hops we hold the block born on device my-step
         src = (my - step) % axis_size
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
                        preferred_element_type=jnp.float32) * scale
-        tile_mask = km[:, None, None, :] > 0
+        tile_mask = None if km is None else km[:, None, None, :] > 0
         if causal:
             k_pos = src * s_k + jnp.arange(s_k)
-            tile_mask = tile_mask & (q_pos[:, None] >= k_pos[None, :])[None, None]
-        s = jnp.where(tile_mask, s, _NEG)
+            cmask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            tile_mask = cmask if tile_mask is None else tile_mask & cmask
+        if tile_mask is not None:
+            s = jnp.where(tile_mask, s, _NEG)
         m_new = jnp.maximum(m, s.max(-1))
-        # explicit mask multiply: on an all-masked tile exp(NEG-NEG)=1 would
-        # otherwise inject phantom probability mass
-        p = jnp.exp(s - m_new[..., None]) * tile_mask
+        p = jnp.exp(s - m_new[..., None])
+        if tile_mask is not None:
+            # explicit mask multiply: on an all-masked tile exp(NEG-NEG)=1
+            # would otherwise inject phantom probability mass
+            p = p * tile_mask
         correction = jnp.exp(m - m_new)
         denom = denom * correction + p.sum(-1)
         acc = acc * correction[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-        rotate = functools.partial(jax.lax.ppermute, axis_name=axis_name,
-                                   perm=perm)
-        k_blk, v_blk, km = rotate(k_blk), rotate(v_blk), rotate(km)
-        return (k_blk, v_blk, km, acc, m_new, denom), None
+        return acc, m_new, denom
 
-    (_, _, _, acc, _, denom), _ = jax.lax.scan(
-        hop, (k, v, kmask, acc, m, denom), jnp.arange(axis_size))
+    rotate = functools.partial(jax.lax.ppermute, axis_name=axis_name,
+                               perm=perm)
+
+    # resident block first, then axis_size-1 rotate+compute hops — no
+    # final dead rotation riding the ICI; the mask block only travels
+    # the ring when a mask exists at all
+    if kmask is None:
+        def hop(carry, step):
+            k_blk, v_blk, acc, m, denom = carry
+            k_blk, v_blk = rotate(k_blk), rotate(v_blk)
+            acc, m, denom = tile_update(acc, m, denom, k_blk, v_blk, None, step)
+            return (k_blk, v_blk, acc, m, denom), None
+
+        acc, m, denom = tile_update(acc, m, denom, k, v, None, 0)
+        (_, _, acc, _, denom), _ = jax.lax.scan(
+            hop, (k, v, acc, m, denom), jnp.arange(1, axis_size))
+    else:
+        def hop(carry, step):
+            k_blk, v_blk, km, acc, m, denom = carry
+            k_blk, v_blk, km = rotate(k_blk), rotate(v_blk), rotate(km)
+            acc, m, denom = tile_update(acc, m, denom, k_blk, v_blk, km, step)
+            return (k_blk, v_blk, km, acc, m, denom), None
+
+        acc, m, denom = tile_update(acc, m, denom, k, v, kmask, 0)
+        (_, _, _, acc, _, denom), _ = jax.lax.scan(
+            hop, (k, v, kmask, acc, m, denom), jnp.arange(1, axis_size))
     out = acc / jnp.maximum(denom, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
@@ -129,6 +152,19 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     qkv_spec = P(data_axis, seq_axis, None, None)
     mask_spec = P(data_axis, seq_axis)
 
+    if key_mask is None:
+        # no mask input at all: the unmasked ring skips the per-hop mask
+        # ppermute and the per-tile compare/multiply entirely
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec)
+        def run_unmasked(q, k, v):
+            return ring_attention(q, k, v, axis_name=seq_axis,
+                                  axis_size=axis_size, causal=causal)
+
+        return run_unmasked(q, k, v)
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
@@ -138,6 +174,4 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                               axis_size=axis_size, key_mask=km,
                               causal=causal)
 
-    if key_mask is None:
-        key_mask = jnp.ones(q.shape[:2], q.dtype)
     return run(q, k, v, key_mask)

@@ -4,13 +4,15 @@ The alternative long-context strategy to the ring (DeepSpeed-Ulysses
 pattern): instead of rotating K/V blocks, one ``lax.all_to_all`` converts
 the sequence sharding into a head sharding — every device then runs
 ordinary full attention over the whole sequence for its slice of heads,
-and a second all-to-all restores the sequence sharding. Two collectives
-total (vs ``n-1`` ppermute hops), at the cost of requiring
+and a second all-to-all restores the sequence sharding. Collective count
+is constant in mesh size — four all_to_alls (q, k, v, out) plus an
+all_gather of the key mask when one is supplied — vs the ring's
+``n-1`` hops of three ppermutes each; the trade is requiring
 ``n_heads % axis_size == 0`` and O(S²) score tiles per device.
 
 Ring wins when S is huge (smaller tiles, overlappable hops); Ulysses wins
 at moderate S where collective count dominates. Both are exposed so a
-sequence model can pick per workload (``routest_tpu/models/routeformer.py``).
+sequence model can pick per workload.
 """
 
 from __future__ import annotations
@@ -19,7 +21,6 @@ import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from routest_tpu.core.smap import shard_map
@@ -67,6 +68,19 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     qkv_spec = P(data_axis, seq_axis, None, None)
     mask_spec = P(data_axis, seq_axis)
 
+    if key_mask is None:
+        # no mask input: the per-device program then skips its mask
+        # all_gather entirely
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec)
+        def run_unmasked(q, k, v):
+            return ulysses_attention(q, k, v, axis_name=seq_axis,
+                                     axis_size=axis_size, causal=causal)
+
+        return run_unmasked(q, k, v)
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
@@ -76,6 +90,4 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                                  axis_size=axis_size, key_mask=km,
                                  causal=causal)
 
-    if key_mask is None:
-        key_mask = jnp.ones(q.shape[:2], q.dtype)
     return run(q, k, v, key_mask)

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import Callable, Dict, Iterator, NamedTuple, Optional, Tuple
 
 import jax
