@@ -1,0 +1,219 @@
+"""Fused ETA-MLP inference kernel (Pallas, TPU).
+
+This kernel runs the whole forward — feature expansion, normalization,
+the matmul chain, and the ``pace·dist + overhead`` epilogue — in ONE
+``pallas_call``, so no activation ever round-trips HBM.
+
+**Measured verdict (v5e-8 single chip, 131k-row batches): XLA wins.**
+SURVEY.md §7.1's rule is "a Pallas kernel is justified only if XLA fails
+to fuse — benchmark first"; the benchmark (``bench.py``, device-side
+``fori_loop`` chaining to defeat tunnel dispatch noise) shows the XLA
+path at ~0.63 ms/batch vs ~1.0 ms for this kernel. Ablation explains it:
+XLA already overlaps the VPU epilogue (gelu) of one MXU tile with the
+next tile's matmul, while within a Mosaic program the per-tile
+expansion→matmul→gelu chain serializes VPU against MXU; the kernel's
+MXU-aligned padding (42→128 input lanes) also adds ~35% matmul FLOPs.
+The model is simply small enough that XLA's fusion is already at the
+HBM roofline.
+
+The kernel therefore ships as the *benchmarked alternative*, not the
+default: ``bench.py`` measures both and reports the faster;
+``serve/ml_service.py`` uses it only under ``ROUTEST_FUSED=1``. It
+stays maintained (full parity suite) as the template for the day the
+flagship model outgrows XLA's fusion — deeper trunks shift the balance
+toward VMEM-resident chaining.
+
+Design notes:
+
+- the batch is tiled over the grid; per tile, every intermediate lives in
+  VMEM and only the (tile, 128) input block and output block touch HBM;
+- feature expansion is pure VPU arithmetic — lane-index comparisons build
+  the weekday/hour one-hots in place (no gathers, no lane relayouts);
+- the train-time normalizer is an affine map feeding a linear layer, so
+  ``pack_eta_params`` folds it into the layer-0 weights/bias at pack time:
+  zero runtime cost and serving can never skew from training normalization
+  (the same guarantee ``EtaMLP._expand`` enforces with in-pytree stats);
+- matmuls run on the MXU in the model policy's compute dtype (bfloat16)
+  with float32 accumulation.
+
+Semantics are identical to ``EtaMLP.apply`` on the 12-feature ABI
+(SURVEY.md Appendix B, ``Flaskr/ml.py:35-48``): unknown categories hit
+zero weight rows, distance is clamped non-negative, two softplus heads
+combine as ``eta = pace · distance + overhead``. Parity is enforced by
+``tests/test_ops_fused.py`` against the XLA path, which remains the
+reference implementation and the fallback wherever Pallas is unavailable
+(``serve/ml_service.py`` degrades automatically).
+
+Inference-only by design: training uses the differentiable XLA path, so
+no custom VJP is defined here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from routest_tpu.data.features import N_FEATURES
+
+# Lane layout of the in-kernel expanded feature vector (width = LANES).
+# Chosen so every region starts where VPU masks are cheap; the 32-wide
+# weekday slot (7 real + 25 zero weight rows) keeps hour at a lane
+# boundary. Order differs from EtaMLP._expand's concat — pack_eta_params
+# permutes the trained layer-0 rows to match.
+LANES = 128
+_CAT = (0, 8)        # weather(4) + traffic(4), copied straight from x
+_WD = (8, 40)        # weekday one-hot, lane 8+w
+_HR = (40, 64)       # hour one-hot, lane 40+h
+_DIST = 64           # raw distance_km (normalizer folded into weights)
+_LOGD = 65           # log1p(distance_km)
+_AGE = 66            # raw driver_age (normalizer folded into weights)
+
+# EtaMLP._expand's row order in the trained layer-0 weight matrix.
+_ROW_CAT = (0, 8)
+_ROW_WD = (8, 15)
+_ROW_HR = (15, 39)
+_ROW_DIST, _ROW_LOGD, _ROW_AGE = 39, 40, 41
+
+Packed = Dict[str, List[jax.Array]]
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def pack_eta_params(model, params) -> Packed:
+    """EtaMLP params → kernel-layout weights (a jit-friendly pytree).
+
+    Layer 0 is re-rowed to the kernel's lane layout with the normalizer
+    folded in: ``(d - mean)/std`` feeding a linear layer is the same as
+    scaling the weight row by ``1/std`` and shifting the bias by
+    ``-mean/std · row``. All dims pad up to multiples of 128 (MXU tiles);
+    padding rows/cols are zero so they are exact no-ops through gelu.
+    """
+    layers = params["layers"]
+    norm = params["norm"]
+    mean = np.asarray(norm["mean"], np.float32)
+    std = np.asarray(norm["std"], np.float32)
+    compute = model.policy.compute_dtype
+
+    ws: List[jax.Array] = []
+    bs: List[jax.Array] = []
+    for i, layer in enumerate(layers):
+        w = np.asarray(layer["w"], np.float32)
+        b = np.asarray(layer["b"], np.float32)
+        d_in, d_out = w.shape
+        if i == 0:
+            wp = np.zeros((LANES, _round_up(d_out, 128)), np.float32)
+            wp[_CAT[0]:_CAT[1], :d_out] = w[_ROW_CAT[0]:_ROW_CAT[1]]
+            wp[_WD[0]:_WD[0] + (_ROW_WD[1] - _ROW_WD[0]), :d_out] = \
+                w[_ROW_WD[0]:_ROW_WD[1]]
+            wp[_HR[0]:_HR[0] + (_ROW_HR[1] - _ROW_HR[0]), :d_out] = \
+                w[_ROW_HR[0]:_ROW_HR[1]]
+            wp[_DIST, :d_out] = w[_ROW_DIST] / std[10]
+            wp[_LOGD, :d_out] = w[_ROW_LOGD]
+            wp[_AGE, :d_out] = w[_ROW_AGE] / std[11]
+            bp = np.zeros((1, wp.shape[1]), np.float32)
+            bp[0, :d_out] = (b
+                             - (mean[10] / std[10]) * w[_ROW_DIST]
+                             - (mean[11] / std[11]) * w[_ROW_AGE])
+        else:
+            wp = np.zeros((_round_up(d_in, 128), _round_up(d_out, 128)), np.float32)
+            wp[:d_in, :d_out] = w
+            bp = np.zeros((1, wp.shape[1]), np.float32)
+            bp[0, :d_out] = b
+        ws.append(jnp.asarray(wp, compute))
+        bs.append(jnp.asarray(bp, jnp.float32))
+    return {"w": ws, "b": bs}
+
+
+def _kernel(n_layers: int, compute, x_ref, *refs) -> None:
+    """One batch tile: expand → matmul chain → eta, all in VMEM.
+
+    refs = w_0, b_0, …, w_{n-1}, b_{n-1}, out_ref.
+    """
+    out_ref = refs[-1]
+    x = x_ref[:]  # (tile, 128) f32; ABI features in lanes 0:12, rest zero
+    tile = x.shape[0]
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tile, LANES), 1)
+    wd = x[:, 8:9].astype(jnp.int32)
+    hr = x[:, 9:10].astype(jnp.int32)
+    dist = jnp.maximum(x[:, 10:11], 0.0)
+    age = x[:, 11:12]
+
+    # Expanded features via lane masks — pure VPU, no relayouts. Lanes
+    # 12:128 of x are zero, so the lane<8 select keeps only the one-hots.
+    xfull = (
+        jnp.where(lane < _CAT[1], x, 0.0)
+        + ((lane >= _WD[0]) & (lane < _WD[1])
+           & (lane - _WD[0] == wd)).astype(jnp.float32)
+        + ((lane >= _HR[0]) & (lane < _HR[1])
+           & (lane - _HR[0] == hr)).astype(jnp.float32)
+        + jnp.where(lane == _DIST, dist, 0.0)
+        + jnp.where(lane == _LOGD, jnp.log1p(dist), 0.0)
+        + jnp.where(lane == _AGE, age, 0.0)
+    )
+
+    h = xfull.astype(compute)
+    for i in range(n_layers):
+        w_ref, b_ref = refs[2 * i], refs[2 * i + 1]
+        out = jnp.dot(h, w_ref[:], preferred_element_type=jnp.float32)
+        out = out + b_ref[:]
+        if i < n_layers - 1:
+            h = jax.nn.gelu(out).astype(compute)
+    pace = jax.nn.softplus(out[:, 0:1])
+    overhead = jax.nn.softplus(out[:, 1:2])
+    eta = pace * dist + overhead
+    out_ref[:] = jnp.broadcast_to(eta, (tile, LANES))
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def fused_eta_forward(packed: Packed, x: jax.Array, *,
+                      tile: int = 2048, interpret: bool = False) -> jax.Array:
+    """(B, 12) ABI features → (B,) ETA minutes via the fused kernel.
+
+    ``interpret=True`` runs the Pallas interpreter (any backend) — used by
+    the CPU test suite; compiled mode requires a TPU.
+    """
+    ws, bs = packed["w"], packed["b"]
+    n_layers = len(ws)
+    b_rows = x.shape[0]
+    tile = min(tile, _round_up(b_rows, 8))
+    b_pad = _round_up(b_rows, tile)
+
+    xp = jnp.zeros((b_pad, LANES), jnp.float32)
+    xp = xp.at[:b_rows, :N_FEATURES].set(x.astype(jnp.float32))
+
+    wb_specs = []
+    for w, b in zip(ws, bs):
+        wb_specs.append(pl.BlockSpec(w.shape, lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        wb_specs.append(pl.BlockSpec(b.shape, lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+
+    flops = 2 * b_pad * sum(w.shape[0] * w.shape[1] for w in ws)
+    bytes_accessed = (xp.size + b_pad * LANES) * 4 + sum(
+        w.size * w.dtype.itemsize for w in ws)
+    out = pl.pallas_call(
+        functools.partial(_kernel, n_layers, ws[0].dtype),
+        grid=(b_pad // tile,),
+        in_specs=[pl.BlockSpec((tile, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)] + wb_specs,
+        out_specs=pl.BlockSpec((tile, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b_pad, LANES), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        cost_estimate=pl.CostEstimate(
+            flops=flops, bytes_accessed=bytes_accessed,
+            transcendentals=b_pad * (sum(w.shape[1] for w in ws[:-1]) + 2),
+        ),
+        interpret=interpret,
+    )(xp, *[a for pair in zip(ws, bs) for a in pair])
+    return out[:b_rows, 0]

@@ -137,11 +137,19 @@ class EtaService:
         self._error: Optional[str] = None
         self._load(model_path or default_model_path())
         self._batcher: Optional[DynamicBatcher] = None
+        self.kernel = "xla"  # which forward path serves: xla | pallas_fused
         if self.available:
             apply_jit = jax.jit(self._model.apply)
             # load_model returns host numpy arrays; pin them on device once
             # or every scoring call re-uploads the whole param tree.
             if runtime is not None:
+                if os.environ.get("ROUTEST_FUSED") == "1":
+                    from routest_tpu.utils.logging import get_logger
+
+                    get_logger("routest_tpu.serve").warning(
+                        "fused_kernel_ignored",
+                        reason="ROUTEST_FUSED=1 is single-device only; "
+                               "mesh serving uses the sharded XLA path")
                 params = runtime.replicate(self._params)
 
                 def score(x: np.ndarray) -> np.ndarray:
@@ -152,6 +160,7 @@ class EtaService:
                 def score(x: np.ndarray) -> np.ndarray:
                     return apply_jit(params, x)
 
+                score = self._maybe_fused_score(score)
             self._score = score
             self._batcher = DynamicBatcher(
                 score, cfg.batch_buckets, cfg.max_batch, cfg.max_wait_ms,
@@ -171,9 +180,52 @@ class EtaService:
                 self._model = None
                 self._params = None
                 self._batcher = None
+                self.kernel = "xla"  # nothing is serving; don't claim fused
                 # drop the score closure too — it captures the device-pinned
                 # param tree and would hold device memory forever
                 self._score = None
+
+    def _maybe_fused_score(self, fallback):
+        """Opt-in swap to the fused Pallas kernel (``ops/fused_mlp.py``).
+
+        Off by default: head-to-head benchmarking (see the kernel's
+        docstring) shows XLA faster for the current model size, so XLA
+        serves unless ``ROUTEST_FUSED=1``. Probed eagerly with one row:
+        any pack/compile failure (non-TPU backend, unexpected param
+        shapes, Mosaic regressions) keeps the XLA path — the kernel is
+        an optimization, never a dependency.
+        """
+        if os.environ.get("ROUTEST_FUSED") != "1":
+            return fallback
+        if jax.default_backend() != "tpu":
+            # Compiled Mosaic needs a TPU; interpreter mode would "work"
+            # but orders of magnitude slower — never serve it.
+            from routest_tpu.utils.logging import get_logger
+
+            get_logger("routest_tpu.serve").warning(
+                "fused_kernel_ignored",
+                reason=f"ROUTEST_FUSED=1 needs the TPU backend, "
+                       f"have {jax.default_backend()}; serving XLA")
+            return fallback
+        try:
+            from routest_tpu.ops import fused_eta_forward, pack_eta_params
+
+            packed = jax.device_put(pack_eta_params(self._model, self._params))
+
+            def score(x: np.ndarray) -> np.ndarray:
+                return fused_eta_forward(packed, jax.numpy.asarray(x))
+
+            probe = np.zeros((1, self._model.n_features), np.float32)
+            if not np.isfinite(np.asarray(score(probe))).all():
+                raise ValueError("fused kernel probe produced non-finite output")
+            self.kernel = "pallas_fused"
+            return score
+        except Exception as e:  # pragma: no cover - depends on backend
+            from routest_tpu.utils.logging import get_logger
+
+            get_logger("routest_tpu.serve").warning(
+                "fused_kernel_unavailable", error=f"{type(e).__name__}: {e}")
+            return fallback
 
     def _load(self, path: str) -> None:
         try:
@@ -230,7 +282,8 @@ class EtaService:
 
     @property
     def stats(self) -> dict:
-        base = {"available": self.available, "error": self._error}
+        base = {"available": self.available, "error": self._error,
+                "kernel": self.kernel}
         if self._batcher is not None:
             base.update(self._batcher.stats)
         return base

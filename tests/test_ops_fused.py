@@ -1,0 +1,110 @@
+"""Parity: the fused Pallas ETA kernel vs the XLA reference path.
+
+Runs the kernel in Pallas interpreter mode on the CPU backend (compiled
+mode needs a TPU); ``EtaMLP.apply`` is the semantics oracle. Covers the
+ABI edge cases the kernel re-implements: unknown-category all-zero
+one-hots, negative-distance clamping, normalizer folding, and non-tile
+batch sizes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from routest_tpu.core.dtypes import DEFAULT_POLICY, F32_POLICY
+from routest_tpu.data.features import batch_from_mapping, encode_requests
+from routest_tpu.data.synthetic import generate_dataset
+from routest_tpu.models.eta_mlp import EtaMLP, fit_normalizer
+from routest_tpu.ops import fused_eta_forward, pack_eta_params
+
+
+def _model_and_params(policy=F32_POLICY, hidden=(256, 256, 128), seed=0):
+    model = EtaMLP(hidden=hidden, policy=policy)
+    data = generate_dataset(2048, seed=seed)
+    feats = batch_from_mapping(data)
+    mean, std = fit_normalizer(feats)
+    params = model.init(jax.random.PRNGKey(seed), norm_mean=mean, norm_std=std)
+    return model, params, feats
+
+
+def test_fused_matches_apply_f32():
+    model, params, feats = _model_and_params()
+    packed = pack_eta_params(model, params)
+    want = np.asarray(model.apply(params, feats))
+    got = np.asarray(fused_eta_forward(packed, feats, tile=256, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_fused_matches_apply_bf16_trunk():
+    # Default policy (bf16 matmuls): padding changes summation order, so
+    # allow bf16-scale tolerance; predictions are tens of minutes.
+    model, params, feats = _model_and_params(policy=DEFAULT_POLICY)
+    packed = pack_eta_params(model, params)
+    want = np.asarray(model.apply(params, feats))
+    got = np.asarray(fused_eta_forward(packed, feats, tile=256, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=0.5)
+
+
+def test_fused_odd_batch_sizes():
+    model, params, feats = _model_and_params()
+    packed = pack_eta_params(model, params)
+    for n in (1, 7, 257):
+        want = np.asarray(model.apply(params, feats[:n]))
+        got = np.asarray(fused_eta_forward(packed, feats[:n], tile=128,
+                                           interpret=True))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_fused_unknown_categories_and_negative_distance():
+    model, params, _ = _model_and_params()
+    packed = pack_eta_params(model, params)
+    rows = encode_requests(
+        weather=["Fog", "Sunny", "Cloudy"],       # "Fog" → all-zero group
+        traffic=["Gridlock", "Medium", "Low"],    # "Gridlock" → all-zero
+        weekday=[0, 6, 3],
+        hour=[0, 23, 12],
+        distance_km=[5.0, 12.5, 0.0],
+        driver_age=[30.0, 55.0, 18.0],
+    )
+    rows[2, 10] = -4.0  # malformed negative distance: both paths clamp to 0
+    want = np.asarray(model.apply(params, rows))
+    got = np.asarray(fused_eta_forward(packed, rows, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+    assert np.isfinite(got).all()
+
+
+def test_fused_non_mxu_hidden_dims():
+    # Hidden widths that need padding (not multiples of 128) stay exact:
+    # zero pad rows/cols are no-ops through gelu.
+    model, params, feats = _model_and_params(hidden=(96, 40))
+    packed = pack_eta_params(model, params)
+    want = np.asarray(model.apply(params, feats[:64]))
+    got = np.asarray(fused_eta_forward(packed, feats[:64], interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_packed_weights_fold_normalizer():
+    # Folding check in isolation: distance/age stats with extreme values
+    # still reproduce the oracle (guards the algebra, not just one draw).
+    model, params, feats = _model_and_params()
+    params["norm"]["mean"] = params["norm"]["mean"].at[10].set(37.5).at[11].set(44.0)
+    params["norm"]["std"] = params["norm"]["std"].at[10].set(0.25).at[11].set(9.0)
+    packed = pack_eta_params(model, params)
+    want = np.asarray(model.apply(params, feats[:128]))
+    got = np.asarray(fused_eta_forward(packed, feats[:128], interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("n", [1, 64])
+def test_fused_under_jit_caller(n):
+    # The wrapper must compose with an outer jit (serving wraps it).
+    model, params, feats = _model_and_params()
+    packed = pack_eta_params(model, params)
+
+    @jax.jit
+    def run(x):
+        return fused_eta_forward(packed, x, interpret=True)
+
+    want = np.asarray(model.apply(params, feats[:n]))
+    np.testing.assert_allclose(np.asarray(run(feats[:n])), want,
+                               rtol=1e-4, atol=1e-3)
