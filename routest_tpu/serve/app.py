@@ -26,6 +26,7 @@ from routest_tpu.core.config import Config, load_config
 from routest_tpu.data.locations import locations_table
 from routest_tpu.optimize.engine import optimize_route
 from routest_tpu.serve import sim
+from routest_tpu.serve.auth import AuthService, bearer_token, mount_auth
 from routest_tpu.serve.bus import make_bus, sse_stream
 from routest_tpu.serve.ml_service import EtaService
 from routest_tpu.serve.store import make_store
@@ -39,19 +40,22 @@ class ServerState:
     """Everything the handlers share."""
 
     def __init__(self, config: Config, eta: EtaService, store, bus,
-                 sim_tick_range=(2.0, 5.0)) -> None:
+                 sim_tick_range=(2.0, 5.0), auth: Optional[AuthService] = None) -> None:
         self.config = config
         self.eta = eta
         self.store = store
         self.bus = bus
         self.sim_tick_range = sim_tick_range
+        self.auth = auth if auth is not None else AuthService(
+            required=os.environ.get("ROUTEST_AUTH") == "require")
         self.started = time.time()
 
 
 def create_app(config: Optional[Config] = None,
                eta_service: Optional[EtaService] = None,
                store=None, bus=None,
-               sim_tick_range=(2.0, 5.0)) -> App:
+               sim_tick_range=(2.0, 5.0),
+               auth: Optional[AuthService] = None) -> App:
     config = config or load_config()
     if eta_service is not None:
         eta = eta_service
@@ -64,10 +68,11 @@ def create_app(config: Optional[Config] = None,
         config.serve.supabase_url, config.serve.supabase_service_key
     )
     bus = bus if bus is not None else make_bus(config.serve.redis_url)
-    state = ServerState(config, eta, store, bus, sim_tick_range)
+    state = ServerState(config, eta, store, bus, sim_tick_range, auth)
 
     app = App()
     app.state = state  # for tests / introspection
+    mount_auth(app, state.auth)
 
     # ── optimization ────────────────────────────────────────────────────
 
@@ -245,6 +250,12 @@ def create_app(config: Optional[Config] = None,
 
     @app.route("/api/history/<req_id>", methods=("DELETE",))
     def delete_history(request, req_id):
+        # The one destructive route: bearer-gated when ROUTEST_AUTH=require
+        # (the reference never gated it; SURVEY.md §2.2 notes its auth
+        # scaffold is bypassed at runtime).
+        if state.auth.required and \
+                state.auth.user_for_token(bearer_token(request)) is None:
+            return {"message": "unauthenticated"}, 401
         try:
             deleted = state.store.delete_request(req_id)
         except Exception as e:

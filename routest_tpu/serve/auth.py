@@ -1,0 +1,287 @@
+"""Auth: the Laravel Breeze capability, token-based and hermetic.
+
+The reference ships Laravel's stock Breeze API scaffold
+(``routes/auth.php:11-36`` + ``app/Http/Controllers/Auth/*`` — register,
+login, logout, forgot/reset password, email verification) guarding
+``GET /api/user`` via Sanctum (``routes/api.php:11-14``). At runtime the
+reference bypasses it entirely (SURVEY.md §1: Flask talks to Supabase
+directly), but the capability is part of the component inventory, so it
+exists here as a first-class serving module:
+
+- personal-access-token auth (Sanctum's API mode): ``Authorization:
+  Bearer <token>`` issued at register/login, revoked at logout;
+- PBKDF2-HMAC-SHA256 password hashing (Laravel uses bcrypt; same
+  contract, stdlib-only);
+- password reset and email verification flows are hermetic: where
+  Breeze emails a link, these endpoints RETURN the token/link payload
+  directly — no SMTP dependency, same state machine. The verify-email
+  hash is sha1(email), matching Laravel's signed-URL ingredient.
+
+Status-code parity with Breeze: validation failures are 422 (including
+bad credentials — Laravel's ValidationException), missing/invalid
+bearer tokens are 401, logout and verification success are 204/200.
+
+Auth stays OFF the data-plane endpoints by default (the reference's
+runtime behavior). ``ROUTEST_AUTH=require`` turns on bearer enforcement
+for the destructive route (``DELETE /api/history/<id>``), the gate the
+reference never built.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import hashlib
+import hmac
+import secrets
+import threading
+import uuid
+from typing import Dict, Optional, Tuple
+
+_PBKDF2_ITERS = 60_000
+_RESET_TTL_S = 3600.0
+_MAX_TOKENS_PER_USER = 16  # oldest sessions evicted beyond this
+
+
+def _hash_password(password: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt, _PBKDF2_ITERS)
+
+
+def verify_email_hash(email: str) -> str:
+    """Laravel's verification-URL hash ingredient: sha1 of the email."""
+    return hashlib.sha1(email.encode()).hexdigest()
+
+
+class AuthService:
+    """In-memory user/token store with the Breeze state machine.
+
+    Thread-safe (the dev server is threaded); hermetic by design, like
+    ``InMemoryStore`` — a PostgREST-backed variant would slot in behind
+    the same interface the way ``store.py`` does it.
+    """
+
+    def __init__(self, required: bool = False) -> None:
+        self.required = required
+        self._lock = threading.Lock()
+        self._users: Dict[str, dict] = {}          # email -> user row
+        self._tokens: Dict[str, str] = {}          # bearer token -> email
+        self._resets: Dict[str, Tuple[str, float]] = {}  # token -> (email, expiry)
+
+    # ── registration / login ───────────────────────────────────────────
+
+    def register(self, name: str, email: str, password: str) -> Tuple[dict, str]:
+        """Create a user and issue a token. Raises ValueError on invalid
+        input or duplicate email (both 422 in Breeze)."""
+        if not name or not email or "@" not in email:
+            raise ValueError("name and a valid email are required")
+        if not password or len(password) < 8:
+            raise ValueError("password must be at least 8 characters")
+        # Hash outside the lock: PBKDF2 is tens of ms and must not
+        # serialize every concurrent auth operation behind it.
+        salt = secrets.token_bytes(16)
+        password_hash = _hash_password(password, salt)
+        with self._lock:
+            if email in self._users:
+                raise ValueError("email already registered")
+            user = {
+                "id": str(uuid.uuid4()),
+                "name": name,
+                "email": email,
+                "salt": salt,
+                "password_hash": password_hash,
+                "email_verified_at": None,
+                "created_at": dt.datetime.now(dt.timezone.utc).isoformat(),
+            }
+            self._users[email] = user
+            token = self._issue_token_locked(email)
+        return self._public(user), token
+
+    def login(self, email: str, password: str) -> Tuple[dict, str]:
+        """Raises ValueError on bad credentials (Breeze: 422 auth.failed)."""
+        with self._lock:
+            user = self._users.get(email or "")
+            # Snapshot the credentials; hash outside the lock (see register).
+            salt = user["salt"] if user else b"\0" * 16
+            want = user["password_hash"] if user else b""
+        got = _hash_password(password or "", salt)
+        if user is None or not hmac.compare_digest(want, got):
+            raise ValueError("these credentials do not match our records")
+        with self._lock:
+            # Password may have rotated between hash and issue; re-check.
+            current = self._users.get(email)
+            if current is None or current["password_hash"] != want:
+                raise ValueError("these credentials do not match our records")
+            token = self._issue_token_locked(email)
+        return self._public(user), token
+
+    def logout(self, token: str) -> bool:
+        with self._lock:
+            return self._tokens.pop(token, None) is not None
+
+    def user_for_token(self, token: Optional[str]) -> Optional[dict]:
+        with self._lock:
+            email = self._tokens.get(token or "")
+            user = self._users.get(email) if email else None
+            return self._public(user) if user else None
+
+    # ── password reset ─────────────────────────────────────────────────
+
+    def forgot_password(self, email: str, *, now: Optional[float] = None) -> Optional[str]:
+        """Issue a reset token; None for unknown emails (Breeze responds
+        identically either way, to avoid account enumeration)."""
+        import time
+
+        t = now or time.time()
+        with self._lock:
+            # Prune expired entries and invalidate the user's previous
+            # token (Laravel keeps at most one live reset per user) —
+            # keeps _resets bounded on a long-running server.
+            self._resets = {k: v for k, v in self._resets.items()
+                            if v[1] > t and v[0] != email}
+            if email not in self._users:
+                return None
+            token = secrets.token_urlsafe(32)
+            self._resets[token] = (email, t + _RESET_TTL_S)
+            return token
+
+    def reset_password(self, token: str, email: str, password: str,
+                       *, now: Optional[float] = None) -> None:
+        """Raises ValueError on invalid/expired/mismatched token."""
+        import time
+
+        if not password or len(password) < 8:
+            raise ValueError("password must be at least 8 characters")
+        salt = secrets.token_bytes(16)
+        password_hash = _hash_password(password, salt)  # outside the lock
+        with self._lock:
+            entry = self._resets.get(token or "")
+            if entry is None or entry[0] != email or (now or time.time()) > entry[1]:
+                raise ValueError("this password reset token is invalid")
+            del self._resets[token]
+            user = self._users[email]
+            user["salt"] = salt
+            user["password_hash"] = password_hash
+            # Laravel revokes existing sessions on reset.
+            for t in [t for t, e in self._tokens.items() if e == email]:
+                del self._tokens[t]
+
+    # ── email verification ─────────────────────────────────────────────
+
+    def verify_email(self, token: str, user_id: str, email_hash: str) -> bool:
+        """Mark the bearer's email verified if id+hash match (the two
+        ingredients of Laravel's signed verification URL)."""
+        with self._lock:
+            email = self._tokens.get(token or "")
+            user = self._users.get(email) if email else None
+            if user is None:
+                raise PermissionError("unauthenticated")
+            if user["id"] != user_id or \
+                    not hmac.compare_digest(verify_email_hash(email), email_hash):
+                raise ValueError("invalid verification link")
+            user["email_verified_at"] = dt.datetime.now(dt.timezone.utc).isoformat()
+            return True
+
+    # ── helpers ────────────────────────────────────────────────────────
+
+    def _issue_token_locked(self, email: str) -> str:
+        # Cap live sessions per user (dicts iterate in insertion order,
+        # so the first matches are the oldest): bounds _tokens on a
+        # long-running server instead of growing one entry per login.
+        mine = [t for t, e in self._tokens.items() if e == email]
+        for stale in mine[: max(0, len(mine) + 1 - _MAX_TOKENS_PER_USER)]:
+            del self._tokens[stale]
+        token = secrets.token_urlsafe(40)
+        self._tokens[token] = email
+        return token
+
+    @staticmethod
+    def _public(user: dict) -> dict:
+        return {k: user[k] for k in
+                ("id", "name", "email", "email_verified_at", "created_at")}
+
+
+def bearer_token(request) -> Optional[str]:
+    header = request.headers.get("Authorization", "")
+    return header[7:] if header.startswith("Bearer ") else None
+
+
+def mount_auth(app, auth: AuthService) -> None:
+    """Register the Breeze-parity endpoints on the serving app."""
+    from routest_tpu.serve.wsgi import get_json
+
+    @app.route("/api/auth/register", methods=("POST",))
+    def register(request):
+        body = get_json(request) or {}
+        try:
+            user, token = auth.register(
+                str(body.get("name") or ""), str(body.get("email") or ""),
+                str(body.get("password") or ""))
+        except ValueError as e:
+            return {"message": str(e), "errors": {"email": [str(e)]}}, 422
+        return {"user": user, "token": token}, 201
+
+    @app.route("/api/auth/login", methods=("POST",))
+    def login(request):
+        body = get_json(request) or {}
+        try:
+            user, token = auth.login(str(body.get("email") or ""),
+                                     str(body.get("password") or ""))
+        except ValueError as e:
+            return {"message": str(e), "errors": {"email": [str(e)]}}, 422
+        return {"user": user, "token": token}, 200
+
+    @app.route("/api/auth/logout", methods=("POST",))
+    def logout(request):
+        if not auth.logout(bearer_token(request) or ""):
+            return {"message": "unauthenticated"}, 401
+        from werkzeug.wrappers import Response
+
+        return Response("", 204)
+
+    @app.route("/api/user", methods=("GET",))
+    def current_user(request):
+        user = auth.user_for_token(bearer_token(request))
+        if user is None:
+            return {"message": "unauthenticated"}, 401
+        return user, 200
+
+    @app.route("/api/auth/forgot-password", methods=("POST",))
+    def forgot_password(request):
+        body = get_json(request) or {}
+        token = auth.forgot_password(str(body.get("email") or ""))
+        # Hermetic stand-in for the reset email; same anti-enumeration
+        # message either way, token only when the account exists.
+        payload = {"status": "We have emailed your password reset link."}
+        if token is not None:
+            payload["reset_token"] = token
+        return payload, 200
+
+    @app.route("/api/auth/reset-password", methods=("POST",))
+    def reset_password(request):
+        body = get_json(request) or {}
+        try:
+            auth.reset_password(str(body.get("token") or ""),
+                                str(body.get("email") or ""),
+                                str(body.get("password") or ""))
+        except ValueError as e:
+            return {"message": str(e), "errors": {"email": [str(e)]}}, 422
+        return {"status": "Your password has been reset."}, 200
+
+    @app.route("/api/auth/email/verification-notification", methods=("POST",))
+    def send_verification(request):
+        user = auth.user_for_token(bearer_token(request))
+        if user is None:
+            return {"message": "unauthenticated"}, 401
+        # Hermetic stand-in for the verification email.
+        return {"status": "verification-link-sent",
+                "verify_url": f"/api/auth/verify-email/{user['id']}/"
+                              f"{verify_email_hash(user['email'])}"}, 200
+
+    @app.route("/api/auth/verify-email/<user_id>/<email_hash>", methods=("GET",))
+    def verify_email(request, user_id, email_hash):
+        try:
+            auth.verify_email(bearer_token(request) or "", user_id, email_hash)
+        except PermissionError:
+            return {"message": "unauthenticated"}, 401
+        except ValueError as e:
+            return {"message": str(e)}, 403
+        return {"verified": True}, 200

@@ -1,0 +1,189 @@
+"""Auth-layer tests: the Breeze state machine (register/login/logout,
+password reset, email verification) and the opt-in bearer gate on the
+destructive history route. Status-code parity per ``serve/auth.py``."""
+
+import jax
+import pytest
+from werkzeug.test import Client
+
+from routest_tpu.core.config import Config, ServeConfig
+from routest_tpu.core.dtypes import F32_POLICY
+from routest_tpu.models.eta_mlp import EtaMLP
+from routest_tpu.serve.app import create_app
+from routest_tpu.serve.auth import AuthService, verify_email_hash
+from routest_tpu.serve.ml_service import EtaService
+from routest_tpu.train.checkpoint import save_model
+
+
+@pytest.fixture(scope="module")
+def model_artifact(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("model") / "eta.msgpack")
+    model = EtaMLP(hidden=(8,), policy=F32_POLICY)
+    save_model(path, model, model.init(jax.random.PRNGKey(0)))
+    return path
+
+
+@pytest.fixture()
+def client(model_artifact):
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    return Client(create_app(Config(), eta_service=eta))
+
+
+def _register(client, email="ana@example.com", password="s3cretpass"):
+    return client.post("/api/auth/register", json={
+        "name": "Ana", "email": email, "password": password})
+
+
+def test_register_login_user_logout_flow(client):
+    r = _register(client)
+    assert r.status_code == 201
+    token = r.get_json()["token"]
+    assert r.get_json()["user"]["email"] == "ana@example.com"
+    assert "password_hash" not in r.get_json()["user"]
+
+    r = client.get("/api/user", headers={"Authorization": f"Bearer {token}"})
+    assert r.status_code == 200 and r.get_json()["name"] == "Ana"
+
+    r = client.post("/api/auth/login", json={
+        "email": "ana@example.com", "password": "s3cretpass"})
+    assert r.status_code == 200
+    token2 = r.get_json()["token"]
+    assert token2 != token  # each login issues a fresh personal token
+
+    r = client.post("/api/auth/logout",
+                    headers={"Authorization": f"Bearer {token}"})
+    assert r.status_code == 204
+    r = client.get("/api/user", headers={"Authorization": f"Bearer {token}"})
+    assert r.status_code == 401  # revoked
+    r = client.get("/api/user", headers={"Authorization": f"Bearer {token2}"})
+    assert r.status_code == 200  # other session intact
+
+
+def test_register_validation_and_duplicates(client):
+    assert _register(client, email="bad-email").status_code == 422
+    assert _register(client, password="short").status_code == 422
+    assert _register(client).status_code == 201
+    r = _register(client)  # duplicate
+    assert r.status_code == 422
+    assert "errors" in r.get_json()
+
+
+def test_login_bad_credentials(client):
+    _register(client)
+    r = client.post("/api/auth/login", json={
+        "email": "ana@example.com", "password": "wrongpass1"})
+    assert r.status_code == 422
+    r = client.post("/api/auth/login", json={
+        "email": "nobody@example.com", "password": "whatever12"})
+    assert r.status_code == 422
+
+
+def test_unauthenticated_user_and_logout(client):
+    assert client.get("/api/user").status_code == 401
+    assert client.post("/api/auth/logout").status_code == 401
+    assert client.get("/api/user",
+                      headers={"Authorization": "Bearer bogus"}).status_code == 401
+
+
+def test_password_reset_flow(client):
+    _register(client)
+    r = client.post("/api/auth/forgot-password",
+                    json={"email": "ana@example.com"})
+    assert r.status_code == 200
+    token = r.get_json()["reset_token"]
+
+    # Unknown email: same message, no token (anti-enumeration).
+    r = client.post("/api/auth/forgot-password",
+                    json={"email": "nobody@example.com"})
+    assert r.status_code == 200 and "reset_token" not in r.get_json()
+
+    r = client.post("/api/auth/reset-password", json={
+        "token": token, "email": "ana@example.com", "password": "newpass123"})
+    assert r.status_code == 200
+    # Old password dead, new one works; token is single-use.
+    assert client.post("/api/auth/login", json={
+        "email": "ana@example.com", "password": "s3cretpass"}).status_code == 422
+    assert client.post("/api/auth/login", json={
+        "email": "ana@example.com", "password": "newpass123"}).status_code == 200
+    r = client.post("/api/auth/reset-password", json={
+        "token": token, "email": "ana@example.com", "password": "again12345"})
+    assert r.status_code == 422
+
+
+def test_reset_revokes_existing_sessions(client):
+    token = _register(client).get_json()["token"]
+    reset = client.post("/api/auth/forgot-password",
+                        json={"email": "ana@example.com"}).get_json()["reset_token"]
+    client.post("/api/auth/reset-password", json={
+        "token": reset, "email": "ana@example.com", "password": "newpass123"})
+    assert client.get("/api/user",
+                      headers={"Authorization": f"Bearer {token}"}).status_code == 401
+
+
+def test_email_verification_flow(client):
+    r = _register(client)
+    token = r.get_json()["token"]
+    user = r.get_json()["user"]
+    assert user["email_verified_at"] is None
+
+    r = client.post("/api/auth/email/verification-notification",
+                    headers={"Authorization": f"Bearer {token}"})
+    assert r.status_code == 200
+    url = r.get_json()["verify_url"]
+    assert url.endswith(verify_email_hash("ana@example.com"))
+
+    assert client.get(url).status_code == 401  # needs the bearer
+    r = client.get(url, headers={"Authorization": f"Bearer {token}"})
+    assert r.status_code == 200 and r.get_json()["verified"] is True
+    r = client.get("/api/user", headers={"Authorization": f"Bearer {token}"})
+    assert r.get_json()["email_verified_at"] is not None
+
+    bad = f"/api/auth/verify-email/{user['id']}/deadbeef"
+    assert client.get(bad, headers={
+        "Authorization": f"Bearer {token}"}).status_code == 403
+
+
+def test_auth_required_gates_history_delete(model_artifact):
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    app = create_app(Config(), eta_service=eta,
+                     auth=AuthService(required=True))
+    client = Client(app)
+    assert client.delete("/api/history/some-id").status_code == 401
+
+    token = _register(client).get_json()["token"]
+    # Authenticated: passes the gate, hits the store (404: no such row).
+    r = client.delete("/api/history/some-id",
+                      headers={"Authorization": f"Bearer {token}"})
+    assert r.status_code == 404
+
+
+def test_second_forgot_invalidates_first_reset_token(client):
+    _register(client)
+    t1 = client.post("/api/auth/forgot-password",
+                     json={"email": "ana@example.com"}).get_json()["reset_token"]
+    t2 = client.post("/api/auth/forgot-password",
+                     json={"email": "ana@example.com"}).get_json()["reset_token"]
+    r = client.post("/api/auth/reset-password", json={
+        "token": t1, "email": "ana@example.com", "password": "newpass123"})
+    assert r.status_code == 422  # superseded, Laravel-style one-live-token
+    r = client.post("/api/auth/reset-password", json={
+        "token": t2, "email": "ana@example.com", "password": "newpass123"})
+    assert r.status_code == 200
+
+
+def test_session_cap_evicts_oldest_token():
+    from routest_tpu.serve import auth as auth_mod
+
+    svc = auth_mod.AuthService()
+    _, first = svc.register("Ana", "ana@example.com", "s3cretpass")
+    tokens = [svc.login("ana@example.com", "s3cretpass")[1]
+              for _ in range(auth_mod._MAX_TOKENS_PER_USER)]
+    assert svc.user_for_token(first) is None      # oldest evicted
+    assert svc.user_for_token(tokens[-1]) is not None
+    live = [t for t in [first] + tokens if svc.user_for_token(t)]
+    assert len(live) == auth_mod._MAX_TOKENS_PER_USER
+
+
+def test_auth_off_by_default_keeps_reference_behavior(client):
+    # The reference never gates the data plane; default must match.
+    assert client.delete("/api/history/missing").status_code == 404
