@@ -26,7 +26,8 @@ from routest_tpu.core.config import Config, load_config
 from routest_tpu.data.locations import locations_table
 from routest_tpu.optimize.engine import optimize_route
 from routest_tpu.serve import sim
-from routest_tpu.serve.auth import AuthService, bearer_token, mount_auth
+from routest_tpu.serve import auth as auth_mod
+from routest_tpu.serve.auth import AuthService, mount_auth
 from routest_tpu.serve.bus import make_bus, sse_stream
 from routest_tpu.serve.ml_service import EtaService
 from routest_tpu.serve.store import make_store
@@ -253,9 +254,8 @@ def create_app(config: Optional[Config] = None,
         # The one destructive route: bearer-gated when ROUTEST_AUTH=require
         # (the reference never gated it; SURVEY.md §2.2 notes its auth
         # scaffold is bypassed at runtime).
-        if state.auth.required and \
-                state.auth.user_for_token(bearer_token(request)) is None:
-            return {"message": "unauthenticated"}, 401
+        if state.auth.required and state.auth.user_from_request(request) is None:
+            return auth_mod.UNAUTHENTICATED
         try:
             deleted = state.store.delete_request(req_id)
         except Exception as e:
@@ -271,17 +271,28 @@ def create_app(config: Optional[Config] = None,
         # Laravel parity (``routes/api.php:7-9``): plain array of rows.
         return locations_table(), 200
 
-    # ── dashboard (the map-app capability, served hermetically) ────────
+    # ── pages (the map-app capability, served hermetically) ────────────
+    # Same layout as the reference frontend: "/" = MVP point-to-point map
+    # (app/page.js), "/ui" = dispatch dashboard (app/ui/page.jsx),
+    # "/health" = status page (app/health/page.jsx).
 
-    _dashboard_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   "static", "dashboard.html")
-    with open(_dashboard_path, "rb") as f:
-        _dashboard_html = f.read()  # immutable asset: read once, serve cached
+    _static_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
+    _pages = {}
+    for _name in ("dashboard", "mvp", "health"):
+        with open(os.path.join(_static_dir, _name + ".html"), "rb") as f:
+            _pages[_name] = f.read()  # immutable assets: read once, serve cached
+
+    @app.route("/", methods=("GET",))
+    def mvp_page(request):
+        return Response(_pages["mvp"], mimetype="text/html")
 
     @app.route("/ui", methods=("GET",))
-    @app.route("/", methods=("GET",))
     def dashboard(request):
-        return Response(_dashboard_html, mimetype="text/html")
+        return Response(_pages["dashboard"], mimetype="text/html")
+
+    @app.route("/health", methods=("GET",))
+    def health_page(request):
+        return Response(_pages["health"], mimetype="text/html")
 
     @app.route("/api/ping", methods=("GET",))
     def ping(request):

@@ -123,6 +123,10 @@ class AuthService:
             user = self._users.get(email) if email else None
             return self._public(user) if user else None
 
+    def user_from_request(self, request) -> Optional[dict]:
+        """The one bearer-auth guard: resolve the request's token, or None."""
+        return self.user_for_token(bearer_token(request))
+
     # ── password reset ─────────────────────────────────────────────────
 
     def forgot_password(self, email: str, *, now: Optional[float] = None) -> Optional[str]:
@@ -204,6 +208,16 @@ def bearer_token(request) -> Optional[str]:
     return header[7:] if header.startswith("Bearer ") else None
 
 
+UNAUTHENTICATED = ({"message": "unauthenticated"}, 401)
+
+
+def validation_error(e: Exception):
+    """Breeze-shaped 422 with the message keyed under the field it names."""
+    msg = str(e)
+    field = "password" if "password" in msg else "email"
+    return {"message": msg, "errors": {field: [msg]}}, 422
+
+
 def mount_auth(app, auth: AuthService) -> None:
     """Register the Breeze-parity endpoints on the serving app."""
     from routest_tpu.serve.wsgi import get_json
@@ -216,7 +230,7 @@ def mount_auth(app, auth: AuthService) -> None:
                 str(body.get("name") or ""), str(body.get("email") or ""),
                 str(body.get("password") or ""))
         except ValueError as e:
-            return {"message": str(e), "errors": {"email": [str(e)]}}, 422
+            return validation_error(e)
         return {"user": user, "token": token}, 201
 
     @app.route("/api/auth/login", methods=("POST",))
@@ -226,22 +240,22 @@ def mount_auth(app, auth: AuthService) -> None:
             user, token = auth.login(str(body.get("email") or ""),
                                      str(body.get("password") or ""))
         except ValueError as e:
-            return {"message": str(e), "errors": {"email": [str(e)]}}, 422
+            return validation_error(e)
         return {"user": user, "token": token}, 200
 
     @app.route("/api/auth/logout", methods=("POST",))
     def logout(request):
         if not auth.logout(bearer_token(request) or ""):
-            return {"message": "unauthenticated"}, 401
+            return UNAUTHENTICATED
         from werkzeug.wrappers import Response
 
         return Response("", 204)
 
     @app.route("/api/user", methods=("GET",))
     def current_user(request):
-        user = auth.user_for_token(bearer_token(request))
+        user = auth.user_from_request(request)
         if user is None:
-            return {"message": "unauthenticated"}, 401
+            return UNAUTHENTICATED
         return user, 200
 
     @app.route("/api/auth/forgot-password", methods=("POST",))
@@ -263,14 +277,14 @@ def mount_auth(app, auth: AuthService) -> None:
                                 str(body.get("email") or ""),
                                 str(body.get("password") or ""))
         except ValueError as e:
-            return {"message": str(e), "errors": {"email": [str(e)]}}, 422
+            return validation_error(e)
         return {"status": "Your password has been reset."}, 200
 
     @app.route("/api/auth/email/verification-notification", methods=("POST",))
     def send_verification(request):
-        user = auth.user_for_token(bearer_token(request))
+        user = auth.user_from_request(request)
         if user is None:
-            return {"message": "unauthenticated"}, 401
+            return UNAUTHENTICATED
         # Hermetic stand-in for the verification email.
         return {"status": "verification-link-sent",
                 "verify_url": f"/api/auth/verify-email/{user['id']}/"
@@ -281,7 +295,7 @@ def mount_auth(app, auth: AuthService) -> None:
         try:
             auth.verify_email(bearer_token(request) or "", user_id, email_hash)
         except PermissionError:
-            return {"message": "unauthenticated"}, 401
+            return UNAUTHENTICATED
         except ValueError as e:
             return {"message": str(e)}, 403
         return {"verified": True}, 200

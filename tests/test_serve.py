@@ -319,10 +319,15 @@ def test_metrics_counts_unhandled_exceptions(model_artifact):
     assert routes["GET /api/boom"]["errors"] == 1
 
 
-def test_dashboard_served(client):
-    for path in ("/", "/ui"):
+def test_pages_served(client):
+    # Reference frontend layout: "/" MVP map, "/ui" dashboard, "/health"
+    # status page (SURVEY.md §2.3).
+    for path, marker in (("/", "request_route"), ("/ui", "realtime_feed"),
+                         ("/health", "api/health")):
         r = client.get(path)
         assert r.status_code == 200
         assert "text/html" in r.headers["Content-Type"]
         body = r.get_data(as_text=True)
-        assert "routest-tpu" in body and "realtime_feed" in body
+        assert "routest-tpu" in body and marker in body
+    # Dashboard keeps the history CSV export (history/page.jsx:73-107).
+    assert "route_history.csv" in client.get("/ui").get_data(as_text=True)
