@@ -119,9 +119,19 @@ def encode_request(
 def batch_from_mapping(batch: Mapping[str, np.ndarray]) -> np.ndarray:
     """Dataset-dict (synthetic.py schema) → (N, 12) features.
 
-    Pure numpy: this is the host-side featurization used by the training
-    loop and the CPU baseline — no device round-trip for a one-hot/concat.
+    Host-side featurization used by the training loop, the serving
+    batcher, and the CPU baseline — no device round-trip for a
+    one-hot/concat. Uses the native encoder (``routest_tpu/native``,
+    single C pass) when the toolchain is available, numpy otherwise;
+    ``ROUTEST_NATIVE=0`` forces numpy.
     """
+    from routest_tpu import native
+
+    if native.available():
+        return native.encode_batch(
+            np.asarray(batch["weather_idx"]), np.asarray(batch["traffic_idx"]),
+            np.asarray(batch["weekday"]), np.asarray(batch["hour"]),
+            np.asarray(batch["distance_km"]), np.asarray(batch["driver_age"]))
     w = np.asarray(batch["weather_idx"], dtype=np.int64)
     t = np.asarray(batch["traffic_idx"], dtype=np.int64)
     n = len(w)

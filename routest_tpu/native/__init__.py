@@ -1,0 +1,154 @@
+"""ctypes bindings for the native data-plane library (fastfeat.cpp).
+
+Build-on-first-use: ``load()`` compiles the shared library with g++ into
+a content-addressed cache (so edits to the .cpp invalidate stale builds)
+and binds the C ABI. Everything here degrades gracefully — ``load()``
+returns None when no toolchain is available and callers fall back to the
+numpy implementations, keeping the framework pure-Python-installable
+(SURVEY.md §2: the reference has zero native components; this library is
+additive runtime, never a dependency).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fastfeat.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("ROUTEST_NATIVE_CACHE") or os.path.join(
+        tempfile.gettempdir(), "routest_tpu_native")
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"fastfeat-{tag}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
+        return out
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The bound library, building it if needed; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("ROUTEST_NATIVE") == "0":
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.ff_abi_version.restype = ctypes.c_int
+        lib.ff_encode_batch.argtypes = [
+            i32p, i32p, i32p, i32p, f32p, f32p, ctypes.c_int64, f32p]
+        lib.ff_count_rows.argtypes = [ctypes.c_char_p]
+        lib.ff_count_rows.restype = ctypes.c_int64
+        lib.ff_parse_csv.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int64,
+            i32p, i32p, i32p, i32p, f32p, f32p, f32p,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.ff_parse_csv.restype = ctypes.c_int64
+        if lib.ff_abi_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def encode_batch(weather_idx: np.ndarray, traffic_idx: np.ndarray,
+                 weekday: np.ndarray, hour: np.ndarray,
+                 distance_km: np.ndarray, driver_age: np.ndarray) -> np.ndarray:
+    """Native 12-feature encode; caller guarantees ``available()``."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    n = len(weather_idx)
+    out = np.empty((n, 12), np.float32)
+    lib.ff_encode_batch(
+        np.ascontiguousarray(weather_idx, np.int32),
+        np.ascontiguousarray(traffic_idx, np.int32),
+        np.ascontiguousarray(weekday, np.int32),
+        np.ascontiguousarray(hour, np.int32),
+        np.ascontiguousarray(distance_km, np.float32),
+        np.ascontiguousarray(driver_age, np.float32),
+        n, out)
+    return out
+
+
+def _pack_vocab(vocab) -> bytes:
+    return b"".join(v.encode() + b"\0" for v in vocab)
+
+
+def parse_csv(path: str, weather_vocab, traffic_vocab):
+    """Native CSV ingest → dataset-dict columns. Caller guarantees
+    ``available()``. Raises ValueError with the offending line on
+    malformed rows (same contract as the Python fallback)."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    cap = lib.ff_count_rows(path.encode())
+    if cap < 0:
+        raise FileNotFoundError(path)
+    cols = {
+        "weather_idx": np.empty(cap, np.int32),
+        "traffic_idx": np.empty(cap, np.int32),
+        "weekday": np.empty(cap, np.int32),
+        "hour": np.empty(cap, np.int32),
+        "distance_km": np.empty(cap, np.float32),
+        "driver_age": np.empty(cap, np.float32),
+        "eta_minutes": np.empty(cap, np.float32),
+    }
+    err_line = ctypes.c_int64(0)
+    n = lib.ff_parse_csv(
+        path.encode(),
+        _pack_vocab(weather_vocab), len(weather_vocab),
+        _pack_vocab(traffic_vocab), len(traffic_vocab),
+        cap,
+        cols["weather_idx"], cols["traffic_idx"], cols["weekday"],
+        cols["hour"], cols["distance_km"], cols["driver_age"],
+        cols["eta_minutes"], ctypes.byref(err_line))
+    if n == -1:
+        raise FileNotFoundError(path)
+    if n == -2:
+        raise ValueError(f"{path}:{err_line.value}: expected 7 fields")
+    if n == -3:
+        raise ValueError(f"{path}:{err_line.value}: non-numeric field")
+    return {k: v[:n] for k, v in cols.items()}

@@ -1,0 +1,193 @@
+// fastfeat: native data-plane for routest_tpu.
+//
+// The reference outsources its data pipeline entirely (data/ and
+// notebooks/ are empty; one pandas row per HTTP request in
+// Flaskr/ml.py:35-51). This framework's training/serving pipeline is
+// host-side numpy by default; this library is the native runtime for the
+// two hot host paths, bound via ctypes (routest_tpu/native/__init__.py):
+//
+//   ff_encode_batch  — categorical/scalar columns -> the 12-feature ABI
+//                      matrix (SURVEY.md Appendix B), row-major f32.
+//   ff_parse_csv     — delivery-history CSV -> column arrays, one pass,
+//                      no per-row Python objects. Schema documented in
+//                      routest_tpu/data/csv_io.py.
+//
+// Plain C ABI (extern "C"), no Python.h dependency: the same .so loads
+// from any runtime. Built on demand by native/build.py with g++ -O3.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// ── feature encoding ────────────────────────────────────────────────────
+// Column order (SURVEY.md Appendix B, Flaskr/ml.py:35-48):
+//   weather_{Cloudy,Stormy,Sunny,Windy}, traffic_{High,Jam,Low,Medium},
+//   weekday_ordered, hour_ordered, distance_km, driver_age
+// weather_idx/traffic_idx use -1 for unknown categories => all-zero group.
+void ff_encode_batch(const int32_t* weather_idx, const int32_t* traffic_idx,
+                     const int32_t* weekday, const int32_t* hour,
+                     const float* distance_km, const float* driver_age,
+                     int64_t n, float* out /* n x 12, row-major */) {
+    for (int64_t i = 0; i < n; ++i) {
+        float* row = out + i * 12;
+        memset(row, 0, 12 * sizeof(float));
+        const int32_t w = weather_idx[i];
+        if (w >= 0 && w < 4) row[w] = 1.0f;
+        const int32_t t = traffic_idx[i];
+        if (t >= 0 && t < 4) row[4 + t] = 1.0f;
+        row[8] = (float)weekday[i];
+        row[9] = (float)hour[i];
+        row[10] = distance_km[i];
+        row[11] = driver_age[i];
+    }
+}
+
+// ── CSV ingest ──────────────────────────────────────────────────────────
+// Expected header (validated by the Python wrapper):
+//   weather,traffic,weekday,hour,distance_km,driver_age,eta_minutes
+// weather/traffic are category NAMES; this parser maps them against the
+// vocab tables passed in (entries are NUL-separated, count given), with
+// unknown -> -1, matching vocab_index() in data/features.py.
+
+struct FFVocab {
+    const char* entries[16];
+    int count;
+};
+
+static void ff_build_vocab(FFVocab* v, const char* packed, int count) {
+    v->count = count > 16 ? 16 : count;
+    const char* p = packed;
+    for (int i = 0; i < v->count; ++i) {
+        v->entries[i] = p;
+        p += strlen(p) + 1;
+    }
+}
+
+static int ff_vocab_lookup(const FFVocab* v, const char* s, int len) {
+    for (int i = 0; i < v->count; ++i) {
+        if ((int)strlen(v->entries[i]) == len &&
+            memcmp(v->entries[i], s, (size_t)len) == 0)
+            return i;
+    }
+    return -1;
+}
+
+// Counts data rows (lines after the first non-empty line). Returns -1 on
+// open failure. Lets the caller allocate exact-size numpy arrays.
+int64_t ff_count_rows(const char* path) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    int64_t lines = 0;
+    char buf[1 << 16];
+    size_t got;
+    char last = '\n';
+    while ((got = fread(buf, 1, sizeof(buf), f)) > 0) {
+        for (size_t i = 0; i < got; ++i)
+            if (buf[i] == '\n') ++lines;
+        last = buf[got - 1];
+    }
+    fclose(f);
+    if (last != '\n') ++lines;        // unterminated final line
+    return lines > 0 ? lines - 1 : 0; // minus header
+}
+
+// Parses up to `cap` data rows into the output arrays. Returns the number
+// of rows parsed, or a negative error code: -1 open failure, -2 a row had
+// the wrong number of fields, -3 a numeric field failed to parse (the
+// offending 1-based line number is written to *err_line for -2/-3).
+int64_t ff_parse_csv(const char* path,
+                     const char* weather_vocab, int n_weather,
+                     const char* traffic_vocab, int n_traffic,
+                     int64_t cap,
+                     int32_t* weather_idx, int32_t* traffic_idx,
+                     int32_t* weekday, int32_t* hour,
+                     float* distance_km, float* driver_age,
+                     float* eta_minutes, int64_t* err_line) {
+    FFVocab wv, tv;
+    ff_build_vocab(&wv, weather_vocab, n_weather);
+    ff_build_vocab(&tv, traffic_vocab, n_traffic);
+    *err_line = 0;
+
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+
+    char line[4096];
+    int64_t row = 0, lineno = 0;
+    bool header = true;
+    while (fgets(line, sizeof(line), f)) {
+        ++lineno;
+        size_t len = strlen(line);
+        if (len == sizeof(line) - 1 && line[len - 1] != '\n') {
+            // Overlong physical line: fgets would silently split it into
+            // bogus rows. No valid row in this 7-field schema approaches
+            // 4 KB, so reject instead of mis-parsing.
+            fclose(f);
+            *err_line = lineno;
+            return -2;
+        }
+        while (len && (line[len - 1] == '\n' || line[len - 1] == '\r'))
+            line[--len] = '\0';
+        if (len == 0) continue;
+        if (header) { header = false; continue; }
+        if (row >= cap) break;
+
+        // exactly 7 comma-separated fields (6 commas), then split
+        int commas = 0;
+        for (size_t i = 0; i < len; ++i)
+            if (line[i] == ',') ++commas;
+        if (commas != 6) {
+            fclose(f);
+            *err_line = lineno;
+            return -2;
+        }
+        const char* fields[7];
+        int flen[7];
+        int nf = 0;
+        const char* start = line;
+        for (size_t i = 0; i <= len; ++i) {
+            if (i == len || line[i] == ',') {
+                fields[nf] = start;
+                flen[nf] = (int)(line + i - start);
+                ++nf;
+                start = line + i + 1;
+            }
+        }
+
+        weather_idx[row] = ff_vocab_lookup(&wv, fields[0], flen[0]);
+        traffic_idx[row] = ff_vocab_lookup(&tv, fields[1], flen[1]);
+
+        char tmp[64];
+        char* end;
+        const int numeric[5] = {2, 3, 4, 5, 6};
+        double vals[5];
+        for (int k = 0; k < 5; ++k) {
+            int fi = numeric[k];
+            int l = flen[fi] < 63 ? flen[fi] : 63;
+            memcpy(tmp, fields[fi], (size_t)l);
+            tmp[l] = '\0';
+            vals[k] = strtod(tmp, &end);
+            if (end == tmp || *end != '\0' || !std::isfinite(vals[k])) {
+                fclose(f);
+                *err_line = lineno;
+                return -3;
+            }
+        }
+        weekday[row] = (int32_t)vals[0];
+        hour[row] = (int32_t)vals[1];
+        distance_km[row] = (float)vals[2];
+        driver_age[row] = (float)vals[3];
+        eta_minutes[row] = (float)vals[4];
+        ++row;
+    }
+    fclose(f);
+    return row;
+}
+
+// ── version stamp (cache invalidation for the build wrapper) ───────────
+int ff_abi_version() { return 1; }
+
+}  // extern "C"

@@ -138,6 +138,12 @@ class EtaService:
         self._load(model_path or default_model_path())
         self._batcher: Optional[DynamicBatcher] = None
         self.kernel = "xla"  # which forward path serves: xla | pallas_fused
+        # Warm the native encoder now: its first use triggers a g++
+        # build (content-cached), which must happen at startup, not
+        # inside the first customer request's batcher flush.
+        from routest_tpu import native
+
+        native.available()
         if self.available:
             apply_jit = jax.jit(self._model.apply)
             # load_model returns host numpy arrays; pin them on device once

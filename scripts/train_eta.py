@@ -29,6 +29,10 @@ def main() -> None:
     parser.add_argument("--n", type=int, default=500_000)
     parser.add_argument("--epochs", type=int, default=30)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--csv", type=str, default=None,
+                        help="train from a delivery-history CSV "
+                             "(data/csv_io.py schema) instead of the "
+                             "synthetic generator")
     parser.add_argument("--quick", action="store_true",
                         help="small run for smoke testing")
     args = parser.parse_args()
@@ -44,8 +48,14 @@ def main() -> None:
     from routest_tpu.train.checkpoint import default_model_path, save_model
     from routest_tpu.train.loop import fit
 
-    print(f"[1/4] dataset: n={args.n}")
-    data = generate_dataset(args.n, seed=args.seed)
+    if args.csv:
+        from routest_tpu.data.csv_io import load_csv
+
+        print(f"[1/4] dataset: {args.csv}")
+        data = load_csv(args.csv)
+    else:
+        print(f"[1/4] dataset: n={args.n}")
+        data = generate_dataset(args.n, seed=args.seed)
     train, ev = train_eval_split(data)
     print(f"      train={len(train['eta_minutes'])} eval={len(ev['eta_minutes'])} "
           f"target std={float(np.std(ev['eta_minutes'])):.2f} min")

@@ -1,0 +1,145 @@
+"""Native data-plane (routest_tpu/native) parity and contract tests.
+
+Compile-gated: skipped wholesale when no C++ toolchain is present — the
+native library is additive runtime, never a dependency, so the numpy
+fallback paths are exercised by the rest of the suite regardless.
+"""
+
+import numpy as np
+import pytest
+
+from routest_tpu import native
+from routest_tpu.data import csv_io
+from routest_tpu.data.features import batch_from_mapping
+from routest_tpu.data.synthetic import generate_dataset
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain / native build failed")
+
+
+def _numpy_encode(data):
+    # Independent oracle: the numpy encoding written out longhand.
+    from routest_tpu.data import features
+
+    w = np.asarray(data["weather_idx"], np.int64)
+    t = np.asarray(data["traffic_idx"], np.int64)
+    n = len(w)
+    out = np.zeros((n, features.N_FEATURES), np.float32)
+    rows = np.arange(n)
+    out[rows[w >= 0], w[w >= 0]] = 1.0
+    out[rows[t >= 0], 4 + t[t >= 0]] = 1.0
+    out[:, 8] = np.asarray(data["weekday"], np.float32)
+    out[:, 9] = np.asarray(data["hour"], np.float32)
+    out[:, 10] = np.asarray(data["distance_km"], np.float32)
+    out[:, 11] = np.asarray(data["driver_age"], np.float32)
+    return out
+
+
+def test_encode_parity_with_numpy(rng):
+    data = generate_dataset(4096, seed=3)
+    # salt in unknown categories (index -1 ⇒ all-zero group)
+    data["weather_idx"] = np.asarray(data["weather_idx"], np.int32).copy()
+    data["traffic_idx"] = np.asarray(data["traffic_idx"], np.int32).copy()
+    data["weather_idx"][::17] = -1
+    data["traffic_idx"][::23] = -1
+    got = native.encode_batch(
+        data["weather_idx"], data["traffic_idx"], data["weekday"],
+        data["hour"], data["distance_km"], data["driver_age"])
+    np.testing.assert_array_equal(got, _numpy_encode(data))
+
+
+def test_batch_from_mapping_uses_native_and_matches(rng):
+    data = generate_dataset(512, seed=4)
+    got = batch_from_mapping(data)
+    np.testing.assert_array_equal(got, _numpy_encode(data))
+
+
+def test_csv_roundtrip_native_vs_python(tmp_path):
+    data = generate_dataset(1000, seed=5)
+    path = str(tmp_path / "deliveries.csv")
+    csv_io.save_csv(path, data)
+
+    via_native = csv_io.load_csv(path)
+    via_python = csv_io.load_csv(path, force_python=True)
+    for key in via_python:
+        np.testing.assert_allclose(via_native[key], via_python[key],
+                                   rtol=1e-6, err_msg=key)
+    np.testing.assert_array_equal(via_native["weather_idx"],
+                                  np.asarray(data["weather_idx"], np.int32))
+    np.testing.assert_allclose(via_native["distance_km"],
+                               data["distance_km"], rtol=1e-5)
+
+
+def test_csv_unknown_categories_map_to_minus_one(tmp_path):
+    path = str(tmp_path / "odd.csv")
+    with open(path, "w") as f:
+        f.write("weather,traffic,weekday,hour,distance_km,driver_age,eta_minutes\n")
+        f.write("Fog,Gridlock,2,9,7.5,41,33.2\n")
+        f.write("Sunny,Low,0,0,1.0,30,10\n")
+    for force in (False, True):
+        d = csv_io.load_csv(path, force_python=force)
+        assert list(d["weather_idx"]) == [-1, 2]
+        assert list(d["traffic_idx"]) == [-1, 2]
+
+
+def test_csv_malformed_rows_error_with_line(tmp_path):
+    bad_fields = str(tmp_path / "bad1.csv")
+    with open(bad_fields, "w") as f:
+        f.write("weather,traffic,weekday,hour,distance_km,driver_age,eta_minutes\n")
+        f.write("Sunny,Low,0,0,1.0,30\n")  # 6 fields
+    bad_numeric = str(tmp_path / "bad2.csv")
+    with open(bad_numeric, "w") as f:
+        f.write("weather,traffic,weekday,hour,distance_km,driver_age,eta_minutes\n")
+        f.write("Sunny,Low,0,0,oops,30,10\n")
+    for path, marker in ((bad_fields, "expected 7 fields"),
+                        (bad_numeric, "non-numeric field")):
+        for force in (False, True):
+            with pytest.raises(ValueError, match=marker) as ei:
+                csv_io.load_csv(path, force_python=force)
+            assert ":2:" in str(ei.value)  # 1-based offending line
+
+
+def test_csv_missing_file(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        csv_io.load_csv(str(tmp_path / "nope.csv"))
+
+
+def test_csv_header_validated(tmp_path):
+    # Reordered/missing headers must error, not silently mis-parse
+    # (positional parsing would swap columns).
+    path = str(tmp_path / "swapped.csv")
+    with open(path, "w") as f:
+        f.write("traffic,weather,weekday,hour,distance_km,driver_age,eta_minutes\n")
+        f.write("Low,Sunny,0,0,1.0,30,10\n")
+    for force in (False, True):
+        with pytest.raises(ValueError, match="bad header"):
+            csv_io.load_csv(path, force_python=force)
+
+
+def test_csv_inf_weekday_same_error_both_paths(tmp_path):
+    # int(float('inf')) raises OverflowError in Python — both parsers
+    # must still surface the documented ValueError with the line number.
+    path = str(tmp_path / "inf.csv")
+    with open(path, "w") as f:
+        f.write("weather,traffic,weekday,hour,distance_km,driver_age,eta_minutes\n")
+        f.write("Sunny,Low,inf,9,7.5,41,33.2\n")
+    for force in (False, True):
+        with pytest.raises(ValueError, match="non-numeric field"):
+            csv_io.load_csv(path, force_python=force)
+
+
+def test_csv_feeds_training(tmp_path):
+    # End-to-end: CSV → dataset dict → one fit step (the data/ pipeline
+    # SURVEY.md §7.3 item 1 says we must build).
+    from routest_tpu.core.config import TrainConfig
+    from routest_tpu.core.dtypes import F32_POLICY
+    from routest_tpu.data.synthetic import train_eval_split
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.train.loop import fit
+
+    path = str(tmp_path / "train.csv")
+    csv_io.save_csv(path, generate_dataset(2000, seed=6))
+    train, ev = train_eval_split(csv_io.load_csv(path), eval_frac=0.2)
+    res = fit(EtaMLP(hidden=(16,), policy=F32_POLICY), train, ev,
+              TrainConfig(epochs=1, batch_size=512))
+    assert np.isfinite(res.eval_rmse)
