@@ -27,9 +27,15 @@ identical-contract Python fallback otherwise (parity enforced by
 from __future__ import annotations
 
 import csv
+import re
 from typing import Dict
 
 import numpy as np
+
+# The shared numeric grammar (see _load_csv_python): plain decimal with
+# optional sign/fraction/exponent — exactly what the native parser's
+# charset pre-check + strtod full-consume accepts.
+_NUMERIC_RE = re.compile(r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$")
 
 from routest_tpu.data.features import TRAFFIC_CATEGORIES, WEATHER_CATEGORIES
 
@@ -105,8 +111,16 @@ def _load_csv_python(path: str) -> Dict[str, np.ndarray]:
             if len(row) != 7:
                 raise ValueError(f"{path}:{lineno}: expected 7 fields")
             try:
+                # _NUMERIC_RE + range guards keep this grammar and the
+                # native parser's byte-for-byte identical (no python-isms
+                # like '1_0', no strtod-isms like hex or padding; f32/i32
+                # overflow is an error, not silent inf/garbage).
+                if not all(_NUMERIC_RE.match(row[i]) for i in (2, 3, 4, 5, 6)):
+                    raise ValueError
                 numeric = [float(row[i]) for i in (2, 3, 4, 5, 6)]
-                if not all(np.isfinite(v) for v in numeric):
+                if not all(np.isfinite(v) and abs(v) <= 3.0e38 for v in numeric):
+                    raise ValueError
+                if any(abs(v) > 2**31 - 1 for v in numeric[:2]):
                     raise ValueError
                 cols["weekday"].append(int(numeric[0]))
                 cols["hour"].append(int(numeric[1]))

@@ -27,18 +27,32 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _cache_dir() -> str:
-    base = os.environ.get("ROUTEST_NATIVE_CACHE") or os.path.join(
-        tempfile.gettempdir(), "routest_tpu_native")
-    os.makedirs(base, exist_ok=True)
+def _cache_dir() -> Optional[str]:
+    """Per-user 0700 cache dir. The .so path must not be forgeable by
+    another local user (a planted library would be dlopen'd into this
+    process), so anything not owned by us / group- or world-writable is
+    rejected. ROUTEST_NATIVE_CACHE overrides (explicit operator choice)."""
+    base = os.environ.get("ROUTEST_NATIVE_CACHE")
+    if base:
+        os.makedirs(base, exist_ok=True)
+        return base
+    base = os.path.join(tempfile.gettempdir(),
+                        f"routest_tpu_native_{os.getuid()}")
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    st = os.stat(base)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        return None  # hijacked path: fall back to numpy rather than trust it
     return base
 
 
 def _build() -> Optional[str]:
+    cache = _cache_dir()
+    if cache is None:
+        return None
     with open(_SRC, "rb") as f:
         src = f.read()
     tag = hashlib.sha256(src).hexdigest()[:16]
-    out = os.path.join(_cache_dir(), f"fastfeat-{tag}.so")
+    out = os.path.join(cache, f"fastfeat-{tag}.so")
     if os.path.exists(out):
         return out
     tmp = out + f".tmp{os.getpid()}"

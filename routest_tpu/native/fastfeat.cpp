@@ -167,14 +167,35 @@ int64_t ff_parse_csv(const char* path,
         for (int k = 0; k < 5; ++k) {
             int fi = numeric[k];
             int l = flen[fi] < 63 ? flen[fi] : 63;
+            // Strict decimal grammar, identical to the Python fallback's
+            // regex: digits/sign/dot/exponent only. This rejects what
+            // strtod would otherwise quietly accept beyond the shared
+            // contract — leading whitespace, hex (0x10), inf/nan.
+            bool ok = l > 0;
+            for (int c = 0; c < l && ok; ++c) {
+                char ch = fields[fi][c];
+                ok = (ch >= '0' && ch <= '9') || ch == '+' || ch == '-' ||
+                     ch == '.' || ch == 'e' || ch == 'E';
+            }
             memcpy(tmp, fields[fi], (size_t)l);
             tmp[l] = '\0';
-            vals[k] = strtod(tmp, &end);
-            if (end == tmp || *end != '\0' || !std::isfinite(vals[k])) {
+            vals[k] = ok ? strtod(tmp, &end) : 0.0;
+            // float32 range guard: values that would overflow to inf in
+            // the f32 output columns are rejected, not silently mangled.
+            if (!ok || end == tmp || *end != '\0' || !std::isfinite(vals[k]) ||
+                vals[k] > 3.0e38 || vals[k] < -3.0e38) {
                 fclose(f);
                 *err_line = lineno;
                 return -3;
             }
+        }
+        // weekday/hour become int32: an out-of-range double->int32 cast
+        // is UB in C++, so range-check instead of silently corrupting.
+        if (vals[0] < -2147483647.0 || vals[0] > 2147483647.0 ||
+            vals[1] < -2147483647.0 || vals[1] > 2147483647.0) {
+            fclose(f);
+            *err_line = lineno;
+            return -3;
         }
         weekday[row] = (int32_t)vals[0];
         hour[row] = (int32_t)vals[1];

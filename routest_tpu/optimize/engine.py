@@ -142,7 +142,11 @@ def optimize_route(input_data: dict) -> dict:
                              dtype=np.float32)
     except (TypeError, ValueError):
         return {"error": "invalid destination payload: must be numeric"}
-    sol = solve_host(dist, demands, cap, max_dist)
+    # Additive ABI: {"refine": true} runs 2-opt on the greedy order —
+    # strictly shorter or equal routes, same response shape. Default off
+    # to keep exact reference-greedy semantics.
+    refine = bool(input_data.get("refine"))
+    sol = solve_host(dist, demands, cap, max_dist, refine=refine)
     if sol["unroutable"]:
         which = ", ".join(str(i) for i in sol["unroutable"])
         return {"error": f"stops not routable under constraints (indices: {which})"}
@@ -176,6 +180,8 @@ def optimize_route(input_data: dict) -> dict:
             },
         },
     }
+    if refine:
+        feature["properties"]["refined"] = True
     _annotate(feature, driver_details, vehicle_type)
     return feature
 

@@ -116,6 +116,34 @@ def test_csv_header_validated(tmp_path):
             csv_io.load_csv(path, force_python=force)
 
 
+def test_csv_numeric_grammar_parity(tmp_path):
+    """Inputs where strtod and python float() disagree must error (or
+    parse) IDENTICALLY on both paths — same file, same result, toolchain
+    or not."""
+    header = "weather,traffic,weekday,hour,distance_km,driver_age,eta_minutes\n"
+    cases = {
+        "Sunny,Low,0,0,1.0 ,30,10\n": False,      # trailing space
+        "Sunny,Low,0,0, 1.0,30,10\n": False,      # leading space
+        "Sunny,Low,0,0,0x10,30,10\n": False,      # strtod-only hex
+        "Sunny,Low,0,0,1_0,30,10\n": False,       # python-only underscore
+        "Sunny,Low,1e30,9,7.5,41,33.2\n": False,  # int32 overflow weekday
+        "Sunny,Low,0,0,1e300,30,10\n": False,     # f32 overflow -> inf
+        "Sunny,Low,0,0,nan,30,10\n": False,
+        "Sunny,Low,2,9,+.5,41,3e1\n": True,       # valid fringe grammar
+    }
+    for i, (row, ok) in enumerate(cases.items()):
+        path = str(tmp_path / f"g{i}.csv")
+        with open(path, "w") as f:
+            f.write(header + row)
+        for force in (False, True):
+            if ok:
+                d = csv_io.load_csv(path, force_python=force)
+                assert len(d["eta_minutes"]) == 1
+            else:
+                with pytest.raises(ValueError, match="non-numeric field"):
+                    csv_io.load_csv(path, force_python=force)
+
+
 def test_csv_inf_weekday_same_error_both_paths(tmp_path):
     # int(float('inf')) raises OverflowError in Python — both parsers
     # must still surface the documented ValueError with the line number.

@@ -1,0 +1,161 @@
+"""2-opt refinement (optimize/vrp.py:refine_2opt): quality, feasibility,
+and optimality checks against brute force — the beyond-reference solver
+upgrade (the reference stops at greedy, ``Flaskr/utils.py:111-139``)."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from routest_tpu.data import geo
+from routest_tpu.optimize.engine import optimize_route
+from routest_tpu.optimize.vrp import greedy_vrp, refine_2opt, solve_host
+
+
+def _random_instance(rng, n):
+    latlon = np.stack([
+        14.4 + 0.3 * rng.random(n + 1),
+        120.95 + 0.18 * rng.random(n + 1),
+    ], axis=1).astype(np.float32)
+    return np.asarray(geo.distance_matrix_m(jnp.asarray(latlon), 1.3))
+
+
+def _closed_length(dist, order, trip_ids):
+    """Total over trips of origin → stops → origin."""
+    total = 0.0
+    prev_trip = None
+    prev_node = 0
+    for o, t in zip(order, trip_ids):
+        if o < 0:
+            break
+        if t != prev_trip:
+            total += dist[prev_node, 0] if prev_trip is not None else 0.0
+            prev_node = 0
+            prev_trip = t
+        total += dist[prev_node, o + 1]
+        prev_node = o + 1
+    total += dist[prev_node, 0]
+    return float(total)
+
+
+def _solve_pair(dist, demands, cap, maxd):
+    sol = greedy_vrp(jnp.asarray(dist), jnp.asarray(demands, jnp.float32),
+                     jnp.asarray(cap, jnp.float32), jnp.asarray(maxd, jnp.float32))
+    refined = refine_2opt(jnp.asarray(dist), sol.order, sol.trip_ids)
+    return sol, np.asarray(refined)
+
+
+def test_refine_never_worse_and_often_better(rng):
+    better, total_g, total_r = 0, 0.0, 0.0
+    for k in range(30):
+        n = int(rng.integers(4, 10))
+        dist = _random_instance(rng, n)
+        demands = np.ones(n, np.float32)
+        sol, refined = _solve_pair(dist, demands, 1e12, 1e12)
+        order_g = np.asarray(sol.order)
+        tids = np.asarray(sol.trip_ids)
+        lg = _closed_length(dist, order_g, tids)
+        lr = _closed_length(dist, refined, tids)
+        assert lr <= lg + 1e-3, f"instance {k}: refinement worsened the tour"
+        assert sorted(refined.tolist()) == sorted(order_g.tolist())
+        better += lr < lg - 1e-3
+        total_g += lg
+        total_r += lr
+    assert better >= 5, "2-opt should improve a healthy fraction of instances"
+    assert total_r < total_g
+
+
+def test_refine_reaches_optimal_on_small_instances(rng):
+    """Single-trip instances small enough to brute-force: refined must be
+    ≤ greedy and ≥ optimal; and it should land ON optimal much more often
+    than greedy does."""
+    hits_r = hits_g = 0
+    for k in range(20):
+        n = 7
+        dist = _random_instance(rng, n)
+        demands = np.ones(n, np.float32)
+        sol, refined = _solve_pair(dist, demands, 1e12, 1e12)
+        tids = np.asarray(sol.trip_ids)
+        best = min(
+            _closed_length(dist, np.asarray(p, np.int32), np.zeros(n, np.int32))
+            for p in itertools.permutations(range(n)))
+        lg = _closed_length(dist, np.asarray(sol.order), tids)
+        lr = _closed_length(dist, refined, tids)
+        assert lr >= best - 1e-3
+        hits_r += abs(lr - best) < 1e-3
+        hits_g += abs(lg - best) < 1e-3
+    assert hits_r > hits_g, (hits_r, hits_g)
+    assert hits_r >= 10
+
+
+def test_refine_respects_trip_boundaries(rng):
+    # Tight capacity forces multiple trips; refinement must keep each
+    # trip's stop set (loads unchanged) and stay within max_distance.
+    for k in range(10):
+        n = 8
+        dist = _random_instance(rng, n)
+        demands = rng.integers(1, 4, n).astype(np.float32)
+        cap = 5.0
+        sol = solve_host(dist, demands, cap, 1e12, refine=False)
+        ref = solve_host(dist, demands, cap, 1e12, refine=True)
+        assert len(sol["trips"]) == len(ref["trips"])
+        for tg, tr in zip(sol["trips"], ref["trips"]):
+            assert sorted(tg) == sorted(tr)
+            assert demands[tr].sum() <= cap
+
+
+def test_refine_feasibility_under_max_distance(rng):
+    for k in range(10):
+        n = 7
+        dist = _random_instance(rng, n)
+        demands = np.ones(n, np.float32)
+        maxd = float(np.median(dist[0, 1:]) * 4)
+        sol = solve_host(dist, demands, 1e12, maxd, refine=True)
+        if sol["unroutable"]:
+            continue
+        # rebuild per-trip closed lengths from the refined order
+        for trip in sol["trips"]:
+            length = dist[0, trip[0] + 1]
+            for a, b in zip(trip[:-1], trip[1:]):
+                length += dist[a + 1, b + 1]
+            length += dist[trip[-1] + 1, 0]
+            assert length <= maxd + 1e-2
+
+
+def test_refine_noop_cases():
+    # Empty / single-stop orders: no valid move, order unchanged.
+    dist = np.asarray([[0.0, 5.0], [5.0, 0.0]], np.float32)
+    order = np.asarray([0], np.int32)
+    tids = np.asarray([0], np.int32)
+    out = np.asarray(refine_2opt(jnp.asarray(dist), jnp.asarray(order),
+                                 jnp.asarray(tids)))
+    assert out.tolist() == [0]
+    out = np.asarray(refine_2opt(jnp.asarray(dist),
+                                 jnp.asarray([-1], jnp.int32),
+                                 jnp.asarray([-1], jnp.int32)))
+    assert out.tolist() == [-1]
+
+
+def test_engine_refine_flag(rng):
+    pts = [{"lat": 14.58, "lon": 121.04}] + [
+        {"lat": 14.4 + 0.25 * float(rng.random()),
+         "lon": 120.97 + 0.14 * float(rng.random()), "payload": 1}
+        for _ in range(8)
+    ]
+    payload = {
+        "source_point": pts[0],
+        "destination_points": pts[1:],
+        "driver_details": {"driver_name": "t", "vehicle_type": "car",
+                           "vehicle_capacity": 9999,
+                           "maximum_distance": 10_000_000},
+    }
+    base = optimize_route(dict(payload))
+    refined = optimize_route({**payload, "refine": True})
+    assert "error" not in refined
+    assert refined["properties"]["refined"] is True
+    assert "refined" not in base["properties"]
+    assert sorted(refined["properties"]["optimized_order"]) == \
+        sorted(base["properties"]["optimized_order"])
+    assert refined["properties"]["summary"]["distance"] <= \
+        base["properties"]["summary"]["distance"] + 0.1
