@@ -33,9 +33,12 @@ from typing import Dict
 import numpy as np
 
 # The shared numeric grammar (see _load_csv_python): plain decimal with
-# optional sign/fraction/exponent — exactly what the native parser's
-# charset pre-check + strtod full-consume accepts.
-_NUMERIC_RE = re.compile(r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$")
+# optional sign/fraction/exponent, at most 63 chars — exactly what the
+# native parser's charset pre-check + strtod full-consume accepts.
+# re.ASCII: \d must mean [0-9] only (float() would happily parse Unicode
+# digits the native parser rejects).
+_NUMERIC_RE = re.compile(r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$", re.ASCII)
+_MAX_NUMERIC_LEN = 63
 
 from routest_tpu.data.features import TRAFFIC_CATEGORIES, WEATHER_CATEGORIES
 
@@ -115,7 +118,9 @@ def _load_csv_python(path: str) -> Dict[str, np.ndarray]:
                 # native parser's byte-for-byte identical (no python-isms
                 # like '1_0', no strtod-isms like hex or padding; f32/i32
                 # overflow is an error, not silent inf/garbage).
-                if not all(_NUMERIC_RE.match(row[i]) for i in (2, 3, 4, 5, 6)):
+                if not all(len(row[i]) <= _MAX_NUMERIC_LEN
+                           and _NUMERIC_RE.match(row[i])
+                           for i in (2, 3, 4, 5, 6)):
                     raise ValueError
                 numeric = [float(row[i]) for i in (2, 3, 4, 5, 6)]
                 if not all(np.isfinite(v) and abs(v) <= 3.0e38 for v in numeric):

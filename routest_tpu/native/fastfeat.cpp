@@ -166,7 +166,15 @@ int64_t ff_parse_csv(const char* path,
         double vals[5];
         for (int k = 0; k < 5; ++k) {
             int fi = numeric[k];
-            int l = flen[fi] < 63 ? flen[fi] : 63;
+            int l = flen[fi];
+            if (l > 63) {
+                // No representable value in this schema needs 64 chars;
+                // reject instead of silently truncating (the Python
+                // fallback enforces the same cap).
+                fclose(f);
+                *err_line = lineno;
+                return -3;
+            }
             // Strict decimal grammar, identical to the Python fallback's
             // regex: digits/sign/dot/exponent only. This rejects what
             // strtod would otherwise quietly accept beyond the shared

@@ -137,6 +137,36 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def sharded_attention(attn_fn, q: jax.Array, k: jax.Array, v: jax.Array,
+                      mesh: Mesh, seq_axis: str,
+                      data_axis: Optional[str],
+                      key_mask: Optional[jax.Array],
+                      causal: bool) -> jax.Array:
+    """Shared shard_map wrapper for the per-device attention programs.
+
+    Builds the spec/arg tuples conditionally so a masked call adds the
+    mask input while an unmasked one omits it entirely — letting the
+    per-device program (which receives ``key_mask=None``) skip its mask
+    collectives and per-tile compare/multiply.
+    """
+    axis_size = mesh.shape[seq_axis]
+    qkv_spec = P(data_axis, seq_axis, None, None)
+    specs = (qkv_spec, qkv_spec, qkv_spec)
+    args = (q, k, v)
+    if key_mask is not None:
+        specs += (P(data_axis, seq_axis),)
+        args += (key_mask,)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=specs,
+                       out_specs=qkv_spec)
+    def run(q, k, v, *maybe_mask):
+        return attn_fn(q, k, v, axis_name=seq_axis, axis_size=axis_size,
+                       key_mask=maybe_mask[0] if maybe_mask else None,
+                       causal=causal)
+
+    return run(*args)
+
+
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, seq_axis: str = "seq",
                            data_axis: Optional[str] = None,
@@ -148,30 +178,5 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     over ``data_axis``. This is the convenience wrapper — models compose
     :func:`ring_attention` directly inside their own shard_map programs.
     """
-    axis_size = mesh.shape[seq_axis]
-    qkv_spec = P(data_axis, seq_axis, None, None)
-    mask_spec = P(data_axis, seq_axis)
-
-    if key_mask is None:
-        # no mask input at all: the unmasked ring skips the per-hop mask
-        # ppermute and the per-tile compare/multiply entirely
-        @functools.partial(
-            shard_map, mesh=mesh,
-            in_specs=(qkv_spec, qkv_spec, qkv_spec),
-            out_specs=qkv_spec)
-        def run_unmasked(q, k, v):
-            return ring_attention(q, k, v, axis_name=seq_axis,
-                                  axis_size=axis_size, causal=causal)
-
-        return run_unmasked(q, k, v)
-
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec)
-    def run(q, k, v, km):
-        return ring_attention(q, k, v, axis_name=seq_axis,
-                              axis_size=axis_size, key_mask=km,
-                              causal=causal)
-
-    return run(q, k, v, key_mask)
+    return sharded_attention(ring_attention, q, k, v, mesh, seq_axis,
+                             data_axis, key_mask, causal)

@@ -17,14 +17,12 @@ sequence model can pick per workload.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from routest_tpu.core.smap import shard_map
-from routest_tpu.parallel.ring import full_attention
+from routest_tpu.parallel.ring import full_attention, sharded_attention
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -64,30 +62,5 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                               key_mask: Optional[jax.Array] = None,
                               causal: bool = False) -> jax.Array:
     """Convenience wrapper over full (B, S, H, D) arrays (cf. ring)."""
-    axis_size = mesh.shape[seq_axis]
-    qkv_spec = P(data_axis, seq_axis, None, None)
-    mask_spec = P(data_axis, seq_axis)
-
-    if key_mask is None:
-        # no mask input: the per-device program then skips its mask
-        # all_gather entirely
-        @functools.partial(
-            shard_map, mesh=mesh,
-            in_specs=(qkv_spec, qkv_spec, qkv_spec),
-            out_specs=qkv_spec)
-        def run_unmasked(q, k, v):
-            return ulysses_attention(q, k, v, axis_name=seq_axis,
-                                     axis_size=axis_size, causal=causal)
-
-        return run_unmasked(q, k, v)
-
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec)
-    def run(q, k, v, km):
-        return ulysses_attention(q, k, v, axis_name=seq_axis,
-                                 axis_size=axis_size, key_mask=km,
-                                 causal=causal)
-
-    return run(q, k, v, key_mask)
+    return sharded_attention(ulysses_attention, q, k, v, mesh, seq_axis,
+                             data_axis, key_mask, causal)

@@ -130,6 +130,12 @@ def test_csv_numeric_grammar_parity(tmp_path):
         "Sunny,Low,0,0,1e300,30,10\n": False,     # f32 overflow -> inf
         "Sunny,Low,0,0,nan,30,10\n": False,
         "Sunny,Low,2,9,+.5,41,3e1\n": True,       # valid fringe grammar
+        # 64-char numeric field: rejected (not truncated) on both paths
+        "Sunny,Low,0,0," + "0" * 63 + "9,30,10\n": False,
+        # 63 chars is within the cap and must parse to the same value
+        "Sunny,Low,0,0," + "0" * 62 + "9,30,10\n": True,
+        # Unicode digit: float() would parse it, both paths must reject
+        "Sunny,Low,٣,0,1.0,30,10\n": False,
     }
     for i, (row, ok) in enumerate(cases.items()):
         path = str(tmp_path / f"g{i}.csv")
