@@ -70,9 +70,27 @@ def _stop_name(point: Dict, idx: Optional[int]) -> str:
     return "origin" if idx is None else f"stop {idx + 1}"
 
 
+def _gc_legs(all_points: List[Dict], dist: np.ndarray, speed_mps: float):
+    """Default leg provider: great-circle geometry, duration = d/speed."""
+    def leg_cost(a: int, b: int):
+        return float(dist[a, b]), float(dist[a, b]) / speed_mps
+
+    def leg_geom(a: int, b: int) -> List[List[float]]:
+        pa, pb = all_points[a], all_points[b]
+        return _leg_geometry((pa["lat"], pa["lon"]),
+                             (pb["lat"], pb["lon"])).tolist()
+
+    return leg_cost, leg_geom
+
+
 def _build_trip_feature_parts(all_points: List[Dict], trip: Sequence[int],
-                              dist: np.ndarray, speed_mps: float):
-    """One trip (origin → stops → origin): geometry, segments, totals."""
+                              leg_cost, leg_geom):
+    """One trip (origin → stops → origin): geometry, segments, totals.
+
+    ``leg_cost(a, b) -> (meters, seconds)`` and ``leg_geom(a, b) ->
+    [[lon, lat], …]`` abstract the leg provider: great-circle by default,
+    road-graph shortest paths when the road router is active.
+    """
     node_seq = [0] + [i + 1 for i in trip] + [0]
     coords: List[List[float]] = []
     segments: List[Dict] = []
@@ -80,11 +98,10 @@ def _build_trip_feature_parts(all_points: List[Dict], trip: Sequence[int],
     total_dur = 0.0
     for a, b in zip(node_seq[:-1], node_seq[1:]):
         pa, pb = all_points[a], all_points[b]
-        leg_m = float(dist[a, b])
-        leg_s = leg_m / speed_mps
-        g = _leg_geometry((pa["lat"], pa["lon"]), (pb["lat"], pb["lon"]))
+        leg_m, leg_s = leg_cost(a, b)
+        g = leg_geom(a, b)
         wp_start = len(coords)
-        pts = g.tolist() if not coords else g.tolist()[1:]
+        pts = g if not coords else g[1:]
         coords.extend(pts)
         wp_end = len(coords) - 1
         name = _stop_name(pb, b - 1 if b > 0 else None)
@@ -131,11 +148,31 @@ def optimize_route(input_data: dict) -> dict:
     except (KeyError, TypeError, ValueError):
         return {"error": "invalid coordinates: each point needs numeric lat/lon"}
 
-    dist = np.asarray(geo.distance_matrix_m(jnp.asarray(latlon), road_factor))
+    # Leg provider: great-circle × road factor by default; with
+    # {"road_graph": true} (additive ABI) legs become true shortest paths
+    # over the on-device road network — street-following geometry,
+    # congestion-model durations (optimize/road_router.py).
+    use_road = bool(input_data.get("road_graph"))
+    if use_road:
+        from routest_tpu.optimize.road_router import default_router
+
+        car_speed = geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("car")]
+        legs = default_router().route_legs(latlon, car_speed / speed)
+        dist = legs.dist_m
+
+        def leg_cost(a: int, b: int):
+            return legs.leg(a, b)[:2]
+
+        def leg_geom(a: int, b: int):
+            return legs.leg(a, b)[2]
+    else:
+        dist = np.asarray(geo.distance_matrix_m(jnp.asarray(latlon), road_factor))
+        leg_cost, leg_geom = _gc_legs(all_points, dist, speed)
 
     if len(destinations) == 1:
-        return _point_to_point(source, destinations[0], all_points, dist, speed,
-                               driver_details, vehicle_type, cap, max_dist)
+        return _point_to_point(source, destinations[0], all_points,
+                               leg_cost, leg_geom, driver_details,
+                               vehicle_type, cap, max_dist, use_road)
 
     try:
         demands = np.asarray([float(p.get("payload", 0) or 0) for p in destinations],
@@ -156,7 +193,8 @@ def optimize_route(input_data: dict) -> dict:
     total_dist = 0.0
     total_dur = 0.0
     for trip in sol["trips"]:
-        c, s, d, t = _build_trip_feature_parts(all_points, trip, dist, speed)
+        c, s, d, t = _build_trip_feature_parts(all_points, trip,
+                                               leg_cost, leg_geom)
         coords.extend(c)
         segments.extend(s)
         total_dist += d
@@ -182,16 +220,19 @@ def optimize_route(input_data: dict) -> dict:
     }
     if refine:
         feature["properties"]["refined"] = True
+    if use_road:
+        feature["properties"]["road_graph"] = True
     _annotate(feature, driver_details, vehicle_type)
     return feature
 
 
-def _point_to_point(source, destination, all_points, dist, speed,
-                    driver_details, vehicle_type, cap, max_dist) -> dict:
+def _point_to_point(source, destination, all_points,
+                    leg_cost, leg_geom, driver_details, vehicle_type,
+                    cap, max_dist, use_road: bool = False) -> dict:
     """Single-destination path with the reference's feasibility semantics
     (``Flaskr/utils.py:53-82``): payload > capacity and distance >
     maximum_distance produce the same joined error strings."""
-    d_m = float(dist[0, 1])
+    d_m = leg_cost(0, 1)[0]
     payload = float(destination.get("payload", 0) or 0)
     errors = []
     if payload > cap:
@@ -202,7 +243,7 @@ def _point_to_point(source, destination, all_points, dist, speed,
         return {"error": " | ".join(errors)}
 
     coords, segments, total_dist, total_dur = _build_trip_feature_parts(
-        all_points, [0], dist, speed
+        all_points, [0], leg_cost, leg_geom
     )
     # Reference point-to-point is one-way (no return leg): use only the
     # outbound segment.
@@ -226,6 +267,8 @@ def _point_to_point(source, destination, all_points, dist, speed,
             "destinations": [destination],
         },
     }
+    if use_road:
+        feature["properties"]["road_graph"] = True
     _annotate(feature, driver_details, vehicle_type)
     return feature
 

@@ -1,0 +1,167 @@
+"""Road-graph routing (optimize/road_router.py): the on-device batched
+Bellman-Ford against a scipy Dijkstra oracle, path-walk invariants, and
+the engine's {"road_graph": true} ABI."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+from scipy.sparse.csgraph import dijkstra
+
+from routest_tpu.data.road_graph import generate_road_graph
+from routest_tpu.optimize.engine import optimize_route
+from routest_tpu.optimize.road_router import RoadRouter
+
+
+@pytest.fixture(scope="module")
+def router():
+    return RoadRouter(graph=generate_road_graph(n_nodes=256, seed=1))
+
+
+def _oracle(router, sources):
+    n = router.n_nodes
+    adj = sp.coo_matrix(
+        (router.length_m, (router.senders, router.receivers)), shape=(n, n)
+    ).tocsr()
+    return dijkstra(adj, directed=True, indices=sources)
+
+
+def test_bellman_ford_matches_dijkstra(router, rng):
+    sources = rng.integers(0, router.n_nodes, 6)
+    dist, _ = router.shortest(sources)
+    want = _oracle(router, sources)
+    finite = np.isfinite(want)
+    assert finite.all(), "bridged graph should be fully connected"
+    np.testing.assert_allclose(dist[finite], want[finite], rtol=1e-4)
+
+
+def test_predecessor_walk_reconstructs_shortest_paths(router, rng):
+    sources = rng.integers(0, router.n_nodes, 3)
+    dist, pred = router.shortest(sources)
+    edge_len = {}
+    for e, (s, r) in enumerate(zip(router.senders, router.receivers)):
+        key = (int(s), int(r))
+        edge_len[key] = min(edge_len.get(key, np.inf), float(router.length_m[e]))
+    for si, src in enumerate(sources):
+        for tgt in rng.integers(0, router.n_nodes, 8):
+            seq = router._walk(pred[si], int(src), int(tgt))
+            if int(tgt) == int(src):
+                assert seq == [int(src)]
+                continue
+            assert seq and seq[0] == int(src) and seq[-1] == int(tgt)
+            total = sum(edge_len[(a, b)] for a, b in zip(seq[:-1], seq[1:]))
+            # walked length equals the distance table (ties may pick a
+            # parallel edge of equal length)
+            np.testing.assert_allclose(total, dist[si, tgt], rtol=1e-3)
+
+
+def test_snap_picks_nearest_node(router):
+    pts = router.coords[[5, 77, 200]] + 1e-4
+    np.testing.assert_array_equal(router.snap(pts), [5, 77, 200])
+
+
+def test_route_legs_invariants(router):
+    pts = np.asarray([[14.58, 121.04], [14.54, 121.06], [14.60, 121.02]],
+                     np.float32)
+    legs = router.route_legs(pts, time_scale=1.0)
+    legs2 = router.route_legs(pts, time_scale=2.0)
+    for i in range(3):
+        assert legs.dist_m[i, i] == 0 and legs.leg(i, i) == (0.0, 0.0, [])
+        for j in range(3):
+            if i == j:
+                continue
+            d, dur, poly = legs.leg(i, j)
+            assert np.isfinite(d) and d > 0 and dur > 0
+            assert d == legs.dist_m[i, j]
+            assert len(poly) >= 3
+            # endpoints are the exact request coordinates (lon, lat)
+            np.testing.assert_allclose(poly[0], [pts[i, 1], pts[i, 0]],
+                                       atol=1e-5)
+            np.testing.assert_allclose(poly[-1], [pts[j, 1], pts[j, 0]],
+                                       atol=1e-5)
+            # slower vehicle scales durations linearly
+            np.testing.assert_allclose(legs2.leg(i, j)[1], dur * 2.0, rtol=1e-5)
+            assert legs.leg(i, j) is legs._memo[(i, j)]  # memoized
+
+
+def test_first_last_mile_charged(router):
+    # A point far off the network must see the point↔network gap in its
+    # distances, not just the intra-graph path.
+    on = np.asarray([[14.58, 121.04]], np.float32)
+    far = np.asarray([[15.8, 121.04]], np.float32)  # ~135 km north of the bbox
+    pts = np.concatenate([on, far])
+    legs = router.route_legs(pts)
+    gap_m = 1000 * 110  # >110 km whatever node it snaps to
+    assert legs.dist_m[0, 1] > gap_m
+    assert legs.leg(0, 1)[1] > gap_m / 20  # duration includes the gap too
+
+
+def _payload(n_dest=3, **extra):
+    pts = [[14.5836, 121.0409], [14.5355, 121.0621],
+           [14.5866, 121.0566], [14.5507, 121.0262]]
+    body = {
+        "source_point": {"lat": pts[0][0], "lon": pts[0][1]},
+        "destination_points": [
+            {"lat": p[0], "lon": p[1], "payload": 1} for p in pts[1:1 + n_dest]],
+        "driver_details": {"driver_name": "t", "vehicle_type": "car",
+                           "vehicle_capacity": 9999,
+                           "maximum_distance": 1_000_000},
+    }
+    body.update(extra)
+    return body
+
+
+def test_engine_road_graph_flag():
+    out = optimize_route(_payload(road_graph=True))
+    assert "error" not in out
+    p = out["properties"]
+    assert p["road_graph"] is True
+    assert p["summary"]["distance"] > 0
+    # street paths are longer than straight lines between the same points
+    base = optimize_route(_payload())
+    assert "road_graph" not in base["properties"]
+    # ABI shape unchanged: segments with steps, optimized_order, bbox
+    assert all(seg["steps"] for seg in p["segments"])
+    assert sorted(p["optimized_order"]) == [0, 1, 2]
+    assert len(out["geometry"]["coordinates"]) >= 4
+
+
+def test_engine_road_graph_point_to_point():
+    out = optimize_route(_payload(n_dest=1, road_graph=True))
+    assert "error" not in out
+    assert out["properties"]["road_graph"] is True
+    assert out["properties"]["summary"]["distance"] > 0
+    assert len(out["properties"]["segments"]) == 1
+
+
+def test_road_graph_over_http_json_serializable():
+    # Through the real WSGI JSON path: numpy scalars anywhere in the
+    # feature would 500 here even though direct-call tests pass.
+    import jax
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config, ServeConfig
+    from routest_tpu.core.dtypes import F32_POLICY
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.serve.app import create_app
+    from routest_tpu.serve.ml_service import EtaService
+    from routest_tpu.train.checkpoint import save_model
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.msgpack")
+        model = EtaMLP(hidden=(8,), policy=F32_POLICY)
+        save_model(path, model, model.init(jax.random.PRNGKey(0)))
+        client = Client(create_app(
+            Config(), eta_service=EtaService(ServeConfig(), model_path=path)))
+        r = client.post("/api/optimize_route",
+                        json=_payload(road_graph=True, refine=True))
+        assert r.status_code == 200, r.get_data(as_text=True)
+        body = r.get_json()
+        assert body["properties"]["road_graph"] is True
+
+
+def test_engine_road_graph_with_refine():
+    out = optimize_route(_payload(road_graph=True, refine=True))
+    assert "error" not in out
+    assert out["properties"]["refined"] is True
+    assert out["properties"]["road_graph"] is True
