@@ -1,0 +1,131 @@
+"""Multi-host runtime: jax.distributed init + hybrid ICI/DCN meshes.
+
+The reference's only "distributed communication" is HTTPS to SaaS
+(SURVEY.md §5.8). This framework's backend is XLA collectives: within a
+slice they ride ICI; across hosts/slices the same program spans DCN once
+``jax.distributed`` is initialized and the mesh is laid out so that the
+*fast-changing* axes stay intra-slice. This module owns both steps:
+
+- :func:`initialize` — idempotent ``jax.distributed.initialize`` with
+  env-layered configuration. On TPU pods jax autodetects coordinator /
+  process count from the TPU metadata, so a bare ``initialize()`` is
+  correct there; elsewhere (CPU/GPU rigs, tests) the ``RTPU_COORDINATOR``
+  / ``RTPU_NUM_PROCESSES`` / ``RTPU_PROCESS_ID`` env vars or explicit
+  kwargs supply it.
+- :func:`hybrid_mesh` — a Mesh whose ``data`` axis factors as
+  (dcn × ici): ``jax.experimental.mesh_utils.create_hybrid_device_mesh``
+  puts slice-local neighbors on the ICI portion, so the gradient psum
+  decomposes into a fast intra-slice reduce + one small cross-host hop —
+  the scaling-book recipe for data parallelism over pods.
+
+The training loop and serving runtime consume the result through the
+same :class:`~routest_tpu.core.mesh.MeshRuntime` as single-host code:
+going multi-host changes ONE call at program start, nothing else.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from routest_tpu.core.mesh import MeshRuntime
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None) -> None:
+    """Idempotent ``jax.distributed.initialize`` with env fallbacks.
+
+    Precedence: explicit kwargs → ``RTPU_COORDINATOR`` /
+    ``RTPU_NUM_PROCESSES`` / ``RTPU_PROCESS_ID`` env vars → jax's own
+    autodetection (TPU pod metadata / SLURM / Open MPI). Safe to call
+    when already initialized (no-op) and in single-process runs.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("RTPU_COORDINATOR")
+
+    def _env_int(name):
+        value = os.environ.get(name)
+        return int(value) if value is not None else None
+
+    num_processes = num_processes if num_processes is not None \
+        else _env_int("RTPU_NUM_PROCESSES")
+    process_id = process_id if process_id is not None \
+        else _env_int("RTPU_PROCESS_ID")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def hybrid_mesh(ici_data: int = -1, dcn_data: int = -1,
+                model: int = 1,
+                axis_names=("data", "model")) -> Mesh:
+    """Mesh for multi-slice/multi-host data parallelism.
+
+    The ``data`` axis has size ``dcn_data × ici_data`` laid out so that
+    consecutive data-shards sit on the same slice: XLA then lowers the
+    gradient psum to intra-slice ICI reduce-scatter/all-gather plus a
+    single DCN all-reduce of the per-slice partials.
+
+    Defaults (-1) infer: ``dcn_data`` = process count, ``ici_data`` =
+    local device count / model. Single-process (tests, one host) falls
+    back to a plain local mesh — same axis names, same consumers.
+    """
+    n_local = jax.local_device_count()
+    n_proc = jax.process_count()
+    if dcn_data == -1:
+        dcn_data = n_proc
+    if ici_data == -1:
+        ici_data = max(1, n_local // model)
+
+    if n_proc == 1 and dcn_data == 1:
+        devices = np.asarray(jax.devices()[: ici_data * model]).reshape(
+            ici_data, model)
+        return Mesh(devices, axis_names)
+
+    from jax.experimental import mesh_utils
+
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(ici_data, model),
+        dcn_mesh_shape=(dcn_data, 1),
+        devices=jax.devices(),
+    )
+    # (dcn*ici, model): flatten the dcn factor into the data axis
+    return Mesh(grid.reshape(dcn_data * ici_data, model), axis_names)
+
+
+def multihost_runtime(model: int = 1) -> MeshRuntime:
+    """One-call multi-host setup: initialize + hybrid mesh → MeshRuntime.
+
+    The intended program prologue on a pod::
+
+        from routest_tpu.core import distributed
+        runtime = distributed.multihost_runtime()
+        # … identical training/serving code as single-host …
+    """
+    initialize()
+    return MeshRuntime(mesh=hybrid_mesh(model=model))

@@ -37,12 +37,17 @@ _CLASS_SPEED_MPS = np.asarray([11.1, 8.3, 5.6])   # 40 / 30 / 20 km/h
 _CLASS_RUSH_SENSITIVITY = np.asarray([0.8, 0.5, 0.25])
 
 
-def _haversine_np(lat1, lon1, lat2, lon2):
+def haversine_np(lat1, lon1, lat2, lon2):
+    """Great-circle meters, vectorized numpy (host-side twin of
+    ``data.geo``'s jnp version; public — the road router builds on it)."""
     r = 6_371_008.8
     lat1, lon1, lat2, lon2 = map(np.radians, (lat1, lon1, lat2, lon2))
     a = (np.sin((lat2 - lat1) / 2) ** 2
          + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2) ** 2)
     return 2 * r * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+_haversine_np = haversine_np  # internal alias (existing call sites)
 
 
 def true_edge_time_s(length_m: np.ndarray, road_class: np.ndarray,

@@ -199,6 +199,11 @@ def optimize_route(input_data: dict) -> dict:
         segments.extend(s)
         total_dist += d
         total_dur += t
+    if not (math.isfinite(total_dist) and math.isfinite(total_dur)):
+        # A leg the solver accepted turned out unwalkable (e.g. a
+        # one-way-disconnected caller graph). Error out rather than emit
+        # `Infinity`, which is not valid JSON.
+        return {"error": "stops not routable over the road graph"}
 
     lons = [c[0] for c in coords]
     lats = [c[1] for c in coords]
@@ -237,7 +242,9 @@ def _point_to_point(source, destination, all_points,
     errors = []
     if payload > cap:
         errors.append("payload exceeds vehicle capacity")
-    if d_m > max_dist:
+    if not math.isfinite(d_m):
+        errors.append("stops not routable over the road graph")
+    elif d_m > max_dist:
         errors.append("route distance exceeds maximum_distance")
     if errors:
         return {"error": " | ".join(errors)}

@@ -36,8 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from routest_tpu.data.road_graph import (
-    _haversine_np,
     generate_road_graph,
+    haversine_np,
     true_edge_time_s,
 )
 
@@ -109,18 +109,34 @@ class RoadRouter:
         # diameter is O(√N) — 4√N is a comfortable bound, and the loop
         # exits early once converged anyway.
         self.max_iters = int(4 * np.sqrt(self.n_nodes)) + 8
+        # Device-resident graph arrays: uploaded once, not per request.
+        self._d_senders = jnp.asarray(self.senders)
+        self._d_receivers = jnp.asarray(self.receivers)
+        self._d_length = jnp.asarray(self.length_m)
 
     def _bridge_components(self, senders, receivers, length, road_class):
         """kNN graphs can come out disconnected; bridge every component to
         the largest with an edge between their closest node pair so every
-        snap target is reachable."""
-        import scipy.sparse as sp
-        from scipy.sparse.csgraph import connected_components
-
+        snap target is reachable. Pure numpy union-find — scipy is a test
+        oracle here, not a runtime dependency."""
         n = len(self.coords)
-        adj = sp.coo_matrix((np.ones(len(senders)), (senders, receivers)),
-                            shape=(n, n))
-        n_comp, labels = connected_components(adj, directed=False)
+        parent = np.arange(n)
+
+        def find(a: int) -> int:
+            root = a
+            while parent[root] != root:
+                root = parent[root]
+            while parent[a] != root:  # path compression
+                parent[a], a = root, parent[a]
+            return root
+
+        for s, r in zip(senders, receivers):
+            ra, rb = find(int(s)), find(int(r))
+            if ra != rb:
+                parent[rb] = ra
+        labels_raw = np.fromiter((find(i) for i in range(n)), np.int64, n)
+        _, labels = np.unique(labels_raw, return_inverse=True)
+        n_comp = int(labels.max()) + 1
         if n_comp <= 1:
             return senders, receivers, length, road_class
         sizes = np.bincount(labels)
@@ -131,7 +147,7 @@ class RoadRouter:
             if comp == main:
                 continue
             nodes = np.flatnonzero(labels == comp)
-            d = _haversine_np(
+            d = haversine_np(
                 self.coords[nodes, 0][:, None], self.coords[nodes, 1][:, None],
                 self.coords[main_nodes, 0][None, :],
                 self.coords[main_nodes, 1][None, :])
@@ -140,7 +156,7 @@ class RoadRouter:
             add_r.append(main_nodes[j])
         add_s = np.asarray(add_s, np.int32)
         add_r = np.asarray(add_r, np.int32)
-        bridge_len = (_haversine_np(
+        bridge_len = (haversine_np(
             self.coords[add_s, 0], self.coords[add_s, 1],
             self.coords[add_r, 0], self.coords[add_r, 1]) * 1.2).astype(np.float32)
         bridge_class = np.full(len(add_s), 1, np.int32)  # collector
@@ -152,17 +168,28 @@ class RoadRouter:
     def snap(self, latlon: np.ndarray) -> np.ndarray:
         """(M, 2) lat/lon → (M,) nearest graph node ids."""
         latlon = np.asarray(latlon, np.float32)
-        d = _haversine_np(latlon[:, 0][:, None], latlon[:, 1][:, None],
+        d = haversine_np(latlon[:, 0][:, None], latlon[:, 1][:, None],
                           self.coords[None, :, 0], self.coords[None, :, 1])
         return np.argmin(d, axis=1).astype(np.int32)
 
     def shortest(self, source_nodes: np.ndarray):
-        """(S,) nodes → ((S, N) distances m, (S, N) predecessor edge ids)."""
+        """(S,) nodes → ((S, N) distances m, (S, N) predecessor edge ids).
+
+        The source axis is padded to power-of-two buckets (duplicating
+        source 0) so varying waypoint counts reuse one compiled program
+        instead of recompiling the while_loop on the request path — the
+        same bucket trick as the serving batcher.
+        """
+        source_nodes = np.asarray(source_nodes, np.int32)
+        n_src = len(source_nodes)
+        bucket = 1 << max(0, (n_src - 1)).bit_length()
+        padded = np.full(bucket, source_nodes[0] if n_src else 0, np.int32)
+        padded[:n_src] = source_nodes
         dist, pred = _bellman_ford(
-            jnp.asarray(self.senders), jnp.asarray(self.receivers),
-            jnp.asarray(self.length_m), jnp.asarray(source_nodes, jnp.int32),
+            self._d_senders, self._d_receivers, self._d_length,
+            jnp.asarray(padded),
             n_nodes=self.n_nodes, max_iters=self.max_iters)
-        return np.asarray(dist), np.asarray(pred)
+        return np.asarray(dist)[:n_src], np.asarray(pred)[:n_src]
 
     def _walk(self, pred_row: np.ndarray, source: int, target: int) -> List[int]:
         """Predecessor edges → node sequence source..target (host-side)."""
@@ -201,7 +228,7 @@ class RoadRouter:
         # charge the point↔snapped-node gap into every leg (at collector
         # free-flow for the duration) so far-off-network points see
         # physically sensible totals instead of intra-graph-only paths.
-        snap_m = _haversine_np(
+        snap_m = haversine_np(
             points_latlon[:, 0], points_latlon[:, 1],
             self.coords[nodes, 0], self.coords[nodes, 1]).astype(np.float32)
         return RoadLegs(self, points_latlon, nodes, dist, pred, snap_m,
