@@ -3,7 +3,18 @@
 BASELINE.json config 2 ("route_optimizer_twx2 batch scoring") scaled up:
 HBM-resident OD batches through the ETA model. The reference scores one
 row per HTTP request on CPU (``Flaskr/ml.py:51-53``); the north-star
-target is ≥10,000 preds/sec (v5e-8). Prints ONE JSON line.
+target is >=10,000 preds/sec (v5e-8). Prints ONE JSON line on stdout,
+always — even when the accelerator is unreachable.
+
+Architecture (hardened after round 1, where backend init hung >400 s and
+the driver captured rc=1 with no JSON):
+
+* The PARENT process never imports jax. It launches the measurement as a
+  CHILD subprocess under a hard wall-clock deadline, first on the default
+  (TPU/axon) backend, then — if that child dies, hangs, or emits no
+  result — on the CPU backend with a smaller workload. Whatever happens,
+  the parent prints exactly one ``{"metric": ...}`` JSON line.
+* The CHILD (``ROUTEST_BENCH_CHILD=1``) does the actual timing.
 
 Methodology — the TPU is reached through a tunnel whose dispatch+fetch
 round trip is ~70 ms and highly variable, so host-side loops measure
@@ -13,30 +24,65 @@ no dead-code elimination, strict serialization) and the per-step time is
 the SLOPE between a short and a long loop, cancelling the fixed
 round-trip cost. Two forward paths are measured — the jit-compiled XLA
 model and the fused Pallas kernel (``ops/fused_mlp.py``, TPU only) — and
-the faster wins.
+the faster wins. A successful accelerator run is recorded to
+``artifacts/bench_tpu.json`` for audit.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 TARGET_PREDS_PER_SEC = 10_000.0  # BASELINE.json north star
+
+# Child workload knobs (overridable so the parent can shrink the CPU run).
 BATCH = 1 << 17                  # 131,072 OD pairs per device call
 N_SHORT, N_LONG = 100, 400       # fori_loop lengths for the slope
 REPEATS = 3
 
+# Parent deadlines (seconds). The driver killed round 1 at ~400 s with no
+# output, so both attempts PLUS the two 10 s post-kill pipe drains must
+# sum below that: 250 + 110 + 2*10 = 390 s worst case.
+TPU_ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_TPU_TIMEOUT", "250"))
+CPU_ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_CPU_TIMEOUT", "110"))
 
-def main() -> None:
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__)) or "."
+
+
+# ---------------------------------------------------------------------------
+# Child: the actual measurement (runs with jax imported, backend decided by
+# the environment the parent set).
+# ---------------------------------------------------------------------------
+
+def child_main() -> None:
+    import jax
+
+    # The sandbox's axon site customization re-exports JAX_PLATFORMS, so the
+    # env var cannot force the CPU backend — only the config API can
+    # (same workaround as tests/conftest.py).
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
     from routest_tpu.data.features import batch_from_mapping
     from routest_tpu.data.synthetic import generate_dataset
     from routest_tpu.models.eta_mlp import EtaMLP
     from routest_tpu.train.checkpoint import default_model_path, load_model
+
+    batch = int(os.environ.get("BENCH_BATCH", str(BATCH)))
+    n_short = int(os.environ.get("BENCH_N_SHORT", str(N_SHORT)))
+    n_long = int(os.environ.get("BENCH_N_LONG", str(N_LONG)))
+    repeats = int(os.environ.get("BENCH_REPEATS", str(REPEATS)))
+
+    t0 = time.perf_counter()
+    backend = jax.default_backend()  # forces backend init
+    init_s = time.perf_counter() - t0
+    print(f"bench: backend={backend} init={init_s:.1f}s", file=sys.stderr)
 
     try:
         model, params = load_model(default_model_path())
@@ -47,7 +93,7 @@ def main() -> None:
     # every jit call re-uploads the params.
     params = jax.device_put(params)
 
-    data = generate_dataset(BATCH, seed=123)
+    data = generate_dataset(batch, seed=123)
     x = jax.device_put(jnp.asarray(batch_from_mapping(data)))
 
     def make_runner(forward):
@@ -62,7 +108,7 @@ def main() -> None:
                 return xx.at[:, 10].add(eta * 1e-12), eta
 
             return jax.lax.fori_loop(
-                0, n_iters, body, (xx, jnp.zeros((BATCH,), jnp.float32)),
+                0, n_iters, body, (xx, jnp.zeros((batch,), jnp.float32)),
             )
 
         return run
@@ -78,15 +124,15 @@ def main() -> None:
 
         timed(2)  # compile + warm
         slopes = []
-        for _ in range(REPEATS):
-            t_short = timed(N_SHORT)
-            t_long = timed(N_LONG)
-            slopes.append((t_long - t_short) / (N_LONG - N_SHORT))
+        for _ in range(repeats):
+            t_short = timed(n_short)
+            t_long = timed(n_long)
+            slopes.append((t_long - t_short) / (n_long - n_short))
         return max(float(np.median(slopes)), 1e-9)
 
     candidates = {"xla": measure(lambda xx: model.apply(params, xx))}
 
-    if jax.default_backend() == "tpu":
+    if backend == "tpu":
         try:
             from routest_tpu.ops import fused_eta_forward, pack_eta_params
 
@@ -99,16 +145,126 @@ def main() -> None:
 
     path = min(candidates, key=candidates.get)
     per_iter = candidates[path]
-    preds_per_sec = BATCH / per_iter
+    preds_per_sec = batch / per_iter
     print(json.dumps({
         "metric": "od_eta_preds_per_sec",
         "value": round(preds_per_sec, 1),
         "unit": "preds/s",
         "vs_baseline": round(preds_per_sec / TARGET_PREDS_PER_SEC, 3),
+        "backend": backend,
+        "path": path,
+        "batch": batch,
+        "init_s": round(init_s, 1),
+        "paths_mps": {k: round(batch / v / 1e6, 2)
+                      for k, v in candidates.items()},
     }))
-    print(f"bench: path={path} " + " ".join(
-        f"{k}={BATCH / v / 1e6:.1f}M/s" for k, v in candidates.items()),
-        file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# Parent: watchdog. Never imports jax; always prints one JSON line.
+# ---------------------------------------------------------------------------
+
+def _scan_result(stdout) -> dict | None:
+    if isinstance(stdout, bytes):  # TimeoutExpired may carry raw bytes
+        stdout = stdout.decode("utf-8", "replace")
+    for line in reversed((stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _run_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
+    """Run the measurement child; return (parsed JSON record, diagnostic)."""
+    import signal
+
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["ROUTEST_BENCH_CHILD"] = "1"
+    timed_out = False
+    try:
+        # Own session so the deadline can killpg the whole tree: the JAX
+        # tunnel runtime may spawn helpers that inherit the pipes, and a
+        # plain child-kill would leave subprocess blocked on the pipe.
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=_REPO_DIR, start_new_session=True,
+        )
+    except Exception as e:  # noqa: BLE001 - diagnostic path
+        return None, f"spawn failed: {type(e).__name__}: {e}"
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            stdout, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired as e:
+            # A setsid'd tunnel helper outside the killed group can hold
+            # the pipe open; keep whatever the child managed to print.
+            stdout = e.stdout or ""
+            stderr = e.stderr or ""
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode("utf-8", "replace")
+    sys.stderr.write((stderr or "")[-2000:])
+    # A child that printed its result and then hung in interpreter/backend
+    # teardown (a known tunnel failure mode) still counts as a success.
+    rec = _scan_result(stdout)
+    if rec is not None:
+        return rec, ""
+    if timed_out:
+        return None, f"timeout after {timeout_s:.0f}s"
+    tail = (stderr or stdout or "").strip().splitlines()[-3:]
+    return None, f"rc={proc.returncode} no result line; tail={' | '.join(tail)}"
+
+
+def main() -> None:
+    if os.environ.get("ROUTEST_BENCH_CHILD") == "1":
+        child_main()
+        return
+
+    diags = []
+    # Attempt 1: default backend (TPU via axon when available).
+    rec, diag = _run_child({}, TPU_ATTEMPT_TIMEOUT)
+    if rec is None:
+        diags.append(f"accel: {diag}")
+        # Attempt 2: CPU fallback, smaller workload so it finishes fast.
+        rec, diag = _run_child(
+            {"BENCH_FORCE_CPU": "1", "BENCH_BATCH": str(1 << 14),
+             "BENCH_N_SHORT": "10", "BENCH_N_LONG": "40",
+             "BENCH_REPEATS": "2"},
+            CPU_ATTEMPT_TIMEOUT)
+        if rec is None:
+            diags.append(f"cpu: {diag}")
+
+    if rec is None:
+        # Total failure: still emit a parseable record with diagnostics.
+        print(json.dumps({
+            "metric": "od_eta_preds_per_sec", "value": 0.0,
+            "unit": "preds/s", "vs_baseline": 0.0,
+            "error": "; ".join(diags),
+        }))
+        return
+
+    if diags:
+        rec["note"] = "; ".join(diags)
+    if rec.get("backend") == "tpu":
+        try:
+            art_dir = os.path.join(_REPO_DIR, "artifacts")
+            os.makedirs(art_dir, exist_ok=True)
+            with open(os.path.join(art_dir, "bench_tpu.json"), "w") as f:
+                json.dump(dict(rec, recorded_unix=int(time.time())), f,
+                          indent=2)
+        except OSError as e:
+            print(f"bench: could not record artifact: {e}", file=sys.stderr)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

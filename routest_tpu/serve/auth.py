@@ -16,6 +16,9 @@ exists here as a first-class serving module:
   Breeze emails a link, these endpoints RETURN the token/link payload
   directly — no SMTP dependency, same state machine. The verify-email
   hash is sha1(email), matching Laravel's signed-URL ingredient.
+  Exception: under ``ROUTEST_AUTH=require`` the reset token is written
+  to the server log instead of the response, so the bearer gate cannot
+  be bypassed by an anonymous forgot-password call.
 
 Status-code parity with Breeze: validation failures are 422 (including
 bad credentials — Laravel's ValidationException), missing/invalid
@@ -36,6 +39,8 @@ import secrets
 import threading
 import uuid
 from typing import Dict, Optional, Tuple
+
+from routest_tpu.utils.logging import get_logger
 
 _PBKDF2_ITERS = 60_000
 _RESET_TTL_S = 3600.0
@@ -262,11 +267,22 @@ def mount_auth(app, auth: AuthService) -> None:
     def forgot_password(request):
         body = get_json(request) or {}
         token = auth.forgot_password(str(body.get("email") or ""))
-        # Hermetic stand-in for the reset email; same anti-enumeration
-        # message either way, token only when the account exists.
+        # Hermetic stand-in for the reset email: identical anti-enumeration
+        # response either way. The token itself is returned ONLY when auth
+        # is not enforced (dev/test convenience); under ROUTEST_AUTH=require
+        # handing it to an anonymous caller would let anyone take over any
+        # account whose email they know — there it goes to the server log
+        # (the "mailbox"), never the HTTP response.
         payload = {"status": "We have emailed your password reset link."}
         if token is not None:
-            payload["reset_token"] = token
+            if auth.required:
+                # JsonLogger json-escapes fields, so an attacker-chosen
+                # email cannot inject forged lines into the token stream.
+                get_logger("routest.auth").info(
+                    "password_reset_token_issued",
+                    email=str(body.get("email") or ""), token=token)
+            else:
+                payload["reset_token"] = token
         return payload, 200
 
     @app.route("/api/auth/reset-password", methods=("POST",))

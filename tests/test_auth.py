@@ -157,6 +157,24 @@ def test_auth_required_gates_history_delete(model_artifact):
     assert r.status_code == 404
 
 
+def test_required_mode_never_returns_reset_token(model_artifact):
+    # Under ROUTEST_AUTH=require, handing the reset token to an anonymous
+    # caller would let anyone hijack any known email; it must go to the
+    # server log only.
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    client = Client(create_app(Config(), eta_service=eta,
+                               auth=AuthService(required=True)))
+    _register(client)
+    r = client.post("/api/auth/forgot-password",
+                    json={"email": "ana@example.com"})
+    assert r.status_code == 200
+    assert "reset_token" not in r.get_json()
+    # Response is indistinguishable from the unknown-email case.
+    r2 = client.post("/api/auth/forgot-password",
+                     json={"email": "nobody@example.com"})
+    assert r.get_json() == r2.get_json()
+
+
 def test_second_forgot_invalidates_first_reset_token(client):
     _register(client)
     t1 = client.post("/api/auth/forgot-password",
