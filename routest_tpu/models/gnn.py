@@ -40,9 +40,9 @@ from routest_tpu.core.dtypes import DEFAULT_POLICY, Policy
 Params = Dict
 
 _N_CLASSES = 3
-_N_HOURS = 24
-# [log_length, speed_limit/10] + class one-hot + hour one-hot
-N_EDGE_FEATURES = 2 + _N_CLASSES + _N_HOURS
+_N_HOUR_FEATURES = 4  # two Fourier harmonics of hour-of-day
+# [log_length, speed_limit/10] + class one-hot + cyclical hour
+N_EDGE_FEATURES = 2 + _N_CLASSES + _N_HOUR_FEATURES
 
 
 class GraphBatch(NamedTuple):
@@ -55,14 +55,39 @@ class GraphBatch(NamedTuple):
     weights: jax.Array     # (E,) 0/1 (padding mask)
 
 
-def edge_features(graph: Dict[str, np.ndarray]) -> np.ndarray:
-    e = len(graph["senders"])
+def _hour_features(hour: np.ndarray) -> np.ndarray:
+    """(E,) hour-of-day → (E, 4) Fourier features.
+
+    Cyclical, not one-hot: the model has to learn the *shape* of the
+    congestion curve, so it can generalize to hours whose labels were
+    held out of training — the non-circular evaluation regime
+    (``scripts/train_gnn.py``). One-hot hours could only memorize
+    per-hour offsets.
+    """
+    ang = np.asarray(hour, np.float32) * np.float32(2.0 * np.pi / 24.0)
+    return np.stack([np.sin(ang), np.cos(ang),
+                     np.sin(2 * ang), np.cos(2 * ang)], axis=-1)
+
+
+def edge_feature_array(length_m: np.ndarray, speed_limit: np.ndarray,
+                       road_class: np.ndarray, hour) -> np.ndarray:
+    """Edge features from raw arrays; ``hour`` is scalar or (E,).
+
+    Public for serving: the road router builds features at the request's
+    pickup hour without a full graph dict.
+    """
+    e = len(length_m)
     out = np.zeros((e, N_EDGE_FEATURES), np.float32)
-    out[:, 0] = np.log1p(graph["length_m"])
-    out[:, 1] = graph["speed_limit"] / 10.0
-    out[np.arange(e), 2 + graph["road_class"]] = 1.0
-    out[np.arange(e), 2 + _N_CLASSES + graph["hour"]] = 1.0
+    out[:, 0] = np.log1p(length_m)
+    out[:, 1] = speed_limit / 10.0
+    out[np.arange(e), 2 + road_class] = 1.0
+    out[:, 2 + _N_CLASSES:] = _hour_features(np.broadcast_to(hour, (e,)))
     return out
+
+
+def edge_features(graph: Dict[str, np.ndarray]) -> np.ndarray:
+    return edge_feature_array(graph["length_m"], graph["speed_limit"],
+                              graph["road_class"], graph["hour"])
 
 
 def graph_batch(graph: Dict[str, np.ndarray], pad_to: int = 0) -> GraphBatch:

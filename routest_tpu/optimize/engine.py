@@ -17,6 +17,7 @@ messages the frontend already handles.
 
 from __future__ import annotations
 
+import datetime as dt
 import math
 from typing import Dict, List, Optional, Sequence
 
@@ -62,6 +63,17 @@ def _leg_steps(p0, p1, name: str, distance_m: float, duration_s: float,
             "way_points": [wp_end, wp_end],
         },
     ]
+
+
+def _pickup_hour(pickup_time) -> int:
+    """Hour-of-day for leg pricing; mirrors the ETA model's pickup_time
+    semantics (``Flaskr/ml.py:30-33``): parse ISO if given, else now."""
+    if pickup_time:
+        try:
+            return dt.datetime.fromisoformat(str(pickup_time)).hour
+        except ValueError:
+            pass
+    return dt.datetime.now().hour
 
 
 def _stop_name(point: Dict, idx: Optional[int]) -> str:
@@ -153,11 +165,14 @@ def optimize_route(input_data: dict) -> dict:
     # over the on-device road network — street-following geometry,
     # congestion-model durations (optimize/road_router.py).
     use_road = bool(input_data.get("road_graph"))
+    legs = None
     if use_road:
         from routest_tpu.optimize.road_router import default_router
 
         car_speed = geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("car")]
-        legs = default_router().route_legs(latlon, car_speed / speed)
+        legs = default_router().route_legs(
+            latlon, car_speed / speed,
+            hour=_pickup_hour(input_data.get("pickup_time")))
         dist = legs.dist_m
 
         def leg_cost(a: int, b: int):
@@ -170,9 +185,12 @@ def optimize_route(input_data: dict) -> dict:
         leg_cost, leg_geom = _gc_legs(all_points, dist, speed)
 
     if len(destinations) == 1:
-        return _point_to_point(source, destinations[0], all_points,
-                               leg_cost, leg_geom, driver_details,
-                               vehicle_type, cap, max_dist, use_road)
+        feature = _point_to_point(source, destinations[0], all_points,
+                                  leg_cost, leg_geom, driver_details,
+                                  vehicle_type, cap, max_dist, use_road)
+        if use_road and "error" not in feature:
+            feature["properties"]["leg_cost_model"] = legs.cost_model
+        return feature
 
     try:
         demands = np.asarray([float(p.get("payload", 0) or 0) for p in destinations],
@@ -227,6 +245,10 @@ def optimize_route(input_data: dict) -> dict:
         feature["properties"]["refined"] = True
     if use_road:
         feature["properties"]["road_graph"] = True
+        # Which pricer produced the durations: "gnn" (learned per-edge
+        # congestion) or "freeflow" physics — additive ABI for clients
+        # and tests to confirm learned costs are live.
+        feature["properties"]["leg_cost_model"] = legs.cost_model
     _annotate(feature, driver_details, vehicle_type)
     return feature
 

@@ -36,10 +36,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from routest_tpu.data.road_graph import (
+    _CLASS_SPEED_MPS,
     generate_road_graph,
     haversine_np,
-    true_edge_time_s,
 )
+from routest_tpu.utils.logging import get_logger
 
 _INF = jnp.float32(3e38)
 
@@ -47,11 +48,11 @@ _INF = jnp.float32(3e38)
 @functools.partial(jax.jit, static_argnames=("n_nodes", "max_iters"))
 def _bellman_ford(senders: jax.Array, receivers: jax.Array, w: jax.Array,
                   sources: jax.Array, *, n_nodes: int,
-                  max_iters: int) -> Tuple[jax.Array, jax.Array]:
-    """(S,) source nodes → (S, N) distances + (S, N) predecessor edges.
-
-    ``max_iters`` bounds the while_loop (≥ graph diameter for exactness;
-    the loop exits early the first sweep that changes nothing).
+                  max_iters: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(S,) source nodes → (S, N) distances, (S, N) predecessor edges,
+    and a scalar bool: True iff the loop CONVERGED (a sweep changed
+    nothing) rather than exhausting ``max_iters`` — the caller must not
+    trust distances when it is False.
     """
     n_src = sources.shape[0]
     dist0 = jnp.full((n_src, n_nodes), _INF).at[
@@ -67,8 +68,9 @@ def _bellman_ford(senders: jax.Array, receivers: jax.Array, w: jax.Array,
         _, changed, it = state
         return changed & (it < max_iters)
 
-    dist, _, _ = jax.lax.while_loop(
+    dist, still_changing, _ = jax.lax.while_loop(
         keep_going, relax, (dist0, jnp.asarray(True), jnp.zeros((), jnp.int32)))
+    converged = jnp.logical_not(still_changing)
 
     # Tight-edge predecessor recovery: among edges with
     # dist[s] + w == dist[r], any one lies on a shortest path; scatter-max
@@ -81,14 +83,16 @@ def _bellman_ford(senders: jax.Array, receivers: jax.Array, w: jax.Array,
         jnp.where(tight, e_ids, -1))
     # sources have distance 0; make them roots even if a tight cycle exists
     pred = pred.at[jnp.arange(n_src), sources].set(-1)
-    return dist, pred
+    return dist, pred, converged
 
 
 class RoadRouter:
     """Routable road network: snap → batched shortest paths → polylines."""
 
     def __init__(self, graph: Optional[Dict[str, np.ndarray]] = None,
-                 n_nodes: int = 2048, seed: int = 0) -> None:
+                 n_nodes: int = 2048, seed: int = 0,
+                 use_gnn: bool = True,
+                 gnn_path: Optional[str] = None) -> None:
         g = graph if graph is not None else generate_road_graph(
             n_nodes=n_nodes, seed=seed)
         self.coords = np.asarray(g["node_coords"], np.float32)   # (N, 2)
@@ -96,25 +100,128 @@ class RoadRouter:
         receivers = np.asarray(g["receivers"], np.int32)
         length = np.asarray(g["length_m"], np.float32)
         road_class = np.asarray(g["road_class"], np.int32)
-        senders, receivers, length, road_class = self._bridge_components(
-            senders, receivers, length, road_class)
+        speed_limit = np.asarray(
+            g.get("speed_limit", _CLASS_SPEED_MPS[road_class]), np.float32)
+        # GNN compatibility is checked against the PRE-bridge graph (what
+        # training sees); if bridging then adds edges, the learned model
+        # is refused below rather than served over a topology it never saw.
+        from routest_tpu.train.checkpoint import graph_fingerprint
+
+        self._train_fingerprint = graph_fingerprint(
+            self.coords, senders, receivers, length)
+        n_edges_raw = len(senders)
+        senders, receivers, length, road_class, speed_limit = \
+            self._bridge_components(senders, receivers, length, road_class,
+                                    speed_limit)
+        self._was_bridged = len(senders) != n_edges_raw
         self.senders, self.receivers = senders, receivers
         self.length_m = length
-        # Free-flow travel time per edge (congestion model at off-peak);
-        # vehicle profiles scale it uniformly in route_legs.
-        self.time_s = true_edge_time_s(
-            length, road_class, np.full(len(length), 12)).astype(np.float32)
+        self.road_class = road_class
+        self.speed_limit = speed_limit
+        # Fallback leg pricing: free-flow physics (length / speed limit +
+        # intersection overhead). Deliberately NOT the data generator's
+        # congestion formula — the request path must not depend on the
+        # synthetic ground truth it is supposed to predict.
+        self.freeflow_time_s = (
+            length / np.maximum(self.speed_limit, 0.1) + 4.0
+        ).astype(np.float32)
+        self.time_s = self.freeflow_time_s  # back-compat alias
         self.n_nodes = len(self.coords)
         # Bellman-Ford needs ≥ diameter sweeps; a kNN street grid's hop
-        # diameter is O(√N) — 4√N is a comfortable bound, and the loop
-        # exits early once converged anyway.
+        # diameter is O(√N) — 4√N is a comfortable first bound, and the
+        # loop exits early once converged. ``shortest`` re-runs with the
+        # exact N-1 bound if this heuristic is ever exhausted.
         self.max_iters = int(4 * np.sqrt(self.n_nodes)) + 8
         # Device-resident graph arrays: uploaded once, not per request.
         self._d_senders = jnp.asarray(self.senders)
         self._d_receivers = jnp.asarray(self.receivers)
         self._d_length = jnp.asarray(self.length_m)
+        # Learned leg costs: load the trained road-GNN when its training
+        # graph fingerprint matches this router's node set.
+        self._gnn = self._load_gnn(gnn_path) if use_gnn else None
+        self._hour_times: Dict[int, np.ndarray] = {}
+        self._gnn_lock = threading.Lock()
 
-    def _bridge_components(self, senders, receivers, length, road_class):
+    @property
+    def leg_cost_model(self) -> str:
+        """"gnn" when learned per-edge times serve requests, else
+        "freeflow"."""
+        return "gnn" if self._gnn is not None else "freeflow"
+
+    def _load_gnn(self, path: Optional[str]):
+        """(model, params) when a compatible artifact exists, else None.
+
+        The artifact is optional by design (same contract as the ETA
+        model's ``(None, None)`` fallback, ``Flaskr/ml.py:25-26``):
+        any failure here degrades to free-flow pricing, never an error.
+        """
+        from routest_tpu.train.checkpoint import default_gnn_path, load_gnn
+
+        resolved = path or default_gnn_path()
+        try:
+            model, params, meta = load_gnn(resolved)
+        except FileNotFoundError:
+            return None
+        except Exception as e:  # corrupt/foreign artifact: degrade, log
+            get_logger("routest.road").warning(
+                "road_gnn_artifact_unusable", path=resolved,
+                error=f"{type(e).__name__}: {e}")
+            return None
+        if meta != self._train_fingerprint:
+            # Expected whenever a custom/test graph is routed; debug only.
+            get_logger("routest.road").debug(
+                "road_gnn_graph_mismatch", path=resolved,
+                artifact=meta, router=self._train_fingerprint)
+            return None
+        if self._was_bridged:
+            # Training saw the unbridged edge set; serving it over extra
+            # bridge edges would perturb aggregation at their endpoints.
+            get_logger("routest.road").warning(
+                "road_gnn_refused_bridged_graph", path=resolved)
+            return None
+        return model, params
+
+    def edge_time_s(self, hour: int) -> np.ndarray:
+        """(E,) per-edge car travel seconds at the given hour-of-day.
+
+        GNN-predicted when the trained artifact matches this graph
+        (cached per hour — 24 small tables max), free-flow physics
+        otherwise. This is the on-device replacement for the reference's
+        "ask ORS how long this leg takes" (``Flaskr/utils.py:97-109``).
+        """
+        if self._gnn is None:
+            return self.freeflow_time_s
+        h = int(hour) % 24
+        with self._gnn_lock:
+            cached = self._hour_times.get(h)
+            if cached is not None:
+                return cached
+        from routest_tpu.models.gnn import GraphBatch, edge_feature_array
+
+        model, params = self._gnn
+        e = len(self.length_m)
+        batch = GraphBatch(
+            senders=jnp.asarray(self.senders),
+            receivers=jnp.asarray(self.receivers),
+            edge_feats=jnp.asarray(edge_feature_array(
+                self.length_m, self.speed_limit, self.road_class, h)),
+            length_m=jnp.asarray(self.length_m),
+            speed_limit=jnp.asarray(self.speed_limit),
+            targets=jnp.zeros((e,), jnp.float32),
+            weights=jnp.ones((e,), jnp.float32),
+        )
+        pred = np.asarray(model.apply(params, jnp.asarray(self.coords), batch),
+                          np.float32)
+        # Physical floor: no edge is faster than free-flow at an
+        # arterial ceiling — guards against a degenerate prediction
+        # pricing an edge at ~0 s and distorting every route through it.
+        pred = np.maximum(pred, self.length_m / 16.7)  # 60 km/h cap
+        with self._gnn_lock:
+            self._hour_times[h] = pred
+        return pred
+
+    def _bridge_components(self, senders, receivers, length, road_class,
+                           speed_limit):
         """kNN graphs can come out disconnected; bridge every component to
         the largest with an edge between their closest node pair so every
         snap target is reachable. Pure numpy union-find — scipy is a test
@@ -138,7 +245,7 @@ class RoadRouter:
         _, labels = np.unique(labels_raw, return_inverse=True)
         n_comp = int(labels.max()) + 1
         if n_comp <= 1:
-            return senders, receivers, length, road_class
+            return senders, receivers, length, road_class, speed_limit
         sizes = np.bincount(labels)
         main = int(np.argmax(sizes))
         add_s, add_r = [], []
@@ -160,10 +267,12 @@ class RoadRouter:
             self.coords[add_s, 0], self.coords[add_s, 1],
             self.coords[add_r, 0], self.coords[add_r, 1]) * 1.2).astype(np.float32)
         bridge_class = np.full(len(add_s), 1, np.int32)  # collector
+        bridge_speed = np.full(len(add_s), _CLASS_SPEED_MPS[1], np.float32)
         return (np.concatenate([senders, add_s, add_r]),
                 np.concatenate([receivers, add_r, add_s]),
                 np.concatenate([length, bridge_len, bridge_len]),
-                np.concatenate([road_class, bridge_class, bridge_class]))
+                np.concatenate([road_class, bridge_class, bridge_class]),
+                np.concatenate([speed_limit, bridge_speed, bridge_speed]))
 
     def snap(self, latlon: np.ndarray) -> np.ndarray:
         """(M, 2) lat/lon → (M,) nearest graph node ids."""
@@ -185,10 +294,23 @@ class RoadRouter:
         bucket = 1 << max(0, (n_src - 1)).bit_length()
         padded = np.full(bucket, source_nodes[0] if n_src else 0, np.int32)
         padded[:n_src] = source_nodes
-        dist, pred = _bellman_ford(
+        dist, pred, converged = _bellman_ford(
             self._d_senders, self._d_receivers, self._d_length,
             jnp.asarray(padded),
             n_nodes=self.n_nodes, max_iters=self.max_iters)
+        if not bool(converged):
+            # The O(√N) diameter heuristic was exhausted while distances
+            # were still improving (possible on long chains, e.g. after
+            # component bridging, or user-supplied path-like graphs).
+            # Silently-wrong distances are never acceptable: re-run with
+            # the exact N-1 Bellman-Ford bound.
+            get_logger("routest.road").warning(
+                "bellman_ford_bound_exhausted", heuristic=self.max_iters,
+                exact=self.n_nodes, n_sources=n_src)
+            dist, pred, converged = _bellman_ford(
+                self._d_senders, self._d_receivers, self._d_length,
+                jnp.asarray(padded),
+                n_nodes=self.n_nodes, max_iters=self.n_nodes)
         return np.asarray(dist)[:n_src], np.asarray(pred)[:n_src]
 
     def _walk(self, pred_row: np.ndarray, source: int, target: int) -> List[int]:
@@ -211,7 +333,8 @@ class RoadRouter:
         return path[::-1]
 
     def route_legs(self, points_latlon: np.ndarray,
-                   time_scale: float = 1.0) -> "RoadLegs":
+                   time_scale: float = 1.0,
+                   hour: Optional[int] = None) -> "RoadLegs":
         """Legs between M waypoints over the road graph.
 
         One batched shortest-path solve up front (all M sources at once —
@@ -219,7 +342,9 @@ class RoadRouter:
         and polylines are LAZY and memoized, because the VRP consumes the
         full (M, M) distance matrix but the response only renders the ~M
         legs of the solved trips. ``time_scale`` maps free-flow car times
-        to the vehicle profile.
+        to the vehicle profile. ``hour`` (0-23, pickup hour) selects the
+        learned congestion regime when the GNN is active; None prices at
+        noon off-peak.
         """
         points_latlon = np.asarray(points_latlon, np.float32)
         nodes = self.snap(points_latlon)
@@ -231,8 +356,9 @@ class RoadRouter:
         snap_m = haversine_np(
             points_latlon[:, 0], points_latlon[:, 1],
             self.coords[nodes, 0], self.coords[nodes, 1]).astype(np.float32)
+        time_s = self.edge_time_s(12 if hour is None else hour)
         return RoadLegs(self, points_latlon, nodes, dist, pred, snap_m,
-                        time_scale)
+                        time_scale, time_s, self.leg_cost_model)
 
 
 _SNAP_SPEED_MPS = 8.3  # first/last-mile charged at collector free-flow
@@ -243,13 +369,17 @@ class RoadLegs:
 
     def __init__(self, router: RoadRouter, points: np.ndarray,
                  nodes: np.ndarray, dist: np.ndarray, pred: np.ndarray,
-                 snap_m: np.ndarray, time_scale: float) -> None:
+                 snap_m: np.ndarray, time_scale: float,
+                 time_s: Optional[np.ndarray] = None,
+                 cost_model: str = "freeflow") -> None:
         self._r = router
         self._points = points
         self._nodes = nodes
         self._pred = pred
         self._snap_m = snap_m
         self._time_scale = time_scale
+        self._time_s = time_s if time_s is not None else router.freeflow_time_s
+        self.cost_model = cost_model
         m = len(points)
         # Full matrix (the VRP input): graph distance + first/last mile.
         self.dist_m = dist[np.arange(m)[:, None], nodes[None, :]] \
@@ -271,7 +401,7 @@ class RoadLegs:
         else:
             # pred[i][b] is by construction the edge that enters b here
             dur = self._time_scale * (
-                float(sum(self._r.time_s[int(self._pred[i][b])]
+                float(sum(self._time_s[int(self._pred[i][b])]
                           for b in node_seq[1:]))
                 + (self._snap_m[i] + self._snap_m[j]) / _SNAP_SPEED_MPS)
             poly = [[float(self._r.coords[n, 1]), float(self._r.coords[n, 0])]

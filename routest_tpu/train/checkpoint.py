@@ -93,6 +93,101 @@ def default_model_path(cfg=None) -> str:
     )
 
 
+# ── Road-GNN serving artifact ─────────────────────────────────────────────
+#
+# Same MAGIC + header + msgpack layout as the ETA artifact, different
+# format tag. The header carries a fingerprint of the TRAINING graph's
+# node set (count + coordinate checksum): the model's message passing is
+# anchored to node embeddings, so serving it over a different node set
+# would silently produce garbage — the router refuses mismatched graphs
+# and falls back to free-flow physics.
+
+GNN_ARTIFACT_VERSION = 1
+
+
+def graph_fingerprint(node_coords: np.ndarray, senders: np.ndarray,
+                      receivers: np.ndarray, length_m: np.ndarray) -> dict:
+    """Nodes AND edges: the GNN's aggregation depends on the topology it
+    was trained over, so an edge-set drift (not just a node drift) must
+    also fail the serving-compatibility check."""
+    import zlib
+
+    def crc(a, dtype):
+        return int(zlib.crc32(np.ascontiguousarray(
+            np.asarray(a, dtype)).tobytes()))
+
+    return {
+        "n_nodes": int(np.asarray(node_coords).shape[0]),
+        "coords_crc32": crc(node_coords, np.float32),
+        "n_edges": int(len(senders)),
+        "edges_crc32": crc(senders, np.int32) ^ crc(receivers, np.int32)
+        ^ crc(length_m, np.float32),
+    }
+
+
+def save_gnn(path: str, model, params, graph: dict) -> None:
+    header = json.dumps(
+        {
+            "format": "routest_tpu.road_gnn",
+            "version": GNN_ARTIFACT_VERSION,
+            "hidden": int(model.hidden),
+            "n_rounds": int(model.n_rounds),
+            "n_nodes": int(model.n_nodes),
+            "compute_dtype": np.dtype(model.policy.compute_dtype).name,
+            "graph": graph_fingerprint(
+                graph["node_coords"], graph["senders"], graph["receivers"],
+                graph["length_m"]),
+        }
+    ).encode() + b"\n"
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    blob = serialization.msgpack_serialize(host_params)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(header)
+        f.write(blob)
+
+
+def load_gnn(path: str):
+    """→ (RoadGNN, params, graph fingerprint dict)."""
+    from routest_tpu.models.gnn import RoadGNN
+
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not a routest_tpu model artifact")
+        header = json.loads(f.readline().decode())
+        blob = f.read()
+    if header.get("format") != "routest_tpu.road_gnn":
+        raise ValueError(f"{path}: unknown artifact format {header.get('format')}")
+    if header.get("version") != GNN_ARTIFACT_VERSION:
+        raise ValueError(
+            f"{path}: road_gnn artifact version {header.get('version')} is "
+            f"incompatible (expects v{GNN_ARTIFACT_VERSION}); retrain via "
+            f"scripts/train_gnn.py")
+    import jax.numpy as jnp
+
+    from routest_tpu.core.dtypes import DEFAULT_POLICY
+
+    compute = header.get("compute_dtype", "bfloat16")
+    policy = dataclasses.replace(DEFAULT_POLICY,
+                                 compute_dtype=jnp.dtype(compute).type)
+    model = RoadGNN(n_nodes=header["n_nodes"], hidden=header["hidden"],
+                    n_rounds=header["n_rounds"], policy=policy)
+    params = serialization.msgpack_restore(blob)
+    params = jax.tree_util.tree_map(np.asarray, params)
+    return model, params, header.get("graph") or {}
+
+
+def default_gnn_path() -> str:
+    """``ROAD_GNN_PATH`` env override, then the in-repo artifact."""
+    return os.getenv("ROAD_GNN_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "artifacts",
+        "road_gnn.msgpack",
+    )
+
+
 # ── Orbax training checkpoints ────────────────────────────────────────────
 
 def save_checkpoint(ckpt_dir: str, step: int, state) -> None:
