@@ -136,6 +136,7 @@ class RoadRouter:
         self._d_senders = jnp.asarray(self.senders)
         self._d_receivers = jnp.asarray(self.receivers)
         self._d_length = jnp.asarray(self.length_m)
+        self._d_speed = jnp.asarray(self.speed_limit)
         # Learned leg costs: load the trained road-GNN when its training
         # graph fingerprint matches this router's node set.
         self._gnn = self._load_gnn(gnn_path) if use_gnn else None
@@ -201,12 +202,12 @@ class RoadRouter:
         model, params = self._gnn
         e = len(self.length_m)
         batch = GraphBatch(
-            senders=jnp.asarray(self.senders),
-            receivers=jnp.asarray(self.receivers),
+            senders=self._d_senders,
+            receivers=self._d_receivers,
             edge_feats=jnp.asarray(edge_feature_array(
                 self.length_m, self.speed_limit, self.road_class, h)),
-            length_m=jnp.asarray(self.length_m),
-            speed_limit=jnp.asarray(self.speed_limit),
+            length_m=self._d_length,
+            speed_limit=self._d_speed,
             targets=jnp.zeros((e,), jnp.float32),
             weights=jnp.ones((e,), jnp.float32),
         )

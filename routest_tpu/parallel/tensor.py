@@ -1,0 +1,160 @@
+"""Tensor parallelism: MLP trunks sharded over the mesh ``model`` axis.
+
+The reference has no parallelism of any kind (SURVEY.md §2.4 — its model
+is a batch-size-1 CPU tree walk, reference ``Flaskr/ml.py:51-53``); the
+``model`` mesh axis existed here since round 1 but carried only
+replicated weights. This module makes it real: Megatron-style sharding
+of the ETA trunk's weight matrices, the scaling path for when a scoring
+model outgrows one chip's HBM.
+
+Layout — alternating column/row parallelism, one ``psum`` per pair:
+
+- even matmuls are **column-parallel**: ``W (d_in, d_out)`` splits along
+  ``d_out``; each device computes its activation slice locally (bias is
+  sharded with it, gelu is elementwise — no communication);
+- odd matmuls are **row-parallel**: ``W`` splits along ``d_in``, which
+  matches the sharded activation from the previous layer; the partial
+  products are combined with one ``psum`` over the model axis and the
+  (replicated) bias is added after.
+
+So a (col, row) pair costs exactly one all-reduce — the canonical
+Megatron MLP schedule. The 2-wide output head is never worth sharding:
+when the schedule would end column-parallel, the final layer runs
+replicated instead (identical tiny matmul on every device, zero
+communication).
+
+Everything is a plain shard_map program over the existing params pytree:
+no new parameter format, gradients flow through the collectives, and the
+``data`` axis keeps sharding the batch orthogonally (the mesh is
+(data, model) — e.g. 4×2 on a v5e-8).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from routest_tpu.core.smap import shard_map
+from routest_tpu.models.eta_mlp import EtaMLP
+
+Params = Dict
+
+
+def _layer_modes(n_layers: int) -> list:
+    """Per-layer schedule: "col" (shard outputs), "row" (shard inputs +
+    psum), or "rep" (replicated — only for a final layer whose input
+    arrives unsharded)."""
+    modes = []
+    sharded = False  # is the activation entering this layer sharded?
+    for i in range(n_layers):
+        if sharded:
+            modes.append("row")
+            sharded = False
+        elif i == n_layers - 1:
+            modes.append("rep")
+        else:
+            modes.append("col")
+            sharded = True
+    return modes
+
+
+_MODE_SPECS = {
+    "col": lambda ax: {"w": P(None, ax), "b": P(ax)},
+    "row": lambda ax: {"w": P(ax, None), "b": P()},
+    "rep": lambda ax: {"w": P(), "b": P()},
+}
+
+
+def tp_param_specs(model: EtaMLP, data_axis: str = "data",
+                   model_axis: str = "model") -> Params:
+    """PartitionSpec pytree matching the EtaMLP params tree."""
+    modes = _layer_modes(len(model.hidden) + 1)
+    return {"layers": [_MODE_SPECS[m](model_axis) for m in modes],
+            "norm": {"mean": P(), "std": P()}}
+
+
+def _validate(model: EtaMLP, tp: int) -> None:
+    dims = tuple(model.hidden) + (2,)
+    modes = _layer_modes(len(dims))
+    for i, (mode, d_out) in enumerate(zip(modes, dims)):
+        if mode == "col" and d_out % tp:
+            raise ValueError(
+                f"column-parallel layer {i} output width {d_out} is not "
+                f"divisible by model-axis size {tp}")
+        if mode == "row" and dims[i - 1] % tp:
+            raise ValueError(
+                f"row-parallel layer {i} input width {dims[i - 1]} is not "
+                f"divisible by model-axis size {tp}")
+
+
+def shard_tp_params(params: Params, model: EtaMLP, mesh: Mesh,
+                    data_axis: str = "data",
+                    model_axis: str = "model") -> Params:
+    """device_put the params with the tensor-parallel layout."""
+    specs = tp_param_specs(model, data_axis, model_axis)
+    # tree_map's structure comes from the FIRST tree; params' array leaves
+    # line up with whole P objects in the spec tree (P is a tuple subclass,
+    # but it is never traversed because the zip stops at params' leaves).
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs,
+    )
+
+
+def make_tp_apply(model: EtaMLP, mesh: Mesh, data_axis: str = "data",
+                  model_axis: str = "model"):
+    """jitted (params, x) → (B,) ETA minutes with weights sharded over
+    ``model_axis`` and the batch over ``data_axis``.
+
+    Numerically matches ``EtaMLP.apply`` (row-parallel psum changes only
+    the f32 summation order). Params must be laid out per
+    :func:`tp_param_specs` (see :func:`shard_tp_params`).
+    """
+    tp = mesh.shape[model_axis]
+    _validate(model, tp)
+    param_specs = tp_param_specs(model, data_axis, model_axis)
+    n_layers = len(model.hidden) + 1
+    modes = _layer_modes(n_layers)
+    c = model.policy.compute_dtype
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(param_specs, P(data_axis)),
+                       out_specs=P(data_axis))
+    def tp_forward(params, x):
+        feats, dist_km = model._expand(params, x)
+        h = feats.astype(c)
+        for i, (mode, layer) in enumerate(zip(modes, params["layers"])):
+            w = layer["w"].astype(c)
+            b = layer["b"].astype(c)
+            if mode == "row":
+                h = jax.lax.psum(h @ w, model_axis) + b  # combine the pair
+            else:  # "col" computes its local slice; "rep" the full (tiny) head
+                h = h @ w + b
+            if i < n_layers - 1:
+                h = jax.nn.gelu(h)
+        out = h.astype(model.policy.output_dtype)
+        pace = jax.nn.softplus(out[..., 0])
+        overhead = jax.nn.softplus(out[..., 1])
+        return pace * dist_km.astype(model.policy.output_dtype) + overhead
+
+    return jax.jit(tp_forward)
+
+
+def make_tp_loss(model: EtaMLP, mesh: Mesh, data_axis: str = "data",
+                 model_axis: str = "model"):
+    """jitted (params, x, y) → scalar weighted MSE under the TP layout.
+
+    Differentiable end-to-end (XLA differentiates psum/all_gather), so
+    ``jax.grad`` of this IS the tensor-parallel training step's core.
+    """
+    tp_apply_inner = make_tp_apply(model, mesh, data_axis, model_axis)
+
+    def loss(params, x, y):
+        pred = tp_apply_inner(params, x)
+        return jnp.mean((pred - y) ** 2)
+
+    return jax.jit(loss)

@@ -7,7 +7,12 @@ and reports edge-time RMSE against two baselines:
   would use with no learning;
 - the noise floor (observed vs ground-truth time) — the best achievable.
 
-Usage: python scripts/train_gnn.py [--nodes 4096] [--steps 400] [--quick]
+Usage: python scripts/train_gnn.py [--nodes 2048] [--steps 400] [--quick]
+
+The default --nodes 2048 matches the serving router's graph, so the
+saved artifact's fingerprint lets the GNN go live on the request path;
+other sizes (and --quick) train for experimentation and are not saved
+to the serving path unless --save is given explicitly.
 """
 
 from __future__ import annotations
@@ -149,16 +154,24 @@ def main() -> None:
         json.dump(report, f, indent=2)
     print(f"      report → {out}")
 
-    if not args.no_save and report["beats_naive"]:
-        # Quality gate BEFORE overwriting the serving artifact: a failed
-        # run must never replace a good model on the request path.
+    # Save gates: (a) quality — a failed run must never replace a good
+    # model on the request path; (b) compatibility — the DEFAULT serving
+    # path only accepts the serving router's graph size, so a --quick or
+    # custom --nodes experiment can't overwrite the live artifact with a
+    # fingerprint the router would refuse (silent free-flow degradation).
+    serving_compatible = args.nodes == 2048 and not args.quick
+    if not args.no_save and report["beats_naive"] and (
+            args.save or serving_compatible):
         from routest_tpu.train.checkpoint import default_gnn_path, save_gnn
 
         artifact = args.save or default_gnn_path()
         save_gnn(artifact, model, params, graph)
         print(f"      artifact → {artifact}")
-    elif not args.no_save:
+    elif not args.no_save and not report["beats_naive"]:
         print("      artifact NOT saved: run did not beat the naive baseline")
+    elif not args.no_save:
+        print("      artifact NOT saved: non-serving graph size "
+              "(pass --save PATH to keep it)")
     sys.exit(0 if report["beats_naive"] else 1)
 
 
