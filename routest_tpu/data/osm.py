@@ -1,0 +1,180 @@
+"""OSM-format road-network ingest → the road-graph dict schema.
+
+The reference rents its street network from ORS/OSRM SaaS
+(``Flaskr/utils.py:55,97,151``); this framework routes on-device over a
+graph dict (``optimize/road_router.py``). Round 1 could only *generate*
+synthetic networks — this module closes the real-streets path: parse an
+OpenStreetMap XML extract (``.osm``, optionally gzipped) into the same
+flat-array schema, so ``RoadRouter(graph=load_osm(path))`` routes over
+actual street geometry. The synthetic generator remains the default for
+hermetic environments.
+
+Parsing model (stdlib ``xml.etree.iterparse``, element-by-element so a
+city extract does not balloon host memory):
+
+- ``<node id lat lon>`` — coordinate store;
+- ``<way>`` with a ``highway`` tag in the drivable set — split into one
+  edge per consecutive ``<nd>`` pair (finest granularity: every bend is
+  a graph vertex, lengths are true haversine);
+- ``oneway=yes/-1`` respected; everything else symmetrized;
+- ``maxspeed`` parsed ("50", "50 km/h", "30 mph"), else the class
+  default; highway class mapped onto the 3-class scheme the GNN and
+  free-flow pricer share (arterial / collector / local).
+
+Only nodes referenced by kept ways survive, re-indexed contiguously.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import xml.etree.ElementTree as ET
+from typing import Dict, IO, Tuple
+
+import numpy as np
+
+from routest_tpu.data.road_graph import _CLASS_SPEED_MPS, haversine_np
+
+# highway=* → road class (0 arterial, 1 collector, 2 local).
+_HIGHWAY_CLASS = {
+    "motorway": 0, "motorway_link": 0, "trunk": 0, "trunk_link": 0,
+    "primary": 0, "primary_link": 0,
+    "secondary": 1, "secondary_link": 1, "tertiary": 1, "tertiary_link": 1,
+    "unclassified": 2, "residential": 2, "living_street": 2, "service": 2,
+}
+
+_MPH_TO_MPS = 0.44704
+_KMH_TO_MPS = 1.0 / 3.6
+
+
+def _parse_maxspeed(value: str) -> float:
+    """OSM maxspeed text → m/s; raises ValueError on non-numeric forms
+    (``"walk"``, ``"none"``, zone refs) so the caller falls back."""
+    text = value.strip().lower()
+    if text.endswith("mph"):
+        return float(text[:-3].strip()) * _MPH_TO_MPS
+    if text.endswith("km/h"):
+        text = text[:-4].strip()
+    return float(text) * _KMH_TO_MPS
+
+
+def _open(path: str) -> IO[bytes]:
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def load_osm(path: str) -> Dict[str, np.ndarray]:
+    """Parse an OSM XML extract into the road-graph dict schema.
+
+    Returns the arrays ``RoadRouter`` consumes: ``node_coords`` (N, 2)
+    lat/lon, ``senders``/``receivers``/``length_m``/``road_class``/
+    ``speed_limit`` (E,). Raises ValueError for malformed XML or an
+    extract with no drivable ways.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+
+    coords: Dict[int, Tuple[float, float]] = {}
+    # per edge: (from_osm_id, to_osm_id, road_class, speed, both_ways)
+    segments = []
+
+    way_nodes = []
+    way_tags: Dict[str, str] = {}
+    root = None
+    try:
+        with _open(path) as f:
+            for event, elem in ET.iterparse(f, events=("start", "end")):
+                if event == "start":
+                    if root is None:
+                        root = elem  # the <osm> element accumulates children
+                    if elem.tag == "way":
+                        way_nodes = []
+                        way_tags = {}
+                    continue
+                if elem.tag == "node":
+                    try:
+                        coords[int(elem.get("id"))] = (
+                            float(elem.get("lat")), float(elem.get("lon")))
+                    except (TypeError, ValueError):
+                        pass  # nodes without coordinates cannot carry edges
+                elif elem.tag == "nd":
+                    ref = elem.get("ref")
+                    if ref is not None:
+                        way_nodes.append(int(ref))
+                elif elem.tag == "tag":
+                    k, v = elem.get("k"), elem.get("v")
+                    if k is not None and v is not None:
+                        way_tags[k] = v
+                elif elem.tag == "way":
+                    _ingest_way(way_nodes, way_tags, segments)
+                # elem.clear() alone is NOT enough: the root keeps an
+                # (emptied) child per element, linear in file size. Drop
+                # completed top-level children from the root itself so a
+                # metro extract streams in O(1) element memory.
+                if root is not None and elem is not root:
+                    elem.clear()
+                    if len(root) and root[-1] is elem:
+                        del root[-1]
+    except ET.ParseError as e:
+        raise ValueError(f"{path}: malformed OSM XML: {e}") from None
+
+    if not segments:
+        raise ValueError(f"{path}: no drivable highway ways found")
+
+    # Compact referenced nodes → contiguous indices.
+    used = sorted({n for s in segments for n in s[:2] if n in coords})
+    index = {osm_id: i for i, osm_id in enumerate(used)}
+    node_coords = np.asarray([coords[i] for i in used], np.float32)
+
+    senders, receivers, road_class, speed = [], [], [], []
+    for a, b, cls, spd, both in segments:
+        if a not in index or b not in index or a == b:
+            continue  # refs outside the extract boundary
+        senders.append(index[a])
+        receivers.append(index[b])
+        road_class.append(cls)
+        speed.append(spd)
+        if both:
+            senders.append(index[b])
+            receivers.append(index[a])
+            road_class.append(cls)
+            speed.append(spd)
+
+    if not senders:
+        raise ValueError(f"{path}: drivable ways reference no in-extract nodes")
+
+    senders = np.asarray(senders, np.int32)
+    receivers = np.asarray(receivers, np.int32)
+    length_m = haversine_np(
+        node_coords[senders, 0], node_coords[senders, 1],
+        node_coords[receivers, 0], node_coords[receivers, 1],
+    ).astype(np.float32)
+    return {
+        "node_coords": node_coords,
+        "senders": senders,
+        "receivers": receivers,
+        "length_m": length_m,
+        "road_class": np.asarray(road_class, np.int32),
+        "speed_limit": np.asarray(speed, np.float32),
+    }
+
+
+def _ingest_way(way_nodes, way_tags, segments) -> None:
+    highway = way_tags.get("highway")
+    cls = _HIGHWAY_CLASS.get(highway) if highway else None
+    if cls is None or len(way_nodes) < 2:
+        return
+    speed = float(_CLASS_SPEED_MPS[cls])
+    if "maxspeed" in way_tags:
+        try:
+            speed = _parse_maxspeed(way_tags["maxspeed"])
+        except ValueError:
+            pass  # non-numeric maxspeed: keep the class default
+    oneway = way_tags.get("oneway", "no").lower()
+    pairs = zip(way_nodes[:-1], way_nodes[1:])
+    if oneway == "-1":  # rare: oneway against drawing direction
+        pairs = zip(way_nodes[1:], way_nodes[:-1])
+    both = oneway not in ("yes", "true", "1", "-1")
+    for a, b in pairs:
+        segments.append((a, b, cls, speed, both))

@@ -422,9 +422,25 @@ _default_lock = threading.Lock()
 
 
 def default_router() -> RoadRouter:
-    """Process-wide router over the generated Metro Manila network."""
+    """Process-wide router: a real OSM extract when ``ROAD_GRAPH_OSM``
+    points at one (``data/osm.py``), else the generated Metro Manila
+    network. A bad extract degrades to the generator with a log line
+    rather than taking down routing."""
+    import os
+
     global _default_router
     with _default_lock:
         if _default_router is None:
-            _default_router = RoadRouter()
+            osm_path = os.environ.get("ROAD_GRAPH_OSM")
+            if osm_path:
+                from routest_tpu.data.osm import load_osm
+
+                try:
+                    _default_router = RoadRouter(graph=load_osm(osm_path))
+                except Exception as e:
+                    get_logger("routest.road").error(
+                        "osm_extract_unusable", path=osm_path,
+                        error=f"{type(e).__name__}: {e}")
+            if _default_router is None:
+                _default_router = RoadRouter()
         return _default_router
