@@ -91,16 +91,31 @@ def hybrid_mesh(ici_data: int = -1, dcn_data: int = -1,
     gradient psum to intra-slice ICI reduce-scatter/all-gather plus a
     single DCN all-reduce of the per-slice partials.
 
-    Defaults (-1) infer: ``dcn_data`` = process count, ``ici_data`` =
-    local device count / model. Single-process (tests, one host) falls
-    back to a plain local mesh — same axis names, same consumers.
+    Defaults (-1) infer the slice structure from the devices themselves:
+    ``dcn_data`` = number of distinct ``device.slice_index`` values and
+    ``ici_data`` = devices-per-slice / model. On v4/v5p pods one ICI
+    domain spans many hosts, so a per-PROCESS device count would
+    under-build the per-slice mesh ``create_hybrid_device_mesh``
+    expects; slice grouping is the ground truth. Platforms without
+    ``slice_index`` (CPU tests, single-host) fall back to process-count
+    × local-device-count, which is exact there. Single-process runs get
+    a plain local mesh — same axis names, same consumers.
     """
     n_local = jax.local_device_count()
     n_proc = jax.process_count()
-    if dcn_data == -1:
-        dcn_data = n_proc
-    if ici_data == -1:
-        ici_data = max(1, n_local // model)
+    if dcn_data == -1 or ici_data == -1:
+        devices = jax.devices()
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        if None not in slice_ids and slice_ids:
+            n_slices = len(slice_ids)
+            per_slice = len(devices) // n_slices
+        else:
+            n_slices = n_proc
+            per_slice = n_local
+        if dcn_data == -1:
+            dcn_data = n_slices
+        if ici_data == -1:
+            ici_data = max(1, per_slice // model)
 
     if n_proc == 1 and dcn_data == 1:
         devices = np.asarray(jax.devices()[: ici_data * model]).reshape(

@@ -100,6 +100,14 @@ def _load_csv_python(path: str) -> Dict[str, np.ndarray]:
     with open(path, newline="") as f:
         header_seen = False
         for lineno, line in enumerate(f, start=1):
+            # Native-parser parity: its 4096-byte fgets buffer rejects any
+            # physical line of 4095+ content BYTES (code -4) — count bytes,
+            # not codepoints, or non-ASCII categories parse-or-error
+            # differently under the two parsers.
+            content_len = len(line.encode("utf-8", "surrogateescape")) \
+                - (1 if line.endswith("\n") else 0)
+            if content_len >= 4095:
+                raise ValueError(f"{path}:{lineno}: line exceeds 4094 bytes")
             line = line.strip("\r\n")
             if not line:
                 continue

@@ -163,6 +163,8 @@ def parse_csv(path: str, weather_vocab, traffic_vocab):
         raise FileNotFoundError(path)
     if n == -2:
         raise ValueError(f"{path}:{err_line.value}: expected 7 fields")
+    if n == -4:
+        raise ValueError(f"{path}:{err_line.value}: line exceeds 4094 bytes")
     if n == -3:
         raise ValueError(f"{path}:{err_line.value}: non-numeric field")
     return {k: v[:n] for k, v in cols.items()}

@@ -124,10 +124,11 @@ int64_t ff_parse_csv(const char* path,
         if (len == sizeof(line) - 1 && line[len - 1] != '\n') {
             // Overlong physical line: fgets would silently split it into
             // bogus rows. No valid row in this 7-field schema approaches
-            // 4 KB, so reject instead of mis-parsing.
+            // 4 KB, so reject instead of mis-parsing. Distinct code so
+            // the Python fallback can mirror the exact same contract.
             fclose(f);
             *err_line = lineno;
-            return -2;
+            return -4;
         }
         while (len && (line[len - 1] == '\n' || line[len - 1] == '\r'))
             line[--len] = '\0';

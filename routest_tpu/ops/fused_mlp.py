@@ -184,6 +184,10 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *,
     ws, bs = packed["w"], packed["b"]
     n_layers = len(ws)
     b_rows = x.shape[0]
+    if b_rows == 0:
+        # A zero-row batch would make the tile (and grid) degenerate —
+        # _round_up(0, 0) divides by zero. Nothing to score.
+        return jnp.zeros((0,), jnp.float32)
     tile = min(tile, _round_up(b_rows, 8))
     b_pad = _round_up(b_rows, tile)
 

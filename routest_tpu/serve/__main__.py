@@ -35,9 +35,26 @@ def ensure_model(path: str) -> None:
 
 
 def main() -> None:
+    if os.environ.get("ROUTEST_FORCE_CPU") == "1":
+        # JAX_PLATFORMS env is re-exported by the axon site hook; only the
+        # config API reliably selects the hermetic CPU backend.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     config = load_config()
     ensure_model(default_model_path(config.model))
     app = create_app(config)
+    # HTTP/1.1 keep-alive: werkzeug defaults to 1.0 (connection-per-
+    # request), which taxes every call with TCP setup + a fresh handler
+    # thread. Persistent connections cut the serving tail roughly in half
+    # under concurrent load.
+    from werkzeug.serving import WSGIRequestHandler
+
+    WSGIRequestHandler.protocol_version = "HTTP/1.1"
     print(f"[serve] listening on {config.serve.host}:{config.serve.port}")
     run_simple(config.serve.host, config.serve.port, app, threaded=True)
 

@@ -99,6 +99,31 @@ def test_csv_malformed_rows_error_with_line(tmp_path):
             assert ":2:" in str(ei.value)  # 1-based offending line
 
 
+def test_csv_overlong_line_same_error_both_paths(tmp_path):
+    # The native parser's 4096-byte fgets buffer rejects 4095+-byte
+    # physical lines; the Python fallback must reject the SAME file with
+    # the SAME error, not quietly map the long category to -1 (the round-1
+    # parity pinhole, ADVICE r1).
+    path = str(tmp_path / "long.csv")
+    with open(path, "w") as f:
+        f.write("weather,traffic,weekday,hour,distance_km,driver_age,eta_minutes\n")
+        f.write("W" * 5000 + ",Low,0,0,1.0,30,10\n")
+    for force in (False, True):
+        with pytest.raises(ValueError, match="line exceeds 4094 bytes") as ei:
+            csv_io.load_csv(path, force_python=force)
+        assert ":2:" in str(ei.value)
+
+    # Just UNDER the cap parses identically on both paths: an unknown
+    # 4070-byte category maps to -1, not an error.
+    ok_path = str(tmp_path / "ok.csv")
+    with open(ok_path, "w") as f:
+        f.write("weather,traffic,weekday,hour,distance_km,driver_age,eta_minutes\n")
+        f.write("W" * 4070 + ",Low,0,0,1.0,30,10\n")
+    for force in (False, True):
+        d = csv_io.load_csv(ok_path, force_python=force)
+        assert d["weather_idx"].tolist() == [-1]
+
+
 def test_csv_missing_file(tmp_path):
     with pytest.raises(FileNotFoundError):
         csv_io.load_csv(str(tmp_path / "nope.csv"))

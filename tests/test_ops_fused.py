@@ -55,6 +55,15 @@ def test_fused_odd_batch_sizes():
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
 
 
+def test_fused_empty_batch():
+    # Zero rows must return zero predictions, not a degenerate grid
+    # (round-1 ADVICE: tile=0 → ZeroDivisionError).
+    model, params, feats = _model_and_params()
+    packed = pack_eta_params(model, params)
+    got = np.asarray(fused_eta_forward(packed, feats[:0], interpret=True))
+    assert got.shape == (0,) and got.dtype == np.float32
+
+
 def test_fused_unknown_categories_and_negative_distance():
     model, params, _ = _model_and_params()
     packed = pack_eta_params(model, params)
