@@ -22,6 +22,8 @@ MLP when "the same model class as the reference" matters.
 from __future__ import annotations
 
 import dataclasses
+import gzip
+import json
 from typing import Dict, Tuple
 
 import jax
@@ -107,3 +109,121 @@ def from_sklearn(model) -> Tuple[GBDT, Params]:
                                 jnp.float32),
     }
     return GBDT(n_trees=n_trees, max_nodes=max_nodes, max_depth=max_depth), params
+
+
+# ── XGBoost importer ──────────────────────────────────────────────────────
+#
+# The reference's production artifact IS an XGBoost regressor
+# (``xgb_eta_model.pkl`` — an unmaterialized LFS pointer; ``Flaskr/
+# ml.py:11-21`` lazily unpickles it). Unpickling needs the xgboost
+# package; the portable route is XGBoost's own JSON model format
+# (``booster.save_model("m.json")``, one line for any operator holding
+# the pkl). This importer converts that JSON into the same padded
+# arrays ``GBDT.apply`` runs on device — so the reference's actual
+# trees can serve at TPU batch throughput.
+#
+# Semantics preserved exactly:
+# - xgboost routes ``x < split_condition`` LEFT (strict); GBDT.apply
+#   tests ``x <= thr``. Thresholds are converted with float32
+#   ``nextafter(thr, -inf)``: for every float32 x, ``x < thr`` ⟺
+#   ``x <= pred(thr)`` — bit-exact, not approximate.
+# - missing values (NaN) follow ``default_left`` per node.
+# - leaf values live in ``split_conditions`` at leaf nodes in the JSON
+#   schema; prediction = base_score + Σ leaf values (identity link, so
+#   only ``reg:*`` objectives are accepted).
+
+
+def from_xgboost_json(path: str) -> Tuple[GBDT, Params]:
+    """XGBoost JSON model file (optionally .gz) → (GBDT, params)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    try:
+        learner = data["learner"]
+        objective = learner["objective"]["name"]
+        trees = learner["gradient_booster"]["model"]["trees"]
+        base_score = float(learner["learner_model_param"]["base_score"])
+    except (KeyError, TypeError) as e:
+        raise ValueError(f"{path}: not an XGBoost JSON model ({e})") from None
+    if not objective.startswith("reg:"):
+        raise ValueError(
+            f"{path}: objective {objective!r} needs a non-identity link; "
+            f"only reg:* objectives are supported")
+    if not trees:
+        raise ValueError(f"{path}: model has no trees")
+
+    n_trees = len(trees)
+    max_nodes = max(len(t["left_children"]) for t in trees)
+    feature = np.zeros((n_trees, max_nodes), np.int32)
+    threshold = np.full((n_trees, max_nodes), np.inf, np.float32)
+    left = np.zeros((n_trees, max_nodes), np.int32)
+    right = np.zeros((n_trees, max_nodes), np.int32)
+    value = np.zeros((n_trees, max_nodes), np.float32)
+    missing_left = np.zeros((n_trees, max_nodes), bool)
+    max_depth = 1
+
+    for t, tree in enumerate(trees):
+        lc = np.asarray(tree["left_children"], np.int32)
+        rc = np.asarray(tree["right_children"], np.int32)
+        cond = np.asarray(tree["split_conditions"], np.float32)
+        split_idx = np.asarray(tree["split_indices"], np.int32)
+        default = np.asarray(tree["default_left"], bool)
+        n = len(lc)
+        is_leaf = lc == -1
+        idx = np.arange(n, dtype=np.int32)
+        feature[t, :n] = np.where(is_leaf, 0, split_idx)
+        # strict-less-than → less-or-equal via float32 predecessor
+        threshold[t, :n] = np.where(
+            is_leaf, np.inf,
+            np.nextafter(cond, np.float32(-np.inf), dtype=np.float32))
+        left[t, :n] = np.where(is_leaf, idx, lc)
+        right[t, :n] = np.where(is_leaf, idx, rc)
+        value[t, :n] = np.where(is_leaf, cond, 0.0)  # leaf value slot
+        missing_left[t, :n] = np.where(is_leaf, False, default)
+        max_depth = max(max_depth, _tree_depth(lc, rc))
+
+    params: Params = {
+        "feature": jnp.asarray(feature),
+        "threshold": jnp.asarray(threshold),
+        "left": jnp.asarray(left),
+        "right": jnp.asarray(right),
+        "value": jnp.asarray(value),
+        "missing_left": jnp.asarray(missing_left),
+        "baseline": jnp.asarray(base_score, jnp.float32),
+    }
+    return GBDT(n_trees=n_trees, max_nodes=max_nodes,
+                max_depth=max_depth), params
+
+
+def _tree_depth(lc: np.ndarray, rc: np.ndarray) -> int:
+    """Edge-count depth of the deepest leaf, iteratively (no recursion
+    limits on degenerate chain trees)."""
+    depth = np.zeros(len(lc), np.int32)
+    best = 0
+    stack = [0]
+    while stack:
+        node = stack.pop()
+        for child in (lc[node], rc[node]):
+            if child >= 0:
+                depth[child] = depth[node] + 1
+                best = max(best, int(depth[child]))
+                stack.append(int(child))
+    return best + 1  # descent rounds needed (root round included)
+
+
+@dataclasses.dataclass(frozen=True)
+class XGBoostEta:
+    """EtaService-compatible wrapper: the reference's 12-feature ABI
+    (SURVEY.md Appendix B) in, minutes out — the drop-in stand-in for
+    ``Flaskr/ml.py``'s pickled booster, running as tensor ops."""
+
+    gbdt: GBDT
+    n_features: int = 12
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        return self.gbdt.apply(params, jnp.asarray(x, jnp.float32))
+
+
+def load_xgboost_eta(path: str) -> Tuple[XGBoostEta, Params]:
+    gbdt, params = from_xgboost_json(path)
+    return XGBoostEta(gbdt=gbdt), params

@@ -266,8 +266,20 @@ class EtaService:
     def _load(self, path: str) -> None:
         try:
             self._model, self._params = load_model(path)
+            return
         except Exception as e:
-            self._error = f"{type(e).__name__}: {e}"
+            first_error = f"{type(e).__name__}: {e}"
+        # ETA_MODEL_PATH may point at the reference's actual model family:
+        # an XGBoost regressor exported to XGBoost's JSON format
+        # (``Flaskr/ml.py:11-21`` unpickles the same trees). Serve it via
+        # the tensorized GBDT path — same 12-feature ABI, batched on
+        # device instead of row-at-a-time CPU walks.
+        try:
+            from routest_tpu.models.gbdt import load_xgboost_eta
+
+            self._model, self._params = load_xgboost_eta(path)
+        except Exception:
+            self._error = first_error
 
     @property
     def available(self) -> bool:

@@ -1,0 +1,84 @@
+-- routest_tpu executable schema (PostgreSQL / Supabase).
+--
+-- Canonical DDL for the persistence layer (serve/store.py). Mirrors the
+-- reference's Laravel migrations --
+-- locations:      backend/laravel/database/migrations/2025_08_12_144039_create_locations_table.php:10-16
+-- route_requests: ...144349_create_route_requests_table.php:10-18
+-- route_results:  ...144521_create_route_results_table.php:10-20
+-- -- PLUS the runtime drift columns the reference's Flask service writes
+-- outside its own migrations (Flaskr/routes.py:148-155,167-176):
+-- route_requests.engine / vehicle_id / driver_age and
+-- route_results.geometry / eta_minutes_ml / eta_completion_time_ml.
+-- Apply to a fresh database with:  psql "$DATABASE_URL" -f schema.sql
+
+BEGIN;
+
+CREATE TABLE IF NOT EXISTS locations (
+  id         uuid PRIMARY KEY,
+  name       text NOT NULL,
+  latitude   numeric(9, 6) NOT NULL,
+  longitude  numeric(9, 6) NOT NULL,
+  created_at timestamptz NOT NULL DEFAULT now()
+);
+
+CREATE TABLE IF NOT EXISTS route_requests (
+  id           uuid PRIMARY KEY DEFAULT gen_random_uuid(),
+  origin_id    uuid NOT NULL REFERENCES locations (id) ON DELETE CASCADE,
+  stops        jsonb NOT NULL DEFAULT '[]'::jsonb,
+  request_time timestamptz NOT NULL DEFAULT now(),
+  status       text NOT NULL DEFAULT 'pending',
+  -- runtime drift columns (written by the optimizer service)
+  engine       text,
+  vehicle_id   text,
+  driver_age   numeric(5, 2)
+);
+
+CREATE TABLE IF NOT EXISTS route_results (
+  id              uuid PRIMARY KEY DEFAULT gen_random_uuid(),
+  request_id      uuid NOT NULL REFERENCES route_requests (id) ON DELETE CASCADE,
+  optimized_order jsonb NOT NULL DEFAULT '[]'::jsonb,
+  total_distance  numeric(10, 2),
+  total_duration  numeric(10, 2),
+  legs            jsonb NOT NULL DEFAULT '[]'::jsonb,
+  created_at      timestamptz NOT NULL DEFAULT now(),
+  -- runtime drift columns (written by the optimizer service)
+  geometry        jsonb,
+  eta_minutes_ml  numeric(10, 2),
+  eta_completion_time_ml timestamptz
+);
+
+-- History reads are newest-first with an embedded-result join
+-- (serve/store.py list_history / Flaskr/routes.py:193-204).
+CREATE INDEX IF NOT EXISTS route_requests_request_time_idx
+  ON route_requests (request_time DESC);
+CREATE INDEX IF NOT EXISTS route_results_request_id_idx
+  ON route_results (request_id);
+
+-- Seed: the 21 canonical Metro Manila sites (data/locations.py;
+-- reference seeder LocationsTableSeeder.php:13-35). Deterministic
+-- uuid5 ids, identical to the in-memory store's.
+INSERT INTO locations (id, name, latitude, longitude) VALUES
+  ('ca61450b-e966-53ad-a248-367ae6b6a430', 'Main Warehouse - Mandaluyong', 14.5836, 121.0409),
+  ('98f8b35f-63d6-5f8c-8faf-cdcaa03d18b3', 'SM Mall of Asia', 14.5352, 120.9822),
+  ('4bd234d0-934d-5e29-9d0a-b639fdf94f5e', 'Greenbelt Mall', 14.5516, 121.0233),
+  ('da1a989e-2f47-5c62-9273-c3adbcb4147d', 'SM Megamall', 14.5833, 121.0567),
+  ('bdf0e64f-914e-543f-ba90-cb8feca6f470', 'Market! Market!', 14.5536, 121.0546),
+  ('eb1549f3-21af-5711-a176-43dbf7e091b8', 'Robinsons Galleria', 14.5896, 121.0614),
+  ('447d44d9-14e5-5b16-aa1a-5be7b23eb7c0', 'SM North EDSA', 14.6556, 121.0313),
+  ('51a183b9-cd02-579b-84b9-9aea0dbd61a7', 'Trinoma Mall', 14.6537, 121.0321),
+  ('71aa6c0f-6bd6-54c1-bc7e-cecd7ebecb30', 'Gateway Mall', 14.6206, 121.0526),
+  ('5c0bc6cc-f0e0-5ec3-a03f-58f0279659d1', 'SM City Manila', 14.5881, 120.9814),
+  ('1e876957-1d88-5ee4-a13b-3f82897e9956', 'Lucky Chinatown Mall', 14.6054, 120.9734),
+  ('fe52bfe2-09b7-5ba5-905c-011bf09089d2', 'SM Aura Premier', 14.5456, 121.0559),
+  ('d3b9f0ff-6289-5770-a2d7-da4c1b9e1b36', 'Robinsons Place Manila', 14.5730, 120.9820),
+  ('b54ce262-67d3-5478-825f-106d2dfeaf22', 'Ayala Malls Vertis North', 14.6543, 121.0327),
+  ('7ca09632-5256-53a0-9be3-0d80a94b2bd9', 'Fisher Mall', 14.6300, 121.0045),
+  ('4caf382e-4fbe-5060-ad12-9adaf234123d', 'SM City Sta. Mesa', 14.6031, 121.0275),
+  ('224afa90-b58b-52e8-8911-f19072ee18d7', 'Alabang Town Center', 14.4269, 121.0314),
+  ('340ee4d8-ab3b-57fc-be9e-10273639f11d', 'Festival Mall Alabang', 14.4143, 121.0438),
+  ('5d2aab15-000e-5ab2-b8b2-54db4afdbc3b', 'Eastwood Mall', 14.6101, 121.0791),
+  ('b0b7c7e5-8a49-588d-969a-dc88d96c576b', 'Robinsons Magnolia', 14.6162, 121.0336),
+  ('36a4c35c-94d9-59b2-8f7b-508ef6d13009', 'Venice Grand Canal Mall', 14.5404, 121.0530)
+ON CONFLICT (id) DO NOTHING;
+
+COMMIT;

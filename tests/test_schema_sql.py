@@ -1,0 +1,97 @@
+"""schema.sql stays executable and in sync with the code (VERDICT r1 #7).
+
+No Postgres exists in this environment, so validation is structural:
+the DDL must parse into the exact table/column/constraint surface the
+stores read and write (serve/store.py), including the drift columns the
+reference's Flask service writes outside its own migrations, and the
+seed block must match data/locations.py row for row.
+"""
+
+import os
+import re
+
+import pytest
+
+SCHEMA = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "schema.sql")
+
+
+@pytest.fixture(scope="module")
+def sql():
+    with open(SCHEMA) as f:
+        return f.read()
+
+
+def _table_body(sql, name):
+    m = re.search(
+        rf"CREATE TABLE IF NOT EXISTS {name} \((.*?)\n\);", sql, re.S)
+    assert m, f"table {name} missing"
+    return m.group(1)
+
+
+def _columns(body):
+    cols = {}
+    for line in body.splitlines():
+        line = line.strip().rstrip(",")
+        if not line or line.startswith("--"):
+            continue
+        parts = line.split()
+        cols[parts[0]] = " ".join(parts[1:])
+    return cols
+
+
+def test_locations_table(sql):
+    cols = _columns(_table_body(sql, "locations"))
+    assert cols["id"].startswith("uuid PRIMARY KEY")
+    for c in ("name", "latitude", "longitude", "created_at"):
+        assert c in cols
+    assert "numeric(9, 6)" in cols["latitude"]
+
+
+def test_route_requests_matches_store_writes(sql):
+    body = _table_body(sql, "route_requests")
+    cols = _columns(body)
+    # every key the serving layer persists has a column
+    # (serve/app.py _persist → store.insert_request)
+    for key in ("origin_id", "stops", "status", "engine", "vehicle_id",
+                "driver_age", "request_time"):
+        assert key in cols, f"route_requests.{key} missing"
+    assert "REFERENCES locations (id) ON DELETE CASCADE" in cols["origin_id"]
+    assert cols["stops"].startswith("jsonb")
+    assert "'pending'" in cols["status"]
+
+
+def test_route_results_matches_store_writes(sql):
+    cols = _columns(_table_body(sql, "route_results"))
+    for key in ("request_id", "optimized_order", "total_distance",
+                "total_duration", "legs", "geometry", "eta_minutes_ml",
+                "eta_completion_time_ml", "created_at"):
+        assert key in cols, f"route_results.{key} missing"
+    # the FK cascade is what makes DELETE /api/history/<id> one call
+    assert ("REFERENCES route_requests (id) ON DELETE CASCADE"
+            in cols["request_id"])
+
+
+def test_seed_rows_match_locations_module(sql):
+    from routest_tpu.data.locations import SEED_LOCATIONS, location_id
+
+    rows = re.findall(
+        r"\('([0-9a-f-]{36})', '((?:[^']|'')+)', ([0-9.]+), ([0-9.]+)\)", sql)
+    assert len(rows) == len(SEED_LOCATIONS) == 21
+    by_name = {name.replace("''", "'"): (rid, float(lat), float(lon))
+               for rid, name, lat, lon in rows}
+    for name, lat, lon in SEED_LOCATIONS:
+        rid, slat, slon = by_name[name]
+        assert rid == location_id(name)
+        assert abs(slat - lat) < 5e-5 and abs(slon - lon) < 5e-5
+
+
+def test_statements_are_balanced(sql):
+    # cheap structural parse: begin/commit bracket, parens balance, and
+    # every statement terminates
+    assert sql.count("(") == sql.count(")")
+    assert re.search(r"^BEGIN;$", sql, re.M)
+    assert re.search(r"^COMMIT;$", sql, re.M)
+    assert sql.count("CREATE TABLE IF NOT EXISTS") == 3
+    assert sql.count("CREATE INDEX IF NOT EXISTS") == 2
+    assert "ON CONFLICT (id) DO NOTHING" in sql

@@ -1,0 +1,166 @@
+"""XGBoost JSON importer (models/gbdt.py): exact semantic parity.
+
+xgboost itself is not installed here, so the oracle is an independent
+pure-Python walker implementing XGBoost's documented prediction
+semantics (strict ``x < split_condition`` goes left, NaN follows
+``default_left``, prediction = base_score + Σ leaf values). The model
+file is generated in xgboost's JSON schema, including threshold-equality
+rows — the edge where a sloppy ``<=`` import would diverge.
+"""
+
+import gzip
+import json
+import random
+
+import numpy as np
+import pytest
+
+from routest_tpu.models.gbdt import from_xgboost_json, load_xgboost_eta
+
+N_FEATURES = 12
+
+
+def _random_tree(rng: random.Random, max_depth: int):
+    """Random binary tree in xgboost JSON array form."""
+    lc, rc, cond, split, default = [], [], [], [], []
+
+    def grow(depth):
+        nid = len(lc)
+        lc.append(-1); rc.append(-1)
+        cond.append(0.0); split.append(0); default.append(0)
+        if depth >= max_depth or rng.random() < 0.3:
+            cond[nid] = rng.uniform(-4, 4)  # leaf value
+            return nid
+        split[nid] = rng.randrange(N_FEATURES)
+        # thresholds on a coarse grid so exact x == thr collisions occur
+        cond[nid] = float(np.float32(rng.choice([0.0, 0.25, 0.5, 1.0, 2.0, 30.0])))
+        default[nid] = rng.randrange(2)
+        left = grow(depth + 1)
+        right = grow(depth + 1)
+        lc[nid], rc[nid] = left, right
+        return nid
+
+    grow(0)
+    return {
+        "left_children": lc, "right_children": rc,
+        "split_conditions": cond, "split_indices": split,
+        "default_left": default,
+    }
+
+
+def _model_json(n_trees=5, seed=0, base_score=1.5, objective="reg:squarederror"):
+    rng = random.Random(seed)
+    return {
+        "learner": {
+            "objective": {"name": objective},
+            "learner_model_param": {"base_score": str(base_score)},
+            "gradient_booster": {
+                "model": {"trees": [_random_tree(rng, 5)
+                                    for _ in range(n_trees)]}
+            },
+        }
+    }
+
+
+def _oracle_predict(model_json, x: np.ndarray) -> np.ndarray:
+    """Independent implementation of xgboost prediction semantics."""
+    learner = model_json["learner"]
+    base = float(learner["learner_model_param"]["base_score"])
+    out = np.full(len(x), base, np.float64)
+    for tree in learner["gradient_booster"]["model"]["trees"]:
+        for i, row in enumerate(x):
+            nid = 0
+            while tree["left_children"][nid] != -1:
+                xv = np.float32(row[tree["split_indices"][nid]])
+                thr = np.float32(tree["split_conditions"][nid])
+                if np.isnan(xv):
+                    go_left = bool(tree["default_left"][nid])
+                else:
+                    go_left = bool(xv < thr)  # xgboost: STRICT less-than
+                nid = (tree["left_children"][nid] if go_left
+                       else tree["right_children"][nid])
+            out[i] += tree["split_conditions"][nid]
+    return out
+
+
+def _batch(seed=0, n=256):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 2, (n, N_FEATURES)).astype(np.float32)
+    # force exact threshold collisions (the < vs <= edge) and NaNs
+    x[::5, rng.integers(0, N_FEATURES, len(x[::5]))] = \
+        rng.choice([0.0, 0.25, 0.5, 1.0, 2.0, 30.0], len(x[::5]))
+    x[::7, 3] = np.nan
+    return x
+
+
+def test_parity_with_oracle(tmp_path):
+    mj = _model_json(n_trees=8, seed=1)
+    path = str(tmp_path / "xgb.json")
+    with open(path, "w") as f:
+        json.dump(mj, f)
+    gbdt, params = from_xgboost_json(path)
+    x = _batch(seed=2)
+    got = np.asarray(gbdt.apply(params, x))
+    want = _oracle_predict(mj, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_parity_gzipped(tmp_path):
+    mj = _model_json(n_trees=3, seed=4)
+    path = str(tmp_path / "xgb.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump(mj, f)
+    gbdt, params = from_xgboost_json(path)
+    x = _batch(seed=5, n=64)
+    np.testing.assert_allclose(np.asarray(gbdt.apply(params, x)),
+                               _oracle_predict(mj, x), rtol=1e-5, atol=1e-5)
+
+
+def test_rejects_non_regression_and_garbage(tmp_path):
+    clf = str(tmp_path / "clf.json")
+    with open(clf, "w") as f:
+        json.dump(_model_json(objective="binary:logistic"), f)
+    with pytest.raises(ValueError, match="reg:"):
+        from_xgboost_json(clf)
+
+    garbage = str(tmp_path / "g.json")
+    with open(garbage, "w") as f:
+        json.dump({"not": "a model"}, f)
+    with pytest.raises(ValueError, match="not an XGBoost JSON model"):
+        from_xgboost_json(garbage)
+
+
+def test_serves_via_eta_model_path(tmp_path):
+    """The reference contract end to end: point ETA_MODEL_PATH at an
+    XGBoost-format model and /api/predict_eta serves it
+    (``Flaskr/ml.py:6-21`` + ``routes.py:365-383``)."""
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config, ServeConfig
+    from routest_tpu.serve.app import create_app
+    from routest_tpu.serve.ml_service import EtaService
+
+    mj = _model_json(n_trees=6, seed=7, base_score=20.0)
+    path = str(tmp_path / "xgb_eta_model.json")
+    with open(path, "w") as f:
+        json.dump(mj, f)
+
+    eta = EtaService(ServeConfig(), model_path=path)
+    assert eta.available, eta.load_error
+    client = Client(create_app(Config(), eta_service=eta))
+    r = client.post("/api/predict_eta", json={
+        "summary": {"distance": 12_000}, "weather": "Sunny",
+        "traffic": "High", "pickup_time": "2026-07-29T08:00:00",
+        "driver_age": 35})
+    assert r.status_code == 200, r.get_data(as_text=True)
+    body = r.get_json()
+    assert np.isfinite(body["eta_minutes_ml"])
+    assert body["eta_completion_time_ml"].startswith("2026-07-29")
+
+    # parity through the whole serving stack (encode → batcher → gbdt)
+    from routest_tpu.data.features import encode_requests
+
+    rows = encode_requests(weather=["Sunny"], traffic=["High"], weekday=[2],
+                           hour=[8], distance_km=[12.0], driver_age=[35.0])
+    want = _oracle_predict(mj, np.asarray(rows, np.float32))
+    np.testing.assert_allclose(body["eta_minutes_ml"], want[0], rtol=1e-4)
