@@ -40,6 +40,15 @@ class GBDT:
     n_trees: int
     max_nodes: int
     max_depth: int
+    # Comparison mode, uniform per ensemble: sklearn splits route
+    # ``x <= thr`` left (strict=False); XGBoost splits route ``x < thr``
+    # left (strict=True). Static on the dataclass — it's compile-time
+    # constant, so jit emits exactly one comparison. Evaluated
+    # as-declared, NOT via threshold perturbation: nextafter(0.0, -inf)
+    # is subnormal and XLA backends flush subnormals to zero, which
+    # silently turned every ``x < 0.0`` split into ``x <= 0.0`` and sent
+    # one-hot features down the wrong branch.
+    strict: bool = False
 
     def apply(self, params: Params, x: jax.Array) -> jax.Array:
         """(B, F) float32 features → (B,) predictions."""
@@ -59,10 +68,10 @@ class GBDT:
             thr = threshold[t_idx, cur]
             xv = jnp.take_along_axis(x, f.reshape(x.shape[0], -1), axis=1)
             xv = xv.reshape(cur.shape)
-            # sklearn routes missing (NaN) values per-node via
-            # missing_go_to_left; plain `NaN <= thr` would always go right.
-            go_left = jnp.where(jnp.isnan(xv), missing_left[t_idx, cur],
-                                xv <= thr)
+            cmp = (xv < thr) if self.strict else (xv <= thr)
+            # sklearn/xgboost route missing (NaN) values per-node via
+            # missing_go_to_left; plain compares would always go right.
+            go_left = jnp.where(jnp.isnan(xv), missing_left[t_idx, cur], cmp)
             nxt = jnp.where(go_left, left[t_idx, cur], right[t_idx, cur])
             return nxt  # leaves self-loop (left == right == own index)
 
@@ -108,7 +117,9 @@ def from_sklearn(model) -> Tuple[GBDT, Params]:
         "baseline": jnp.asarray(float(np.ravel(model._baseline_prediction)[0]),
                                 jnp.float32),
     }
-    return GBDT(n_trees=n_trees, max_nodes=max_nodes, max_depth=max_depth), params
+    # sklearn HistGradientBoosting routes x <= threshold left
+    return GBDT(n_trees=n_trees, max_nodes=max_nodes, max_depth=max_depth,
+                strict=False), params
 
 
 # ── XGBoost importer ──────────────────────────────────────────────────────
@@ -123,10 +134,13 @@ def from_sklearn(model) -> Tuple[GBDT, Params]:
 # trees can serve at TPU batch throughput.
 #
 # Semantics preserved exactly:
-# - xgboost routes ``x < split_condition`` LEFT (strict); GBDT.apply
-#   tests ``x <= thr``. Thresholds are converted with float32
-#   ``nextafter(thr, -inf)``: for every float32 x, ``x < thr`` ⟺
-#   ``x <= pred(thr)`` — bit-exact, not approximate.
+# - xgboost routes ``x < split_condition`` LEFT (strict). The ensemble
+#   is marked ``strict=True`` and ``GBDT.apply`` evaluates ``x < thr``
+#   as-declared. (A previous revision rewrote thresholds with
+#   ``nextafter(thr, -inf)`` to reuse the ``<=`` path; that is wrong on
+#   XLA backends, which flush subnormals to zero — ``nextafter(0.0,
+#   -inf)`` is subnormal, so every 0.0 threshold silently became
+#   ``x <= 0.0`` and one-hot features took the wrong branch.)
 # - missing values (NaN) follow ``default_left`` per node.
 # - leaf values live in ``split_conditions`` at leaf nodes in the JSON
 #   schema; prediction = base_score + Σ leaf values (identity link, so
@@ -172,10 +186,7 @@ def from_xgboost_json(path: str) -> Tuple[GBDT, Params]:
         is_leaf = lc == -1
         idx = np.arange(n, dtype=np.int32)
         feature[t, :n] = np.where(is_leaf, 0, split_idx)
-        # strict-less-than → less-or-equal via float32 predecessor
-        threshold[t, :n] = np.where(
-            is_leaf, np.inf,
-            np.nextafter(cond, np.float32(-np.inf), dtype=np.float32))
+        threshold[t, :n] = np.where(is_leaf, np.inf, cond)
         left[t, :n] = np.where(is_leaf, idx, lc)
         right[t, :n] = np.where(is_leaf, idx, rc)
         value[t, :n] = np.where(is_leaf, cond, 0.0)  # leaf value slot
@@ -191,8 +202,9 @@ def from_xgboost_json(path: str) -> Tuple[GBDT, Params]:
         "missing_left": jnp.asarray(missing_left),
         "baseline": jnp.asarray(base_score, jnp.float32),
     }
+    # xgboost splits: x < thr goes left
     return GBDT(n_trees=n_trees, max_nodes=max_nodes,
-                max_depth=max_depth), params
+                max_depth=max_depth, strict=True), params
 
 
 def _tree_depth(lc: np.ndarray, rc: np.ndarray) -> int:

@@ -142,6 +142,94 @@ def create_app(config: Optional[Config] = None,
             return {"error": "model unavailable"}, 503
         return {"eta_minutes_ml": eta_min, "eta_completion_time_ml": eta_iso}, 200
 
+    @app.route("/api/predict_eta_batch", methods=("POST",))
+    def predict_eta_batch(request):
+        """Batched ETA scoring — the serving-side 10k preds/sec path.
+
+        Additive to the reference ABI (its ``/predict_eta`` is one row
+        per request, ``Flaskr/routes.py:365-383``). Accepts either form:
+
+        - columnar (fast path): ``{"distance_m": [..N..], "weather":
+          [..]|str, "traffic": [..]|str, "driver_age": [..]|num,
+          "pickup_time": [..]|iso}`` — scalars broadcast to N;
+        - row-shaped: ``{"items": [{summary:{distance}, weather, traffic,
+          pickup_time, driver_age}, ...]}`` (each item = the single-row
+          request body).
+
+        Response: ``{"count": N, "eta_minutes_ml": [..],
+        "eta_completion_time_ml": [..]}`` / 503 when no model serves.
+        """
+        body = get_json(request) or {}
+        try:
+            if "items" in body:
+                items = body["items"]
+                if not isinstance(items, list) or not items:
+                    return {"error": "items must be a non-empty list"}, 400
+                distance = [float(((it.get("summary") or {}).get("distance"))
+                                  or it.get("distance_m") or 0)
+                            for it in items]
+                # `or` (not .get default) so explicit nulls coerce to the
+                # defaults exactly like the columnar form / single endpoint
+                weather = [it.get("weather") or "Sunny" for it in items]
+                traffic = [it.get("traffic") or "Low" for it in items]
+                age = [float(it.get("driver_age", 30) or 30) for it in items]
+                pickup = [it.get("pickup_time") for it in items]
+            else:
+                distance = body.get("distance_m")
+                if not isinstance(distance, list) or not distance:
+                    return {"error": "distance_m must be a non-empty list "
+                                     "(or send items=[...])"}, 400
+                distance = [float(d or 0) for d in distance]
+                n = len(distance)
+
+                def col(name, default):
+                    v = body.get(name, default)
+                    if isinstance(v, list):
+                        if len(v) != n:
+                            raise ValueError(
+                                f"{name} has {len(v)} entries, expected {n}")
+                        return v
+                    return [v] * n  # scalar broadcasts
+
+                weather = [w or "Sunny" for w in col("weather", "Sunny")]
+                traffic = [t or "Low" for t in col("traffic", "Low")]
+                age = [float(a or 30) for a in col("driver_age", 30.0)]
+                pickup = col("pickup_time", None)
+            # Bad entry TYPES are client errors: catch them here as 400,
+            # not downstream as a 503 that reads like a model outage.
+            for name, vals in (("weather", weather), ("traffic", traffic)):
+                for v in vals:
+                    if not isinstance(v, str):
+                        raise ValueError(f"{name} entries must be strings")
+            for p in pickup:
+                if p is not None and not isinstance(p, str):
+                    raise ValueError("pickup_time entries must be ISO strings")
+        except (TypeError, ValueError, AttributeError) as e:
+            # AttributeError: non-dict items / summary ("items": ["foo"])
+            return {"error": f"malformed batch: {e}"}, 400
+        if len(distance) > 131_072:
+            return {"error": "batch too large (max 131072 rows)"}, 400
+        try:
+            minutes, iso = state.eta.predict_eta_batch(
+                weather=weather, traffic=traffic, distance_m=distance,
+                pickup_time=pickup, driver_age=age)
+        except Exception as e:
+            _log.error("predict_batch_failed", error=str(e))
+            minutes = None
+        if minutes is None:
+            return {"error": "model unavailable"}, 503
+        import math
+
+        # Non-finite rows serialize as null in BOTH columns (NaN is
+        # invalid JSON; its timestamp is NaT) — the batch-shaped analog
+        # of the single-row (None, None) contract.
+        finite = [math.isfinite(m) for m in minutes]
+        return {"count": len(distance),
+                "eta_minutes_ml": [round(float(m), 4) if ok else None
+                                   for m, ok in zip(minutes, finite)],
+                "eta_completion_time_ml": [str(s) if ok else None
+                                           for s, ok in zip(iso, finite)]}, 200
+
     # ── live tracking ──────────────────────────────────────────────────
 
     @app.route("/api/confirm_route", methods=("POST",))

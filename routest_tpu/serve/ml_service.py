@@ -31,12 +31,13 @@ from routest_tpu.train.checkpoint import default_model_path, load_model
 
 
 class _Pending:
-    __slots__ = ("rows", "event", "result")
+    __slots__ = ("rows", "event", "result", "error")
 
     def __init__(self, rows: np.ndarray) -> None:
         self.rows = rows
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
 
 
 class DynamicBatcher:
@@ -79,8 +80,14 @@ class DynamicBatcher:
             self._queued_rows += len(rows)
             should_flush = (self._queued_rows >= self._max_batch
                             and not self._flushing)
+        # A flush exception here may belong to OTHER requests' rows (the
+        # capped drain can exclude ours); our own failure arrives via
+        # pending.error below, so never re-raise from the shared flush.
         if should_flush:
-            self._flush()
+            try:
+                self._flush()
+            except Exception:
+                pass
         deadline = time.monotonic() + self._max_wait
         while True:
             # Oldest-waiter timeout: whoever wakes first drains the queue.
@@ -89,38 +96,60 @@ class DynamicBatcher:
             remaining = deadline - time.monotonic()
             if pending.event.wait(timeout=max(remaining, 0.001)):
                 break
-            self._flush()
+            try:
+                self._flush()
+            except Exception:
+                pass
+        if pending.error is not None:
+            # A dead device must surface as an error on EVERY waiter, not
+            # only the thread that happened to run the flush — silent NaN
+            # fills would 200 with all-null columns while the TPU is down.
+            raise pending.error
         assert pending.result is not None
         return pending.result
 
     def _flush(self) -> None:
-        with self._lock:
-            if self._flushing or not self._queue:
-                return
-            self._flushing = True
-            batch = self._queue
-            self._queue = []
-            self._queued_rows = 0
-        try:
-            rows = np.concatenate([p.rows for p in batch], axis=0)
-            n = len(rows)
-            preds = np.asarray(self._score(pad_rows(rows, self._bucket(n))))[:n]
-            self.stats["flushes"] += 1
-            self.stats["rows"] += n
-            self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], n)
-            offset = 0
-            for p in batch:
-                p.result = preds[offset: offset + len(p.rows)]
-                offset += len(p.rows)
-                p.event.set()
-        except Exception:
-            for p in batch:
-                p.result = np.full((len(p.rows),), np.nan, np.float32)
-                p.event.set()
-            raise
-        finally:
+        while True:
             with self._lock:
-                self._flushing = False
+                if self._flushing or not self._queue:
+                    return
+                self._flushing = True
+                # Drain at most max_batch rows (whole requests): with
+                # submissions pre-chunked to the largest bucket, every
+                # flush shape stays bucketed — unbounded drains compiled
+                # a fresh XLA executable per novel concatenated size.
+                taken = cnt = 0
+                for p in self._queue:
+                    if cnt and taken + len(p.rows) > self._max_batch:
+                        break
+                    taken += len(p.rows)
+                    cnt += 1
+                batch = self._queue[:cnt]      # O(k) slice, not O(n) pops
+                del self._queue[:cnt]
+                self._queued_rows -= taken
+            try:
+                rows = np.concatenate([p.rows for p in batch], axis=0)
+                n = len(rows)
+                preds = np.asarray(self._score(pad_rows(rows, self._bucket(n))))[:n]
+                self.stats["flushes"] += 1
+                self.stats["rows"] += n
+                self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], n)
+                offset = 0
+                for p in batch:
+                    p.result = preds[offset: offset + len(p.rows)]
+                    offset += len(p.rows)
+                    p.event.set()
+            except Exception as e:
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+                raise
+            finally:
+                with self._lock:
+                    self._flushing = False
+                    more = self._queued_rows >= self._max_batch
+            if not more:
+                return
 
 
 class EtaService:
@@ -292,7 +321,16 @@ class EtaService:
     def predict_batch(self, rows: np.ndarray) -> Optional[np.ndarray]:
         if not self.available or self._batcher is None:
             return None
-        return self._batcher.submit(np.asarray(rows, np.float32))
+        rows = np.asarray(rows, np.float32)
+        # Chunk oversize batches to the largest compile bucket: arbitrary
+        # row counts would each compile a fresh executable (a client
+        # sweeping sizes = recompile storm + unbounded jit cache).
+        cap = self._batcher._buckets[-1]
+        if len(rows) <= cap:
+            return self._batcher.submit(rows)
+        return np.concatenate([
+            self._batcher.submit(rows[i: i + cap])
+            for i in range(0, len(rows), cap)])
 
     def predict_eta_minutes(
         self, *, weather: str, traffic: str, distance_m: float,
@@ -327,6 +365,61 @@ class EtaService:
         eta_minutes = float(preds[0])
         eta_ts = (pickup_dt + dt.timedelta(minutes=eta_minutes)).isoformat()
         return eta_minutes, eta_ts
+
+    def predict_eta_batch(
+        self, *, weather: Sequence[str], traffic: Sequence[str],
+        distance_m: Sequence[float], pickup_time,
+        driver_age: Sequence[float],
+    ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """Batched scoring: N OD pairs → (minutes (N,), completion ISO (N,)).
+
+        The serving-side half of the 10k preds/sec north star
+        (BASELINE.json): the reference scores one row per HTTP request
+        (``Flaskr/routes.py:365-383``); here one request carries a whole
+        OD batch straight into the device batcher. ``pickup_time`` may be
+        a single ISO string (shared by the batch) or a sequence of N.
+        Returns (None, None) when no model is serving.
+        """
+        if not self.available:
+            return None, None
+        n = len(distance_m)
+        if isinstance(pickup_time, (str, dt.datetime)) or pickup_time is None:
+            pickup_time = [pickup_time] * n
+
+        def parse(p):
+            if isinstance(p, str):
+                try:
+                    p = dt.datetime.fromisoformat(p)
+                except ValueError:
+                    p = None
+            if not isinstance(p, dt.datetime):
+                p = dt.datetime.now()
+            if p.tzinfo is not None:
+                # Keep offset-local WALL time (drop tzinfo for datetime64):
+                # the single-row path encodes hour/weekday from the wall
+                # clock as sent, and the two endpoints must featurize the
+                # identical row identically.
+                p = p.replace(tzinfo=None)
+            return p
+
+        pickups = [parse(p) for p in pickup_time]
+        rows = encode_requests(
+            weather=list(weather), traffic=list(traffic),
+            weekday=[p.weekday() for p in pickups],
+            hour=[p.hour for p in pickups],
+            distance_km=[float(d or 0) / 1000.0 for d in distance_m],
+            driver_age=[float(a or 30.0) for a in driver_age],
+        )
+        preds = self.predict_batch(rows)
+        if preds is None:
+            return None, None
+        minutes = np.asarray(preds, np.float64)
+        # Vectorized completion stamps: datetime64 arithmetic beats a
+        # per-row datetime+timedelta loop ~50x at batch sizes that matter.
+        base = np.asarray([np.datetime64(p, "ms") for p in pickups])
+        completion = base + (minutes * 60_000.0).astype("timedelta64[ms]")
+        iso = np.datetime_as_string(completion, unit="s")
+        return minutes, iso
 
     @property
     def stats(self) -> dict:

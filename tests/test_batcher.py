@@ -79,6 +79,58 @@ def test_failed_score_propagates_and_unblocks():
     assert len(b2.submit(np.ones((1, 12), np.float32))) == 1
 
 
+def test_failed_score_raises_on_every_waiter():
+    """A flush failure must error on ALL coalesced requests, not only the
+    thread that ran the flush — the rest used to get silent NaN fills."""
+    def bad_score(x):
+        raise RuntimeError("device fell over")
+
+    b = DynamicBatcher(bad_score, buckets=(64,), max_batch=64,
+                       max_wait_ms=50.0)
+    n = 4
+    outcomes = [None] * n
+    barrier = threading.Barrier(n)
+
+    def worker(i):
+        barrier.wait()
+        try:
+            b.submit(np.ones((2, 12), np.float32))
+            outcomes[i] = "ok"
+        except RuntimeError:
+            outcomes[i] = "raised"
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert outcomes == ["raised"] * n
+
+
+def test_flush_shapes_stay_bucketed_under_max_batch_drain():
+    """The flusher drains at most max_batch rows per device call, so a
+    deep queue never concatenates into an unbucketed (recompiling) shape."""
+    calls = []
+    b = DynamicBatcher(_echo_score(calls), buckets=(4, 8), max_batch=8,
+                       max_wait_ms=200.0)
+    n_threads = 12  # 24 rows queued against max_batch=8
+    results = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i):
+        barrier.wait()
+        results[i] = b.submit(np.full((2, 12), float(i), np.float32))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for i in range(n_threads):
+        np.testing.assert_allclose(results[i], np.full(2, i * 12.0))
+    assert all(shape[0] in (4, 8) for shape in calls), calls
+
+
 def test_alignment_rounds_buckets_to_shard_multiples():
     """With a 6-way data mesh, every padded batch must divide by 6."""
     calls = []

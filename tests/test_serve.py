@@ -94,6 +94,88 @@ def test_predict_eta(client):
     assert body["eta_completion_time_ml"].startswith("2026-07-29T")
 
 
+def test_predict_eta_batch_columnar(client):
+    n = 257  # deliberately not a bucket size: exercises pad + slice-back
+    r = client.post("/api/predict_eta_batch", json={
+        "distance_m": [1000.0 * (i + 1) for i in range(n)],
+        "weather": "Stormy",            # scalar broadcast
+        "traffic": ["Jam"] * n,          # full column
+        "driver_age": 40,
+        "pickup_time": "2026-07-29T18:00:00",
+    })
+    assert r.status_code == 200
+    body = r.get_json()
+    assert body["count"] == n
+    assert len(body["eta_minutes_ml"]) == n
+    assert len(body["eta_completion_time_ml"]) == n
+    import datetime as dt
+    # every stamp parses as ISO (untrained-model ETAs may cross midnight)
+    for t in body["eta_completion_time_ml"]:
+        dt.datetime.fromisoformat(t)
+
+    # row 0 must match the single-row endpoint bit-for-bit (same encoder,
+    # same model, same pickup)
+    single = client.post("/api/predict_eta", json={
+        "summary": {"distance": 1000.0}, "driver_age": 40,
+        "weather": "Stormy", "traffic": "Jam",
+        "pickup_time": "2026-07-29T18:00:00"}).get_json()
+    assert abs(body["eta_minutes_ml"][0] - single["eta_minutes_ml"]) < 1e-3
+
+
+def test_predict_eta_batch_items_form(client):
+    r = client.post("/api/predict_eta_batch", json={"items": [
+        {"summary": {"distance": 5000}, "weather": "Sunny", "traffic": "Low",
+         "pickup_time": "2026-07-29T08:00:00", "driver_age": 25},
+        {"summary": {"distance": 15000}, "weather": "Cloudy",
+         "traffic": "High", "pickup_time": "2026-07-29T17:30:00"},
+    ]})
+    assert r.status_code == 200
+    body = r.get_json()
+    assert body["count"] == 2
+    assert body["eta_completion_time_ml"][1].startswith("2026-07-29T")
+
+
+def test_predict_eta_batch_rejects_malformed(client):
+    # mismatched column lengths
+    r = client.post("/api/predict_eta_batch", json={
+        "distance_m": [1.0, 2.0], "traffic": ["Low"]})
+    assert r.status_code == 400
+    # empty / missing distance
+    assert client.post("/api/predict_eta_batch", json={}).status_code == 400
+    assert client.post("/api/predict_eta_batch",
+                       json={"items": []}).status_code == 400
+    # non-dict items must 400, not 500 (AttributeError path)
+    assert client.post("/api/predict_eta_batch",
+                       json={"items": ["foo"]}).status_code == 400
+    assert client.post("/api/predict_eta_batch",
+                       json={"items": [{"summary": "5km"}]}).status_code == 400
+    # bad entry TYPES are 400 client errors, not 503 model outages
+    assert client.post("/api/predict_eta_batch", json={
+        "distance_m": [1.0], "weather": [{"x": 1}]}).status_code == 400
+    assert client.post("/api/predict_eta_batch", json={
+        "distance_m": [1.0], "pickup_time": [[2026]]}).status_code == 400
+
+
+def test_predict_eta_batch_nan_rows_serialize_null(client):
+    # A NaN input row must yield null in BOTH columns (NaN/NaT are not
+    # valid JSON), while finite rows in the same batch still serve.
+    r = client.post("/api/predict_eta_batch", json={
+        "distance_m": ["NaN", 5000.0], "traffic": "Low"})
+    assert r.status_code == 200
+    body = r.get_json()
+    assert body["eta_minutes_ml"][0] is None
+    assert body["eta_completion_time_ml"][0] is None
+    assert body["eta_minutes_ml"][1] is not None
+    assert body["eta_completion_time_ml"][1] is not None
+
+
+def test_predict_eta_batch_model_unavailable():
+    eta = EtaService(ServeConfig(), model_path="/nonexistent/model.msgpack")
+    c = Client(create_app(Config(), eta_service=eta))
+    r = c.post("/api/predict_eta_batch", json={"distance_m": [1000.0]})
+    assert r.status_code == 503
+
+
 def test_predict_eta_model_unavailable(model_artifact):
     eta = EtaService(ServeConfig(), model_path="/nonexistent/model.msgpack")
     app = create_app(Config(), eta_service=eta)

@@ -116,6 +116,38 @@ def test_parity_gzipped(tmp_path):
                                _oracle_predict(mj, x), rtol=1e-5, atol=1e-5)
 
 
+def test_zero_threshold_strict_compare(tmp_path):
+    """Regression: thresholds of exactly 0.0 must keep STRICT semantics
+    on XLA backends. A nextafter(0.0, -inf)-based import produces a
+    subnormal threshold that XLA flushes to zero, turning ``x < 0.0``
+    into ``x <= 0.0`` — so every one-hot feature (exactly 0.0/1.0, the
+    12-feature ABI's common case) took the wrong branch."""
+    tree = {
+        # node 0: split on feature 4 at 0.0; left → leaf 1, right → leaf 2
+        "left_children": [1, -1, -1],
+        "right_children": [2, -1, -1],
+        "split_conditions": [0.0, 100.0, 200.0],
+        "split_indices": [4, 0, 0],
+        "default_left": [1, 0, 0],
+    }
+    mj = {"learner": {
+        "objective": {"name": "reg:squarederror"},
+        "learner_model_param": {"base_score": "0.0"},
+        "gradient_booster": {"model": {"trees": [tree]}},
+    }}
+    path = str(tmp_path / "zero.json")
+    with open(path, "w") as f:
+        json.dump(mj, f)
+    gbdt, params = from_xgboost_json(path)
+    x = np.zeros((3, N_FEATURES), np.float32)
+    x[0, 4] = 0.0      # 0.0 < 0.0 is False → RIGHT → 200
+    x[1, 4] = -1.0     # -1 < 0.0 → LEFT → 100
+    x[2, 4] = np.nan   # default_left → LEFT → 100
+    got = np.asarray(gbdt.apply(params, x))
+    np.testing.assert_allclose(got, [200.0, 100.0, 100.0])
+    np.testing.assert_allclose(got, _oracle_predict(mj, x))
+
+
 def test_rejects_non_regression_and_garbage(tmp_path):
     clf = str(tmp_path / "clf.json")
     with open(clf, "w") as f:
