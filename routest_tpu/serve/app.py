@@ -165,6 +165,8 @@ def create_app(config: Optional[Config] = None,
                 items = body["items"]
                 if not isinstance(items, list) or not items:
                     return {"error": "items must be a non-empty list"}, 400
+                if len(items) > 131_072:  # O(1), BEFORE any per-row work
+                    return {"error": "batch too large (max 131072 rows)"}, 400
                 distance = [float(((it.get("summary") or {}).get("distance"))
                                   or it.get("distance_m") or 0)
                             for it in items]
@@ -179,6 +181,8 @@ def create_app(config: Optional[Config] = None,
                 if not isinstance(distance, list) or not distance:
                     return {"error": "distance_m must be a non-empty list "
                                      "(or send items=[...])"}, 400
+                if len(distance) > 131_072:  # O(1), BEFORE per-row work
+                    return {"error": "batch too large (max 131072 rows)"}, 400
                 distance = [float(d or 0) for d in distance]
                 n = len(distance)
 
@@ -207,8 +211,6 @@ def create_app(config: Optional[Config] = None,
         except (TypeError, ValueError, AttributeError) as e:
             # AttributeError: non-dict items / summary ("items": ["foo"])
             return {"error": f"malformed batch: {e}"}, 400
-        if len(distance) > 131_072:
-            return {"error": "batch too large (max 131072 rows)"}, 400
         try:
             minutes, iso = state.eta.predict_eta_batch(
                 weather=weather, traffic=traffic, distance_m=distance,

@@ -59,6 +59,10 @@ class DynamicBatcher:
             {((b + self._align - 1) // self._align) * self._align for b in buckets}
         )
         self._max_batch = max_batch
+        # Drain cap: flush shapes must stay bucketed even when an
+        # operator sets max_batch above the largest bucket (the bucket
+        # list is fixed while RTPU_MAX_BATCH is env-configurable).
+        self._drain_cap = min(max_batch, self._buckets[-1])
         self._max_wait = max_wait_ms / 1000.0
         self._lock = threading.Lock()
         self._queue: List[_Pending] = []
@@ -120,7 +124,7 @@ class DynamicBatcher:
                 # a fresh XLA executable per novel concatenated size.
                 taken = cnt = 0
                 for p in self._queue:
-                    if cnt and taken + len(p.rows) > self._max_batch:
+                    if cnt and taken + len(p.rows) > self._drain_cap:
                         break
                     taken += len(p.rows)
                     cnt += 1
@@ -147,7 +151,7 @@ class DynamicBatcher:
             finally:
                 with self._lock:
                     self._flushing = False
-                    more = self._queued_rows >= self._max_batch
+                    more = self._queued_rows >= self._drain_cap
             if not more:
                 return
 

@@ -10,7 +10,8 @@ import pytest
 
 from routest_tpu.data import geo
 from routest_tpu.optimize.engine import optimize_route
-from routest_tpu.optimize.vrp import greedy_vrp, refine_2opt, solve_host
+from routest_tpu.optimize.vrp import (greedy_vrp, refine_2opt, solve_host,
+                                       trips_cost)
 
 
 def _random_instance(rng, n):
@@ -89,9 +90,11 @@ def test_refine_reaches_optimal_on_small_instances(rng):
     assert hits_r >= 10
 
 
-def test_refine_respects_trip_boundaries(rng):
-    # Tight capacity forces multiple trips; refinement must keep each
-    # trip's stop set (loads unchanged) and stay within max_distance.
+def test_refine_respects_capacity_across_trips(rng):
+    # Tight capacity forces multiple trips; full refinement (2-opt +
+    # cross-trip relocate) MAY move stops between trips, but every trip
+    # must stay within capacity, the stop multiset must be preserved, and
+    # total cost must never worsen.
     for k in range(10):
         n = 8
         dist = _random_instance(rng, n)
@@ -99,10 +102,12 @@ def test_refine_respects_trip_boundaries(rng):
         cap = 5.0
         sol = solve_host(dist, demands, cap, 1e12, refine=False)
         ref = solve_host(dist, demands, cap, 1e12, refine=True)
-        assert len(sol["trips"]) == len(ref["trips"])
-        for tg, tr in zip(sol["trips"], ref["trips"]):
-            assert sorted(tg) == sorted(tr)
+        assert sorted(sol["optimized_order"]) == sorted(ref["optimized_order"])
+        for tr in ref["trips"]:
             assert demands[tr].sum() <= cap
+        cost_g = trips_cost(dist, sol["trips"])
+        cost_r = trips_cost(dist, ref["trips"])
+        assert cost_r <= cost_g + 1e-2
 
 
 def test_refine_feasibility_under_max_distance(rng):
@@ -121,6 +126,73 @@ def test_refine_feasibility_under_max_distance(rng):
                 length += dist[a + 1, b + 1]
             length += dist[trip[-1] + 1, 0]
             assert length <= maxd + 1e-2
+
+
+def test_relocate_moves_stop_across_trips():
+    """Crafted line-world instance where greedy strands a far-side stop in
+    the wrong trip: stops a,b east at +10/+10.1, c,d west at -10/-10.1,
+    capacity 3. Greedy packs trip1=[a,c,b] (zig-zag, 60.2) + trip2=[d]
+    (20.2); intra-trip 2-opt alone can only reach 60.4 total; moving c
+    into d's trip (a cross-trip relocate) reaches the 40.4 optimum."""
+    x = np.asarray([0.0, 10.0, 10.1, -10.0, -10.1], np.float32)
+    dist = np.abs(x[:, None] - x[None, :])
+    demands = np.ones(4, np.float32)
+
+    base = solve_host(dist, demands, 3.0, 1e12, refine=False)
+    ref = solve_host(dist, demands, 3.0, 1e12, refine=True)
+
+    def total(sol):
+        return trips_cost(dist, sol["trips"])
+
+    assert total(base) > 80.0  # greedy zig-zags
+    assert total(ref) < 41.0   # relocate + 2-opt reach the optimum
+    # stops preserved, capacity respected
+    assert sorted(base["optimized_order"]) == sorted(ref["optimized_order"])
+    for t in ref["trips"]:
+        assert demands[t].sum() <= 3.0
+    # the east/west clusters ended up in separate trips
+    sets = [sorted(t) for t in ref["trips"]]
+    assert sorted(sets) == [[0, 1], [2, 3]]
+
+
+def test_relocate_beats_2opt_on_multitrip_instances(rng):
+    """Across random tight-capacity instances, full refinement must never
+    lose to 2-opt-only, and must strictly win somewhere."""
+    from routest_tpu.optimize.vrp import refine_relocate, tour_cost
+
+    wins = 0
+    for k in range(15):
+        n = 10
+        dist = _random_instance(rng, n)
+        demands = rng.integers(1, 4, n).astype(np.float32)
+        cap = 6.0
+        sol = greedy_vrp(jnp.asarray(dist), jnp.asarray(demands),
+                         jnp.asarray(cap, jnp.float32),
+                         jnp.asarray(1e12, jnp.float32))
+        two = refine_2opt(jnp.asarray(dist), sol.order, sol.trip_ids)
+        cost_2opt = _closed_length(dist, np.asarray(two),
+                                   np.asarray(sol.trip_ids))
+        full = solve_host(dist, demands, cap, 1e12, refine=True)
+        cost_full = trips_cost(dist, full["trips"])
+        assert cost_full <= cost_2opt + 1e-2
+        wins += cost_full < cost_2opt - 1e-3
+    assert wins >= 3, f"relocate never improved on 2-opt ({wins})"
+
+
+def test_relocate_single_and_empty():
+    from routest_tpu.optimize.vrp import refine_relocate
+
+    dist = np.asarray([[0.0, 5.0], [5.0, 0.0]], np.float32)
+    out = refine_relocate(
+        jnp.asarray(dist), jnp.asarray([1.0], jnp.float32),
+        jnp.asarray(10.0, jnp.float32), jnp.asarray(1e12, jnp.float32),
+        jnp.asarray([0], jnp.int32), jnp.asarray([0], jnp.int32))
+    assert np.asarray(out.order).tolist() == [0]
+    out = refine_relocate(
+        jnp.asarray(dist), jnp.asarray([1.0], jnp.float32),
+        jnp.asarray(10.0, jnp.float32), jnp.asarray(1e12, jnp.float32),
+        jnp.asarray([-1], jnp.int32), jnp.asarray([-1], jnp.int32))
+    assert np.asarray(out.order).tolist() == [-1]
 
 
 def test_refine_noop_cases():
