@@ -1,0 +1,146 @@
+"""Pipeline parallelism: model stages mapped onto a mesh axis.
+
+SURVEY.md §2.4 scopes pipeline parallelism out of the minimum slice but
+requires the runner API be designed "so stages *could* map to mesh axes
+later" — this module is that API, implemented rather than sketched: a
+GPipe-style fill-drain schedule as a fixed-shape ``shard_map`` program
+over a ``stage`` mesh axis. (The reference has no parallelism of any
+kind — its model is a batch-1 CPU tree walk, ``Flaskr/ml.py:51-53``.)
+
+Design:
+
+- a *stage* is any shape-preserving function ``stage_fn(stage_params, x)
+  -> x`` — the same callable runs on every device, closed over nothing;
+- per-stage parameters are STACKED along a leading axis of size
+  ``n_stages`` and sharded ``P(stage_axis)``, so device *s* holds only
+  stage *s*'s weights — the HBM-scaling point of PP;
+- microbatches stream through the pipe: tick *t* feeds microbatch *t*
+  into stage 0, every stage transforms the activation it holds, and one
+  ``ppermute`` per tick shifts activations forward. After
+  ``n_stages + n_micro - 1`` ticks every microbatch has drained through
+  the last stage;
+- the whole schedule is one ``lax.scan`` (static trip count), so it
+  jits, differentiates (XLA transposes the ``ppermute``s — gradients
+  counter-rotate backward through the pipe), and composes with the
+  ``data`` axis for DP×PP meshes.
+
+Bubble fraction is the classic (S-1)/(S+M-1); pick ``n_micro ≫ stages``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from routest_tpu.core.smap import shard_map
+
+
+def stack_stage_params(per_stage_params: list):
+    """[stage0_tree, stage1_tree, ...] → one tree with a leading stage
+    axis (leaf shapes must match across stages)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def shard_stage_params(stacked, mesh: Mesh, stage_axis: str = "stage"):
+    """device_put the stacked tree so device s holds stage s's slice."""
+    sharding = NamedSharding(mesh, P(stage_axis))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), stacked)
+
+
+def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
+    """(B, ...) → (M, B/M, ...) microbatch stack."""
+    if x.shape[0] % n_micro:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by n_micro={n_micro}")
+    return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+
+def make_pipeline_apply(stage_fn: Callable, mesh: Mesh,
+                        stage_axis: str = "stage"):
+    """jitted (stacked_params, xs) → ys.
+
+    ``xs``: (M, b, ...) microbatches (see :func:`microbatch`),
+    replicated; ``stacked_params``: leading stage axis sharded over
+    ``stage_axis`` (see :func:`shard_stage_params`). Returns (M, b, ...)
+    outputs, replicated — numerically identical to applying the stages
+    sequentially (:func:`sequential_apply`).
+    """
+    n_stages = mesh.shape[stage_axis]
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(stage_axis), P()), out_specs=P())
+    def run(stacked_local, xs):
+        # shard_map hands each device a (1, ...) slice of every leaf
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        s = jax.lax.axis_index(stage_axis)
+        m_total = xs.shape[0]
+        zero = jnp.zeros_like(xs[0])
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 ingests microbatch t (past the fill window it
+            # processes zeros that are never recorded)
+            x_in = jnp.where(t < m_total, xs[jnp.minimum(t, m_total - 1)],
+                             zero)
+            buf = jnp.where(s == 0, x_in, buf)
+            y = stage_fn(local, buf)
+            # the LAST stage emits microbatch m = t - (n_stages - 1)
+            m = t - (n_stages - 1)
+            mc = jnp.clip(m, 0, m_total - 1)
+            valid = (s == n_stages - 1) & (m >= 0) & (m < m_total)
+            outs = outs.at[mc].set(jnp.where(valid, y, outs[mc]))
+            # one hop forward per tick; stage 0's wrap-around input is
+            # overwritten by the next ingest
+            buf = jax.lax.ppermute(y, stage_axis, fwd)
+            return (buf, outs), None
+
+        ticks = jnp.arange(n_stages + m_total - 1)
+        (_, outs), _ = jax.lax.scan(tick, (zero, jnp.zeros_like(xs)), ticks)
+        # outputs live on the last stage only; psum replicates them
+        # (every other stage contributes zeros)
+        return jax.lax.psum(outs, stage_axis)
+
+    return jax.jit(run)
+
+
+def sequential_apply(stage_fn: Callable, per_stage_params: list,
+                     x: jax.Array) -> jax.Array:
+    """The single-device oracle the pipeline must match."""
+    for p in per_stage_params:
+        x = stage_fn(p, x)
+    return x
+
+
+def make_pipeline_train_step(stage_fn: Callable, optimizer, mesh: Mesh,
+                             stage_axis: str = "stage"):
+    """jitted (stacked_params, opt_state, xs, ys) → (params, opt_state,
+    loss): train THROUGH the pipeline.
+
+    The loss differentiates across every ``ppermute`` hop (XLA's
+    transpose rule counter-rotates cotangents), so each device ends up
+    with exactly its own stage's gradient slice — stage-sharded
+    optimizer state updates locally, no gradient resharding.
+    """
+    import optax
+
+    apply_fn = make_pipeline_apply(stage_fn, mesh, stage_axis)
+
+    def loss_fn(stacked, xs, ys):
+        preds = apply_fn(stacked, xs)
+        return jnp.mean((preds - ys) ** 2)
+
+    @jax.jit
+    def step(stacked, opt_state, xs, ys):
+        loss, grads = jax.value_and_grad(loss_fn)(stacked, xs, ys)
+        updates, opt_state = optimizer.update(grads, opt_state, stacked)
+        stacked = optax.apply_updates(stacked, updates)
+        return stacked, opt_state, loss
+
+    return step

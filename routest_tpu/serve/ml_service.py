@@ -189,10 +189,13 @@ class EtaService:
                         "fused_kernel_ignored",
                         reason="ROUTEST_FUSED=1 is single-device only; "
                                "mesh serving uses the sharded XLA path")
-                params = runtime.replicate(self._params)
+                score = self._maybe_tp_score(runtime)
+                if score is None:  # replicated weights, batch-sharded
+                    params = runtime.replicate(self._params)
 
-                def score(x: np.ndarray) -> np.ndarray:
-                    return apply_jit(params, runtime.shard_batch(jax.numpy.asarray(x)))
+                    def score(x: np.ndarray) -> np.ndarray:
+                        return apply_jit(
+                            params, runtime.shard_batch(jax.numpy.asarray(x)))
             else:
                 params = jax.device_put(self._params)
 
@@ -253,6 +256,38 @@ class EtaService:
         get_logger("routest_tpu.serve").info(
             "batch_buckets_warmed", buckets=list(self._batcher._buckets),
             seconds=round(time.time() - t0, 2))
+
+    def _maybe_tp_score(self, runtime: MeshRuntime):
+        """Tensor-parallel serving when the mesh has a real ``model``
+        axis (``RTPU_MESH_MODEL>1``) — weights sharded Megatron-style
+        over it, batch over ``data`` (SURVEY.md §2.4 TP row). Returns
+        None (→ replicated fallback) when the axis is 1, the artifact is
+        not an MLP (the GBDT path gathers, not matmuls), or the trunk
+        widths don't divide the axis — TP is an opt-in optimization,
+        never a dependency."""
+        from routest_tpu.models.eta_mlp import EtaMLP as _EtaMLP
+
+        tp = runtime.mesh.shape[runtime.model_axis]
+        if tp <= 1 or not isinstance(self._model, _EtaMLP):
+            return None
+        try:
+            from routest_tpu.parallel.tensor import (make_tp_apply,
+                                                     shard_tp_params)
+
+            tp_apply = make_tp_apply(self._model, runtime.mesh)
+            params = shard_tp_params(self._params, self._model, runtime.mesh)
+        except ValueError as e:
+            from routest_tpu.utils.logging import get_logger
+
+            get_logger("routest_tpu.serve").warning(
+                "tp_serving_unavailable", error=str(e))
+            return None
+
+        def score(x: np.ndarray) -> np.ndarray:
+            return tp_apply(params, runtime.shard_batch(jax.numpy.asarray(x)))
+
+        self.kernel = "xla_tp"
+        return score
 
     def _maybe_fused_score(self, fallback):
         """Opt-in swap to the fused Pallas kernel (``ops/fused_mlp.py``).

@@ -1,0 +1,116 @@
+"""Pipeline parallelism (parallel/pipeline.py): the GPipe fill-drain
+schedule over a ``stage`` mesh axis must match sequential stage
+application exactly, differentiate through the ppermute hops, and train.
+Closes the SURVEY.md §2.4 PP row (absent in the reference, which has no
+parallelism at all)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from routest_tpu.parallel.pipeline import (
+    make_pipeline_apply,
+    make_pipeline_train_step,
+    microbatch,
+    sequential_apply,
+    shard_stage_params,
+    stack_stage_params,
+)
+
+
+def _stage_fn(p, x):
+    """One shape-preserving MLP block: (b, D) → (b, D)."""
+    return jax.nn.gelu(x @ p["w"] + p["b"])
+
+
+def _make_stages(n_stages, d, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_stages)
+    return [
+        {"w": jax.random.normal(k, (d, d)) * 0.3,
+         "b": jnp.zeros((d,))}
+        for k in keys
+    ]
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("stage",))
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(4, 8), (8, 4), (2, 2), (1, 3)])
+def test_pipeline_matches_sequential(n_stages, n_micro):
+    d = 16
+    stages = _make_stages(n_stages, d)
+    mesh = _mesh(n_stages)
+    x = jax.random.normal(jax.random.PRNGKey(9), (n_micro * 4, d))
+
+    want = np.asarray(sequential_apply(_stage_fn, stages, x))
+
+    stacked = shard_stage_params(stack_stage_params(stages), mesh)
+    xs = microbatch(x, n_micro)
+    got = np.asarray(make_pipeline_apply(_stage_fn, mesh)(stacked, xs))
+    np.testing.assert_allclose(got.reshape(want.shape), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradient_parity():
+    """Gradients must counter-rotate correctly through the ppermute hops:
+    d(loss)/d(stage_k params) from the pipeline == from the sequential
+    oracle, for every stage."""
+    n_stages, n_micro, d = 4, 4, 8
+    stages = _make_stages(n_stages, d, seed=2)
+    mesh = _mesh(n_stages)
+    x = jax.random.normal(jax.random.PRNGKey(3), (n_micro * 2, d))
+    y = jax.random.normal(jax.random.PRNGKey(4), (n_micro * 2, d))
+
+    def seq_loss(stages_list):
+        return jnp.mean((sequential_apply(_stage_fn, stages_list, x) - y) ** 2)
+
+    want = jax.grad(seq_loss)(stages)
+
+    apply_fn = make_pipeline_apply(_stage_fn, mesh)
+    xs, ys = microbatch(x, n_micro), microbatch(y, n_micro)
+
+    def pipe_loss(stacked):
+        return jnp.mean((apply_fn(stacked, xs) - ys) ** 2)
+
+    stacked = shard_stage_params(stack_stage_params(stages), mesh)
+    got = jax.grad(pipe_loss)(stacked)
+
+    for s in range(n_stages):
+        np.testing.assert_allclose(
+            np.asarray(got["w"][s]), np.asarray(want[s]["w"]),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(got["b"][s]), np.asarray(want[s]["b"]),
+            rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_train_step_learns():
+    n_stages, n_micro, d = 4, 8, 8
+    stages = _make_stages(n_stages, d, seed=5)
+    mesh = _mesh(n_stages)
+    opt = optax.adam(1e-2)
+    step = make_pipeline_train_step(_stage_fn, opt, mesh)
+
+    stacked = shard_stage_params(stack_stage_params(stages), mesh)
+    opt_state = opt.init(stacked)
+    x = jax.random.normal(jax.random.PRNGKey(6), (n_micro * 4, d))
+    y = 0.5 * x  # learnable target
+    xs, ys = microbatch(x, n_micro), microbatch(y, n_micro)
+
+    losses = []
+    for _ in range(60):
+        stacked, opt_state, loss = step(stacked, opt_state, xs, ys)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], losses[::10]
+    # stage sharding preserved through updates
+    shardings = {str(stacked["w"].sharding.spec)}
+    assert shardings == {"PartitionSpec('stage',)"}, shardings
+
+
+def test_microbatch_validates():
+    with pytest.raises(ValueError, match="not divisible"):
+        microbatch(jnp.zeros((10, 4)), 3)

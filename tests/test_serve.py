@@ -176,6 +176,46 @@ def test_predict_eta_batch_model_unavailable():
     assert r.status_code == 503
 
 
+def test_tp_serving_parity(model_artifact):
+    """RTPU_MESH_MODEL>1 serves through tensor-parallel matmuls; the
+    answer must match single-device serving bit-for-bit-ish."""
+    from routest_tpu.core.config import MeshConfig
+    from routest_tpu.core.mesh import MeshRuntime
+
+    rt = MeshRuntime.create(MeshConfig(data=4, model=2))
+    tp_eta = EtaService(ServeConfig(), model_path=model_artifact, runtime=rt)
+    assert tp_eta.available, tp_eta.load_error
+    assert tp_eta.kernel == "xla_tp"
+
+    plain = EtaService(ServeConfig(), model_path=model_artifact)
+    m_tp, _ = tp_eta.predict_eta_minutes(
+        weather="Stormy", traffic="Jam", distance_m=6983.0,
+        pickup_time="2026-07-29T18:00:00", driver_age=44)
+    m_plain, _ = plain.predict_eta_minutes(
+        weather="Stormy", traffic="Jam", distance_m=6983.0,
+        pickup_time="2026-07-29T18:00:00", driver_age=44)
+    assert abs(m_tp - m_plain) < 1e-3, (m_tp, m_plain)
+
+
+def test_tp_serving_falls_back_on_indivisible_widths(tmp_path):
+    """A trunk whose widths don't divide the model axis must serve via
+    the replicated path, not fail."""
+    from routest_tpu.core.config import MeshConfig
+    from routest_tpu.core.mesh import MeshRuntime
+
+    path = str(tmp_path / "odd.msgpack")
+    model = EtaMLP(hidden=(30, 16), policy=F32_POLICY)  # 30 % 4 != 0
+    save_model(path, model, model.init(jax.random.PRNGKey(1)))
+    rt = MeshRuntime.create(MeshConfig(data=2, model=4))
+    eta = EtaService(ServeConfig(), model_path=path, runtime=rt)
+    assert eta.available
+    assert eta.kernel == "xla"  # replicated fallback
+    m, _ = eta.predict_eta_minutes(weather="Sunny", traffic="Low",
+                                   distance_m=5000.0,
+                                   pickup_time="2026-07-29T08:00:00")
+    assert m is not None and np.isfinite(m)
+
+
 def test_predict_eta_model_unavailable(model_artifact):
     eta = EtaService(ServeConfig(), model_path="/nonexistent/model.msgpack")
     app = create_app(Config(), eta_service=eta)
