@@ -62,6 +62,49 @@ def true_edge_time_s(length_m: np.ndarray, road_class: np.ndarray,
     return base * congestion * night + 4.0  # signalized-intersection overhead
 
 
+def add_congestion_observations(graph: Dict[str, np.ndarray], seed: int = 0,
+                                noise_sigma: float = 0.06,
+                                samples_per_edge: int = 1) -> Dict[str, np.ndarray]:
+    """Congestion-overlay training targets for ANY road graph.
+
+    Takes a topology-only graph dict (``senders``/``length_m``/
+    ``road_class`` — e.g. an OSM extract from ``data/osm.py``, which
+    carries no travel-time labels) and adds the per-edge observation
+    columns the GNN trains on: a sampled observation ``hour``, the
+    ground-truth congestion-model time (``true_edge_time_s`` — rush-hour
+    peaks, class sensitivity, night discount), and log-normally noised
+    observed time. In production these columns would come from fleet
+    telemetry; the overlay is the stand-in that makes learned leg costs
+    trainable on arbitrary real road networks, not only on the synthetic
+    generator whose observations are baked in (the round-2 gap: OSM
+    ingest and GNN serving were mutually exclusive).
+
+    ``samples_per_edge > 1`` tiles the edge arrays, drawing an
+    independent observation hour per copy — small extracts need several
+    observations per edge to expose the congestion curve's shape. The
+    serving fingerprint must be computed from the UN-tiled graph (the
+    topology serving aggregates over), so pass the base dict to
+    ``save_gnn`` and the tiled one only to the training batch.
+    """
+    rng = np.random.default_rng(seed)
+    out = dict(graph)
+    if samples_per_edge > 1:
+        for key in ("senders", "receivers", "length_m", "road_class",
+                    "speed_limit"):
+            if key in out:
+                out[key] = np.tile(np.asarray(out[key]), samples_per_edge)
+    n_edges = len(out["senders"])
+    road_class = np.asarray(out["road_class"], np.int32)
+    length_m = np.asarray(out["length_m"], np.float32)
+    hour = rng.integers(0, 24, size=n_edges).astype(np.int32)
+    t_true = true_edge_time_s(length_m, road_class, hour)
+    time_s = (t_true * rng.lognormal(0.0, noise_sigma, n_edges)).astype(np.float32)
+    out["hour"] = hour
+    out["time_s"] = time_s
+    out["time_true_s"] = t_true.astype(np.float32)
+    return out
+
+
 def generate_road_graph(n_nodes: int = 4096, k: int = 4, seed: int = 0,
                         noise_sigma: float = 0.06) -> Dict[str, np.ndarray]:
     """Graph dict: node_coords (N,2), senders/receivers (E,), edge feature

@@ -92,11 +92,13 @@ def candidate_permutations(n_stops: int, max_candidates: int = 4096,
                           dtype=np.int32)
     rng = np.random.default_rng(seed)
     if dist is not None:
-        n_informed = max_candidates - max_candidates // 4
-        informed = perturbed_greedy_orders(dist, n_informed, seed=seed)
-        tail = np.stack([rng.permutation(n_stops)
-                         for _ in range(max_candidates - n_informed)])
-        perms = np.concatenate([informed, tail.astype(np.int32)])
+        n_uniform = max_candidates // 4  # may be 0 at tiny budgets
+        informed = perturbed_greedy_orders(
+            dist, max_candidates - n_uniform, seed=seed)
+        tail = (np.stack([rng.permutation(n_stops)
+                          for _ in range(n_uniform)]).astype(np.int32)
+                if n_uniform else np.empty((0, n_stops), np.int32))
+        perms = np.concatenate([informed, tail])
     else:
         perms = np.stack(
             [rng.permutation(n_stops) for _ in range(max_candidates)]

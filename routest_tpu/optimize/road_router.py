@@ -102,18 +102,21 @@ class RoadRouter:
         road_class = np.asarray(g["road_class"], np.int32)
         speed_limit = np.asarray(
             g.get("speed_limit", _CLASS_SPEED_MPS[road_class]), np.float32)
-        # GNN compatibility is checked against the PRE-bridge graph (what
-        # training sees); if bridging then adds edges, the learned model
-        # is refused below rather than served over a topology it never saw.
-        from routest_tpu.train.checkpoint import graph_fingerprint
-
-        self._train_fingerprint = graph_fingerprint(
-            self.coords, senders, receivers, length)
         n_edges_raw = len(senders)
         senders, receivers, length, road_class, speed_limit = \
             self._bridge_components(senders, receivers, length, road_class,
                                     speed_limit)
         self._was_bridged = len(senders) != n_edges_raw
+        # GNN compatibility is checked against the POST-bridge graph —
+        # the edge set messages actually aggregate over at serving time.
+        # Training must therefore run on the same bridged arrays
+        # (``graph_dict()``), which makes learned costs work on real OSM
+        # extracts too: bridging is deterministic, so trainer and server
+        # agree on the fingerprint.
+        from routest_tpu.train.checkpoint import graph_fingerprint
+
+        self._fingerprint = graph_fingerprint(
+            self.coords, senders, receivers, length)
         self.senders, self.receivers = senders, receivers
         self.length_m = length
         self.road_class = road_class
@@ -149,6 +152,20 @@ class RoadRouter:
         "freeflow"."""
         return "gnn" if self._gnn is not None else "freeflow"
 
+    def graph_dict(self) -> Dict[str, np.ndarray]:
+        """The (post-bridge) routable graph — the EXACT arrays serving
+        aggregates over, and therefore the arrays the GNN must train on
+        (``scripts/train_gnn.py`` consumes this; the saved artifact's
+        fingerprint then matches ``_load_gnn``'s check)."""
+        return {
+            "node_coords": self.coords,
+            "senders": self.senders,
+            "receivers": self.receivers,
+            "length_m": self.length_m,
+            "road_class": self.road_class,
+            "speed_limit": self.speed_limit,
+        }
+
     def _load_gnn(self, path: Optional[str]):
         """(model, params) when a compatible artifact exists, else None.
 
@@ -168,17 +185,11 @@ class RoadRouter:
                 "road_gnn_artifact_unusable", path=resolved,
                 error=f"{type(e).__name__}: {e}")
             return None
-        if meta != self._train_fingerprint:
+        if meta != self._fingerprint:
             # Expected whenever a custom/test graph is routed; debug only.
             get_logger("routest.road").debug(
                 "road_gnn_graph_mismatch", path=resolved,
-                artifact=meta, router=self._train_fingerprint)
-            return None
-        if self._was_bridged:
-            # Training saw the unbridged edge set; serving it over extra
-            # bridge edges would perturb aggregation at their endpoints.
-            get_logger("routest.road").warning(
-                "road_gnn_refused_bridged_graph", path=resolved)
+                artifact=meta, router=self._fingerprint)
             return None
         return model, params
 

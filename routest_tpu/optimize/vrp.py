@@ -382,25 +382,19 @@ def trips_cost(dist: np.ndarray, trips) -> float:
 
 def tour_cost(dist: np.ndarray, order: np.ndarray,
               trip_ids: np.ndarray) -> float:
-    """Host-side total closed-tour distance of a (possibly multi-trip)
-    solution — the objective the refiners minimize."""
-    total = 0.0
-    cur = 0
-    last_trip = None
-    for p in range(len(order)):
-        if order[p] < 0:
+    """(order, trip_ids)-form view of :func:`trips_cost` — converts the
+    padded solver arrays to a trips-list and delegates, so there is one
+    cost oracle, not two."""
+    trips: list = []
+    last_tid = None
+    for o, t in zip(order, trip_ids):
+        if o < 0:
             break
-        node = int(order[p]) + 1
-        tid = int(trip_ids[p])
-        if tid != last_trip:
-            total += float(dist[cur, 0]) if last_trip is not None else 0.0
-            cur = 0
-            last_trip = tid
-        total += float(dist[cur, node])
-        cur = node
-    if last_trip is not None:
-        total += float(dist[cur, 0])
-    return total
+        if t != last_tid:
+            trips.append([])
+            last_tid = t
+        trips[-1].append(int(o))
+    return trips_cost(dist, trips)
 
 
 def solve_host(dist: np.ndarray, demands: np.ndarray, capacity: float,

@@ -37,6 +37,13 @@ def main() -> None:
     parser.add_argument("--nodes", type=int, default=2048)
     parser.add_argument("--steps", type=int, default=400)
     parser.add_argument("--hidden", type=int, default=64)
+    parser.add_argument("--osm", default=None, metavar="PATH",
+                        help="train on an OSM XML extract (data/osm.py) "
+                             "instead of the synthetic generator; targets "
+                             "come from the congestion overlay "
+                             "(road_graph.add_congestion_observations) and "
+                             "the artifact fingerprint matches the router "
+                             "serving that extract (ROAD_GRAPH_OSM)")
     parser.add_argument("--save", default=None,
                         help="artifact path (default: ROAD_GNN_PATH or "
                              "artifacts/road_gnn.msgpack — the same "
@@ -65,12 +72,34 @@ def main() -> None:
     import optax
 
     from routest_tpu.core.mesh import MeshRuntime
-    from routest_tpu.data.road_graph import generate_road_graph
+    from routest_tpu.data.road_graph import (add_congestion_observations,
+                                             generate_road_graph)
     from routest_tpu.models.gnn import RoadGNN, graph_batch
 
     runtime = MeshRuntime.create()
-    print(f"[1/3] graph: {args.nodes} nodes, mesh {dict(runtime.mesh.shape)}")
-    graph = generate_road_graph(n_nodes=args.nodes, k=4, seed=0)
+    # BOTH paths train on the EXACT routable graph a server aggregates
+    # over — RoadRouter's post-component-bridging edge set — so the
+    # artifact's fingerprint always passes the serving router's
+    # compatibility check (a disconnected kNN draw or OSM extract gains
+    # bridge edges; training on the raw arrays would fingerprint-mismatch
+    # forever). Targets come from the congestion overlay.
+    from routest_tpu.optimize.road_router import RoadRouter
+
+    if args.osm:
+        from routest_tpu.data.osm import load_osm
+
+        router = RoadRouter(graph=load_osm(args.osm), use_gnn=False)
+        args.nodes = router.n_nodes
+        print(f"[1/3] OSM graph {args.osm}: {router.n_nodes} nodes, "
+              f"mesh {dict(runtime.mesh.shape)}")
+    else:
+        print(f"[1/3] graph: {args.nodes} nodes, "
+              f"mesh {dict(runtime.mesh.shape)}")
+        router = RoadRouter(
+            graph=generate_road_graph(n_nodes=args.nodes, k=4, seed=0),
+            use_gnn=False)
+    serving_graph = router.graph_dict()  # un-tiled: carries the fingerprint
+    graph = add_congestion_observations(serving_graph, seed=0)
     n_edges = len(graph["senders"])
 
     naive = graph["length_m"] / np.maximum(graph["speed_limit"], 0.1) + 4.0
@@ -147,8 +176,13 @@ def main() -> None:
         "beats_naive": bool(rmse < naive_rmse
                             and rmse_hours < naive_rmse_hours),
     }
+    if args.osm:
+        report["osm"] = args.osm
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    out = os.path.join(repo, "artifacts", "gnn_report.json")
+    # --osm runs report separately: gnn_report.json is the config-4
+    # (full synthetic network) benchmark artifact the driver reads.
+    out = os.path.join(repo, "artifacts",
+                       "gnn_report_osm.json" if args.osm else "gnn_report.json")
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
@@ -159,19 +193,29 @@ def main() -> None:
     # path only accepts the serving router's graph size, so a --quick or
     # custom --nodes experiment can't overwrite the live artifact with a
     # fingerprint the router would refuse (silent free-flow degradation).
-    serving_compatible = args.nodes == 2048 and not args.quick
+    # --osm runs must name their artifact explicitly (--save): the
+    # DEFAULT path belongs to the synthetic serving graph, and an OSM
+    # artifact silently clobbering it would free-flow-degrade a synthetic
+    # server on its next boot (the fingerprint check refuses with only a
+    # debug log).
+    serving_compatible = (args.osm is None and args.nodes == 2048
+                          and not args.quick)
     if not args.no_save and report["beats_naive"] and (
             args.save or serving_compatible):
         from routest_tpu.train.checkpoint import default_gnn_path, save_gnn
 
         artifact = args.save or default_gnn_path()
-        save_gnn(artifact, model, params, graph)
+        # fingerprint from the UN-tiled serving graph, not the training
+        # view (identical today; add_congestion_observations may tile)
+        save_gnn(artifact, model, params, serving_graph)
         print(f"      artifact → {artifact}")
     elif not args.no_save and not report["beats_naive"]:
         print("      artifact NOT saved: run did not beat the naive baseline")
     elif not args.no_save:
-        print("      artifact NOT saved: non-serving graph size "
-              "(pass --save PATH to keep it)")
+        reason = ("--osm runs need an explicit --save PATH (point "
+                  "ROAD_GNN_PATH at it when serving)" if args.osm
+                  else "non-serving graph size (pass --save PATH to keep it)")
+        print(f"      artifact NOT saved: {reason}")
     sys.exit(0 if report["beats_naive"] else 1)
 
 

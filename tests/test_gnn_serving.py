@@ -107,6 +107,81 @@ def test_gnn_artifact_roundtrip_and_rejects_corrupt(tmp_path):
     assert router.leg_cost_model == "freeflow"
 
 
+def test_osm_extract_trains_and_serves_gnn(tmp_path):
+    """Round-3 e2e (VERDICT #3): a real OSM extract gets congestion-
+    overlay targets, trains the GNN, and the resulting artifact goes
+    LIVE on a router serving that same extract — leg_cost_model "gnn",
+    beating free-flow on hours whose labels were held out. Closes the
+    round-2 gap where OSM ingest and learned leg costs were mutually
+    exclusive."""
+    import os
+
+    import jax
+    import optax
+
+    from routest_tpu.core.dtypes import F32_POLICY
+    from routest_tpu.data.osm import load_osm
+    from routest_tpu.data.road_graph import add_congestion_observations
+    from routest_tpu.models.gnn import RoadGNN, graph_batch
+    from routest_tpu.train.checkpoint import save_gnn
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "mandaluyong_sample.osm")
+    base = RoadRouter(graph=load_osm(fixture), use_gnn=False)
+    assert base.leg_cost_model == "freeflow"
+
+    # Tiny extract: several observation samples per edge expose the
+    # congestion curve; the UN-tiled graph_dict carries the fingerprint.
+    serving_graph = base.graph_dict()
+    train_graph = add_congestion_observations(
+        serving_graph, seed=3, samples_per_edge=16)
+    held_hours = (8, 18)  # labels at these hours never enter the loss
+    held = np.isin(train_graph["hour"], held_hours)
+
+    model = RoadGNN(n_nodes=base.n_nodes, hidden=16, n_rounds=2,
+                    policy=F32_POLICY)
+    params = model.init(jax.random.PRNGKey(0))
+    optimizer = optax.adamw(optax.cosine_decay_schedule(5e-3, 250), 1e-4)
+    opt_state = optimizer.init(params)
+    batch = graph_batch(train_graph)
+    batch = batch._replace(
+        weights=batch.weights * np.asarray(~held, np.float32))
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(model.loss)(
+            params, train_graph["node_coords"], batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(250):
+        params, opt_state, _ = step(params, opt_state)
+
+    # held-out-hour quality: learned times beat free-flow physics
+    pred = np.asarray(model.apply(params, train_graph["node_coords"], batch))
+    naive = (train_graph["length_m"]
+             / np.maximum(train_graph["speed_limit"], 0.1) + 4.0)
+    truth = train_graph["time_s"]
+    gnn_rmse = float(np.sqrt(np.mean((pred[held] - truth[held]) ** 2)))
+    naive_rmse = float(np.sqrt(np.mean((naive[held] - truth[held]) ** 2)))
+    assert gnn_rmse < naive_rmse, (gnn_rmse, naive_rmse)
+
+    # artifact saved against the SERVING graph → goes live on a fresh
+    # router of the same extract
+    artifact = str(tmp_path / "osm_gnn.msgpack")
+    save_gnn(artifact, model, params, serving_graph)
+    served = RoadRouter(graph=load_osm(fixture), gnn_path=artifact)
+    assert served.leg_cost_model == "gnn"
+    rush, night = served.edge_time_s(8), served.edge_time_s(3)
+    assert rush.shape == served.length_m.shape
+    assert np.isfinite(rush).all()
+    assert rush.mean() > night.mean()  # learned the congestion regime
+
+    # and a different graph still refuses the artifact (fingerprint)
+    other = RoadRouter(n_nodes=128, seed=9, gnn_path=artifact)
+    assert other.leg_cost_model == "freeflow"
+
+
 def test_gnn_beats_naive_on_held_out_edges():
     """Training-quality gate at test scale: learned per-edge times beat
     the free-flow estimate on edges whose labels were held out."""
