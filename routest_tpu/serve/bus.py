@@ -138,9 +138,17 @@ class _RedisSubscription:
 
 
 def make_bus(redis_url: Optional[str]):
+    """Bus from the REDIS_URL scheme: ``redis(s)://`` → RedisBus,
+    ``tcp://`` → the hermetic cross-process broker (``serve/netbus.py``),
+    unset/unreachable → in-memory (single-process)."""
     if redis_url:
         try:
-            bus = RedisBus(redis_url)
+            if redis_url.startswith("tcp://"):
+                from routest_tpu.serve.netbus import NetBus
+
+                bus = NetBus(redis_url)
+            else:
+                bus = RedisBus(redis_url)
             if bus.ping():
                 return bus
         except Exception:

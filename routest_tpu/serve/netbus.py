@@ -1,0 +1,297 @@
+"""Hermetic cross-process pub/sub: a tiny TCP broker + bus client.
+
+The reference fans SSE out across workers through Upstash Redis
+(``Flaskr/__init__.py:25-28`` — Redis exists there for exactly this:
+one worker receives the tracker POST, another holds the browser's SSE
+socket). This module is the hermetic equivalent for environments
+without a Redis server: a ~stdlib-only broker process speaking
+newline-delimited JSON over TCP, and a ``NetBus`` client with the same
+interface as ``InMemoryBus``/``RedisBus`` (publish / subscribe / ping).
+
+Select it with ``REDIS_URL=tcp://host:port`` (``make_bus`` dispatches on
+the scheme); run a broker with ``python -m routest_tpu.serve.netbus``.
+
+Protocol (one JSON object per line):
+- ``{"op": "ping"}``                       → ``{"ok": true}``
+- ``{"op": "publish", "channel": c, "data": …}``
+                                           → ``{"ok": true, "receivers": n}``
+- ``{"op": "subscribe", "channel": c}``    → ``{"ok": true}`` then a
+  ``{"channel": c, "data": …}`` push line per published message; the
+  connection stays open for the life of the subscription.
+
+Not a Redis replacement — no persistence, no auth, loopback-trust
+security model (bind 127.0.0.1 unless told otherwise).
+"""
+
+from __future__ import annotations
+
+import json
+import select
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, Optional, Set, Tuple
+from urllib.parse import urlsplit
+
+# A subscriber that stops reading (backgrounded browser tab, network
+# stall) must never block publishes for everyone else: once its TCP
+# window fills, sends time out after this long and the broker drops it.
+_SEND_TIMEOUT_S = 1.0
+
+
+class _BrokerHandler(socketserver.StreamRequestHandler):
+    def setup(self) -> None:
+        super().setup()
+        # Serializes the handler thread's acks with fanout pushes from
+        # publisher threads — without it a subscribe ack could interleave
+        # with (or trail) the first pushed event.
+        self._wlock = threading.Lock()
+
+    def handle(self) -> None:  # one connection = publisher or subscriber
+        server: Broker = self.server  # type: ignore[assignment]
+        subscribed: Optional[str] = None
+        try:
+            for raw in self.rfile:
+                try:
+                    msg = json.loads(raw)
+                    op = msg.get("op")
+                except Exception:
+                    self._send({"ok": False, "error": "bad json"})
+                    continue
+                if op == "ping":
+                    self._send({"ok": True})
+                elif op == "publish":
+                    n = server.fanout(str(msg.get("channel")), msg.get("data"))
+                    self._send({"ok": True, "receivers": n})
+                elif op == "subscribe":
+                    subscribed = str(msg.get("channel"))
+                    # SO_SNDTIMEO (send-only: blocking reads unaffected)
+                    # bounds pushes to a stalled consumer.
+                    self.connection.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                        struct.pack("ll", int(_SEND_TIMEOUT_S),
+                                    int((_SEND_TIMEOUT_S % 1) * 1e6)))
+                    # ack BEFORE the handler becomes visible to fanout,
+                    # so no pushed event can precede it on the wire
+                    self._send({"ok": True})
+                    server.add_subscriber(subscribed, self)
+                else:
+                    self._send({"ok": False, "error": f"unknown op {op!r}"})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if subscribed is not None:
+                server.drop_subscriber(subscribed, self)
+
+    def _send(self, obj: dict) -> None:
+        with self._wlock:
+            self.wfile.write(json.dumps(obj).encode() + b"\n")
+            self.wfile.flush()
+
+    def push(self, line: bytes) -> bool:
+        try:
+            with self._wlock:
+                self.wfile.write(line)
+                self.wfile.flush()
+            return True
+        except (ConnectionError, OSError):
+            # includes socket.timeout: a consumer that stayed stalled past
+            # SO_SNDTIMEO gets dropped rather than blocking the channel
+            return False
+
+
+class Broker(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        super().__init__((host, port), _BrokerHandler)
+        self._subs: Dict[str, Set[_BrokerHandler]] = {}
+        self._subs_lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def add_subscriber(self, channel: str, h: _BrokerHandler) -> None:
+        with self._subs_lock:
+            self._subs.setdefault(channel, set()).add(h)
+
+    def drop_subscriber(self, channel: str, h: _BrokerHandler) -> None:
+        with self._subs_lock:
+            self._subs.get(channel, set()).discard(h)
+
+    def fanout(self, channel: str, data) -> int:
+        line = json.dumps({"channel": channel, "data": data}).encode() + b"\n"
+        with self._subs_lock:
+            targets = list(self._subs.get(channel, ()))
+        delivered = 0
+        for h in targets:
+            if h.push(line):
+                delivered += 1
+            else:
+                self.drop_subscriber(channel, h)
+        return delivered
+
+
+def start_broker(host: str = "127.0.0.1",
+                 port: int = 0) -> Tuple[Broker, threading.Thread]:
+    """In-process broker (tests); returns (server, serving thread)."""
+    broker = Broker(host, port)
+    t = threading.Thread(target=broker.serve_forever, daemon=True)
+    t.start()
+    return broker, t
+
+
+def _parse(url: str) -> Tuple[str, int]:
+    parts = urlsplit(url)
+    if parts.scheme != "tcp" or not parts.hostname or not parts.port:
+        raise ValueError(f"netbus url must be tcp://host:port, got {url!r}")
+    return parts.hostname, parts.port
+
+
+class NetBus:
+    """Bus client over a :class:`Broker` (interface-equal to
+    ``InMemoryBus``/``RedisBus`` in ``serve/bus.py``)."""
+
+    def __init__(self, url: str, timeout: float = 2.0) -> None:
+        self._addr = _parse(url)
+        self._timeout = timeout
+        self._lock = threading.Lock()  # one command in flight on the conn
+        self._conn: Optional[socket.socket] = None
+        self._rfile = None
+
+    def _connect(self):
+        conn = socket.create_connection(self._addr, timeout=self._timeout)
+        return conn, conn.makefile("rb")
+
+    def _command(self, obj: dict) -> dict:
+        payload = json.dumps(obj).encode() + b"\n"
+        with self._lock:
+            for attempt in (0, 1):  # reconnect once on a dead keep-alive
+                try:
+                    if self._conn is None:
+                        self._conn, self._rfile = self._connect()
+                    self._conn.sendall(payload)
+                    line = self._rfile.readline()
+                    if not line:
+                        raise ConnectionError("broker closed connection")
+                    return json.loads(line)
+                except (ConnectionError, OSError, ValueError):
+                    if self._conn is not None:
+                        try:
+                            self._conn.close()
+                        except OSError:
+                            pass
+                    self._conn = None
+                    self._rfile = None
+                    if attempt:
+                        raise
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def publish(self, channel: str, data: dict) -> int:
+        resp = self._command({"op": "publish", "channel": channel,
+                              "data": data})
+        return int(resp.get("receivers", 0))
+
+    def subscribe(self, channel: str) -> "_NetSubscription":
+        conn = socket.create_connection(self._addr, timeout=self._timeout)
+        conn.sendall(json.dumps({"op": "subscribe",
+                                 "channel": channel}).encode() + b"\n")
+        sub = _NetSubscription(conn)
+        ack = sub._read_line(timeout=self._timeout)
+        if ack is None or not json.loads(ack).get("ok"):
+            conn.close()
+            raise ConnectionError(f"subscribe to {channel!r} refused")
+        return sub
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._command({"op": "ping"}).get("ok"))
+        except Exception:
+            return False
+
+    @property
+    def kind(self) -> str:
+        return "netbus"
+
+
+class _NetSubscription:
+    """Line reader over the subscription socket.
+
+    select() + a manual byte buffer instead of socket.makefile +
+    settimeout: a timeout firing mid-line on a buffered file object
+    leaves its internal buffer inconsistent (documented makefile
+    caveat), silently corrupting the next message — here a partial line
+    just stays in ``_buf`` until the rest arrives.
+    """
+
+    def __init__(self, conn: socket.socket) -> None:
+        self._conn = conn
+        self._conn.setblocking(False)
+        self._buf = bytearray()
+
+    def _read_line(self, timeout: float) -> Optional[bytes]:
+        deadline = time.monotonic() + max(timeout, 0.001)
+        while b"\n" not in self._buf:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                readable, _, _ = select.select([self._conn], [], [], remaining)
+            except (OSError, ValueError):  # closed fd
+                return None
+            if not readable:
+                return None
+            try:
+                chunk = self._conn.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                return None
+            if not chunk:  # peer closed
+                return None
+            self._buf += chunk
+        line, _, rest = bytes(self._buf).partition(b"\n")
+        self._buf = bytearray(rest)
+        return line
+
+    def get(self, timeout: Optional[float] = None) -> Optional[dict]:
+        line = self._read_line(timeout if timeout and timeout > 0 else 0.01)
+        if not line:
+            return None
+        try:
+            return json.loads(line).get("data")
+        except ValueError:
+            return None
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "_NetSubscription":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="routest_tpu SSE broker")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    broker = Broker(args.host, args.port)
+    print(f"[netbus] broker listening on tcp://{args.host}:{broker.port}",
+          flush=True)
+    broker.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

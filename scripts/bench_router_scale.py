@@ -1,0 +1,116 @@
+"""Road-router scale benchmark: metro-scale graphs (VERDICT r2 #5).
+
+Measures the on-device batched Bellman-Ford shortest-path solver
+(``optimize/road_router.py``) from the 2k-node serving default up to a
+≥50k-node metro-scale network — ORS-class territory, the engine the
+reference outsources its matrix calls to (``Flaskr/utils.py:97-103``).
+
+Per size: graph build time, router init (bridging + device upload),
+cold solve (includes the XLA compile for that padded source bucket),
+and warm solve wall time for a 16-waypoint batch (the quantity that
+gates request latency — one solve prices a whole (M, M) leg matrix).
+
+Writes artifacts/router_scale.json and prints a markdown table.
+Runs on whatever jax backend is active (TPU through the tunnel when
+available; --cpu forces the hermetic backend).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sizes", type=int, nargs="+",
+                        default=[2048, 8192, 50_000])
+    parser.add_argument("--waypoints", type=int, default=16)
+    parser.add_argument("--cpu", action="store_true",
+                        help="hermetic CPU backend (TPU tunnel down)")
+    args = parser.parse_args()
+    if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import numpy as np
+
+    from routest_tpu.data.road_graph import generate_road_graph
+    from routest_tpu.optimize.road_router import RoadRouter
+
+    rows = []
+    rng = np.random.default_rng(7)
+    for n in args.sizes:
+        t0 = time.perf_counter()
+        graph = generate_road_graph(n_nodes=n, k=4, seed=0)
+        t_gen = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        router = RoadRouter(graph=graph, use_gnn=False)
+        t_init = time.perf_counter() - t0
+
+        pts = np.stack([
+            rng.uniform(14.40, 14.68, args.waypoints),
+            rng.uniform(120.96, 121.10, args.waypoints),
+        ], axis=1).astype(np.float32)
+        nodes = router.snap(pts)
+
+        t0 = time.perf_counter()
+        dist, _ = router.shortest(nodes)            # cold: pays compile
+        t_cold = time.perf_counter() - t0
+
+        solves = []
+        for _ in range(3):                           # warm: steady state
+            t0 = time.perf_counter()
+            dist, _ = router.shortest(nodes)
+            solves.append(time.perf_counter() - t0)
+        t_warm = min(solves)
+
+        reach = np.isfinite(
+            np.where(dist < 1e37, dist, np.inf)).mean()
+        row = {
+            "nodes": router.n_nodes,
+            "edges": int(len(router.senders)),
+            "waypoints": args.waypoints,
+            "graph_build_s": round(t_gen, 2),
+            "router_init_s": round(t_init, 2),
+            "solve_cold_ms": round(1000 * t_cold, 1),
+            "solve_warm_ms": round(1000 * t_warm, 1),
+            "max_iters_bound": router.max_iters,
+            "reachable_frac": round(float(reach), 4),
+        }
+        rows.append(row)
+        print(f"  {row['nodes']:>7,} nodes {row['edges']:>8,} edges | "
+              f"build {row['graph_build_s']}s init {row['router_init_s']}s | "
+              f"solve cold {row['solve_cold_ms']}ms warm "
+              f"{row['solve_warm_ms']}ms", flush=True)
+
+    report = {"backend": jax.default_backend(), "rows": rows}
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "router_scale.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+
+    print(f"\n| nodes | edges | warm solve ({args.waypoints} sources) | "
+          f"cold (compile) |")
+    print("|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['nodes']:,} | {r['edges']:,} | {r['solve_warm_ms']} ms "
+              f"| {r['solve_cold_ms']} ms |")
+    print(f"\nbackend={report['backend']} → {out}")
+
+
+if __name__ == "__main__":
+    main()

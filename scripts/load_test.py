@@ -89,7 +89,9 @@ def _percentiles(samples):
             round(1000 * sum(samples) / len(samples), 2)}
 
 
-def run_load(base: str, n_threads: int, n_requests: int):
+def run_load(bases, n_threads: int, n_requests: int):
+    """``bases``: one or more server base URLs; client threads round-robin
+    across them (multi-worker mode shares one SSE broker behind them)."""
     from routest_tpu.data.locations import SEED_LOCATIONS
 
     eta_lat: list = []
@@ -126,7 +128,7 @@ def run_load(base: str, n_threads: int, n_requests: int):
         rng = random.Random(seed)
         # One persistent HTTP/1.1 connection per worker: measures the
         # server, not per-request TCP/thread setup.
-        poster = PersistentPoster(base)
+        poster = PersistentPoster(bases[seed % len(bases)])
         for i in range(n_requests):
             try:
                 if i % 10 == 9:  # 10% heavy optimize calls
@@ -159,6 +161,7 @@ def run_load(base: str, n_threads: int, n_requests: int):
     total = len(eta_lat) + len(opt_lat)
     report = {
         "threads": n_threads,
+        "workers": len(bases),
         "requests": total,
         "wall_seconds": round(wall, 2),
         "rps": round(total / wall, 1),
@@ -167,13 +170,15 @@ def run_load(base: str, n_threads: int, n_requests: int):
         "optimize_route": _percentiles(opt_lat) if opt_lat else {},
     }
     try:
-        report["server_metrics"] = _get(base, "/api/metrics")
+        # one entry per worker — scraping only worker 0 would present
+        # ~1/N of the traffic as if it were the whole run's server view
+        report["server_metrics"] = [_get(b, "/api/metrics") for b in bases]
     except Exception:
         pass
     return report, errors
 
 
-def run_batch_load(base: str, n_threads: int, n_requests: int,
+def run_batch_load(bases, n_threads: int, n_requests: int,
                    batch_size: int):
     """North-star phase: OD *batches* through ``/api/predict_eta_batch``.
 
@@ -199,7 +204,7 @@ def run_batch_load(base: str, n_threads: int, n_requests: int,
 
     def worker(seed: int):
         rng = random.Random(seed)
-        poster = PersistentPoster(base, timeout=120)
+        poster = PersistentPoster(bases[seed % len(bases)], timeout=120)
         for _ in range(n_requests):
             try:
                 dt_s, status, raw = poster.post("/api/predict_eta_batch",
@@ -250,6 +255,10 @@ def main() -> None:
                         help="requests per thread")
     parser.add_argument("--base-url", default=None,
                         help="target a running server instead of self-spawning")
+    parser.add_argument("--workers", type=int, default=1,
+                        help="self-spawn N server worker processes sharing "
+                             "one SSE broker (serve/netbus.py); clients "
+                             "round-robin across workers")
     parser.add_argument("--p95-budget-ms", type=float, default=50.0,
                         help="fail if /api/predict_eta client p95 exceeds "
                              "this (0 disables)")
@@ -267,45 +276,66 @@ def main() -> None:
     # NB: --cpu configures the SERVER subprocess (via ROUTEST_FORCE_CPU
     # below); the load generator itself never touches jax.
 
-    server_proc = None
+    server_procs = []
+    broker = None
     if args.base_url:
-        base = args.base_url.rstrip("/")
+        if args.workers > 1:
+            parser.error("--workers spawns local servers and cannot be "
+                         "combined with --base-url (target N external "
+                         "workers by running one load_test per base)")
+        bases = [args.base_url.rstrip("/")]
     else:
-        # Self-spawn the server in a SUBPROCESS: an in-process server
+        # Self-spawn server(s) in SUBPROCESSES: an in-process server
         # would share the load generator's GIL, inflating client-side
         # percentiles with generator scheduling delay rather than
         # measuring the server (round 1 measured exactly that artifact).
+        # --workers N spawns N worker processes sharing one SSE broker
+        # (the cross-process bus, serve/netbus.py).
         import socket
         import subprocess
 
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = dict(os.environ)
-        env["PORT"] = str(port)
         if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
             env["ROUTEST_FORCE_CPU"] = "1"
-        server_proc = subprocess.Popen(
-            [sys.executable, "-m", "routest_tpu.serve"], env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        )
-        base = f"http://127.0.0.1:{port}"
-        print(f"[load_test] spawned server pid={server_proc.pid} at {base}")
+        n_workers = max(1, args.workers)
+        if n_workers > 1:
+            from routest_tpu.serve.netbus import start_broker
+
+            broker, _ = start_broker()
+            env["REDIS_URL"] = f"tcp://127.0.0.1:{broker.port}"
+            print(f"[load_test] broker at {env['REDIS_URL']}")
+        ports = []
+        for _ in range(n_workers):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                ports.append(s.getsockname()[1])
+        for port in ports:
+            e = dict(env)
+            e["PORT"] = str(port)
+            server_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "routest_tpu.serve"], env=e, cwd=repo))
+        bases = [f"http://127.0.0.1:{p}" for p in ports]
+        print(f"[load_test] spawned {n_workers} server worker(s): "
+              f"{', '.join(bases)}")
         deadline = time.time() + 240  # first boot may train + warm buckets
-        while True:
-            try:
-                if _get(base, "/api/ping", timeout=2).get("ok"):
-                    break
-            except Exception:
-                pass
-            if server_proc.poll() is not None:
-                print("[load_test] server process died", file=sys.stderr)
-                sys.exit(2)
-            if time.time() > deadline:
-                server_proc.kill()
-                print("[load_test] server never became ready", file=sys.stderr)
-                sys.exit(2)
-            time.sleep(0.5)
+        for base in bases:
+            while True:
+                try:
+                    if _get(base, "/api/ping", timeout=2).get("ok"):
+                        break
+                except Exception:
+                    pass
+                if any(p.poll() is not None for p in server_procs):
+                    print("[load_test] a server process died", file=sys.stderr)
+                    sys.exit(2)
+                if time.time() > deadline:
+                    for p in server_procs:
+                        p.kill()
+                    print("[load_test] server never became ready",
+                          file=sys.stderr)
+                    sys.exit(2)
+                time.sleep(0.5)
 
     try:
         cores = os.cpu_count() or 1
@@ -314,17 +344,17 @@ def main() -> None:
             print(f"[load_test] WARNING: {n_threads} threads on {cores} "
                   f"core(s): client p95 will be dominated by host queueing",
                   file=sys.stderr)
-        report, errors = run_load(base, n_threads, args.requests)
+        report, errors = run_load(bases, n_threads, args.requests)
         if args.batch_size > 0:
             batch_report, batch_errors = run_batch_load(
-                base, args.batch_threads, args.batch_requests,
+                bases, args.batch_threads, args.batch_requests,
                 args.batch_size)
             report["predict_eta_batch"] = batch_report
             errors.extend(batch_errors)
     except BaseException:
-        # Don't leak the spawned server on any failure/abort path.
-        if server_proc is not None:
-            server_proc.terminate()
+        # Don't leak spawned servers on any failure/abort path.
+        for p_ in server_procs:
+            p_.terminate()
         raise
     report["cpu_count"] = cores
     # Latency budget on the batched hot path: the whole point of warming
@@ -350,8 +380,8 @@ def main() -> None:
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
-    if server_proc is not None:
-        server_proc.terminate()
+    for p_ in server_procs:
+        p_.terminate()
     sys.exit(1 if errors or not budget_ok else 0)
 
 

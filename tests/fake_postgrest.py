@@ -1,0 +1,120 @@
+"""Minimal PostgREST-compatible HTTP server for cross-process tests.
+
+Implements exactly the request shapes ``serve/store.py:PostgRESTStore``
+issues (the same shapes the reference sends to Supabase,
+``Flaskr/routes.py:134-182,193-250,386-405``): representation-returning
+inserts, embedded-resource selects with ``order``/``limit``/``id=eq.``
+filters, and FK-cascade deletes. In-memory, threaded, stdlib-only — the
+multi-worker analog of the reference's sqlite-:memory: test trick.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.requests: Dict[str, Dict] = {}
+        self.results: Dict[str, List[Dict]] = {}
+
+
+def _now() -> str:
+    return dt.datetime.now(dt.timezone.utc).isoformat()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # keep test output clean
+        pass
+
+    @property
+    def _state(self) -> _State:
+        return self.server.state  # type: ignore[attr-defined]
+
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _table(self) -> Tuple[str, dict]:
+        parts = urlsplit(self.path)
+        return parts.path.rsplit("/", 1)[-1], parse_qs(parts.query)
+
+    def do_POST(self) -> None:
+        table, _ = self._table()
+        row = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0))))
+        st = self._state
+        with st.lock:
+            if table == "route_requests":
+                rid = str(uuid.uuid4())
+                stored = {"id": rid, "request_time": _now(), **row}
+                st.requests[rid] = stored
+                self._json(201, [stored])
+            elif table == "route_results":
+                req_id = row.get("request_id")
+                if req_id not in st.requests:
+                    self._json(409, {"message": "FK violation"})
+                    return
+                stored = {"id": str(uuid.uuid4()), "created_at": _now(), **row}
+                st.results.setdefault(req_id, []).append(stored)
+                self._json(201, [stored])
+            else:
+                self._json(404, {"message": f"no table {table}"})
+
+    def do_GET(self) -> None:
+        table, q = self._table()
+        if table != "route_requests":
+            self._json(404, {"message": f"no table {table}"})
+            return
+        st = self._state
+        with st.lock:
+            rows = list(st.requests.values())
+            if "id" in q:  # id=eq.<uuid>
+                want = q["id"][0].removeprefix("eq.")
+                rows = [r for r in rows if r["id"] == want]
+            if q.get("order", [""])[0].startswith("request_time.desc"):
+                rows = sorted(rows, key=lambda r: r["request_time"],
+                              reverse=True)
+            limit = int(q.get("limit", ["1000"])[0])
+            rows = rows[:limit]
+            embed = "route_results" in q.get("select", [""])[0]
+            out = [
+                {**r, **({"route_results": list(st.results.get(r["id"], ()))}
+                         if embed else {})}
+                for r in rows
+            ]
+        self._json(200, out)
+
+    def do_DELETE(self) -> None:
+        table, q = self._table()
+        if table != "route_requests" or "id" not in q:
+            self._json(404, {"message": "unsupported delete"})
+            return
+        want = q["id"][0].removeprefix("eq.")
+        st = self._state
+        with st.lock:
+            row = st.requests.pop(want, None)
+            st.results.pop(want, None)  # FK cascade
+        self._json(200, [row] if row else [])
+
+
+def start_fake_postgrest(port: int = 0):
+    """→ (server, thread, base_url). ``base_url`` is what SUPABASE_URL
+    should be set to (the store appends ``/rest/v1`` itself)."""
+    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    server.state = _State()  # type: ignore[attr-defined]
+    server.daemon_threads = True
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, t, f"http://127.0.0.1:{server.server_address[1]}"
