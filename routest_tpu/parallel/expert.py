@@ -1,0 +1,159 @@
+"""Expert parallelism: a Switch-style MoE layer over an ``expert`` axis.
+
+The last §2.4 row (SURVEY.md marks EP "n/a; keep mesh abstraction
+general" — the reference has no parallelism of any kind). Implemented
+rather than waived so the mesh abstraction is proven general: per-expert
+MLPs live on their own devices, tokens travel to their expert and back
+via ``all_to_all`` — the EP pattern that scales conditional-compute
+models past one chip's HBM.
+
+Schedule (top-1 routing, capacity-bounded — the Switch Transformer
+recipe):
+
+1. tokens are sharded over the ``expert`` axis (which doubles as the
+   data axis for the token batch, the standard EP layout);
+2. each device routes its local tokens (argmax over router logits) and
+   packs, per destination expert, up to ``capacity`` tokens into a
+   fixed-shape (E, C, D) dispatch buffer (overflow tokens are dropped —
+   their output is the zero vector, recorded in the combine mask);
+3. ONE ``all_to_all`` turns (dest_expert, C, D) into (source_device, C,
+   D) on every expert's device — each device now holds every token
+   routed to ITS expert;
+4. the local expert MLP runs on its (E·C, D) slab — dense matmuls, MXU
+   territory;
+5. a second ``all_to_all`` returns expert outputs to the tokens' home
+   devices, where they scatter back into sequence order, scaled by the
+   router gate (straight-through for top-1).
+
+Everything is fixed-shape; gradients flow through both all_to_alls and
+the gather/scatter (router grads via the gate multiplication). A
+``load_balance_loss`` (mean expert load × mean router prob, scaled E²)
+is returned for training, as in the Switch paper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from routest_tpu.core.smap import shard_map
+
+Params = Dict
+
+
+def init_moe_params(key: jax.Array, n_experts: int, d_model: int,
+                    d_hidden: int) -> Params:
+    """Router + stacked expert FFNs (leading axis = expert)."""
+    kr, k1, k2 = jax.random.split(key, 3)
+    s1 = 1.0 / jnp.sqrt(d_model)
+    s2 = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "router": jax.random.normal(kr, (d_model, n_experts)) * s1,
+        "w1": jax.random.normal(k1, (n_experts, d_model, d_hidden)) * s1,
+        "b1": jnp.zeros((n_experts, d_hidden)),
+        "w2": jax.random.normal(k2, (n_experts, d_hidden, d_model)) * s2,
+        "b2": jnp.zeros((n_experts, d_model)),
+    }
+
+
+def shard_moe_params(params: Params, mesh: Mesh,
+                     expert_axis: str = "expert") -> Params:
+    """Experts to their devices; the router is replicated."""
+    ex = NamedSharding(mesh, P(expert_axis))
+    rep = NamedSharding(mesh, P())
+    return {k: jax.device_put(v, rep if k == "router" else ex)
+            for k, v in params.items()}
+
+
+def _expert_ffn(w1, b1, w2, b2, x):
+    return jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+
+
+def moe_apply_dense(params: Params, tokens: jax.Array) -> jax.Array:
+    """Single-device oracle: every token through its argmax expert, no
+    capacity limit. The EP layer must match this wherever no token
+    overflowed."""
+    logits = tokens @ params["router"]
+    gates = jax.nn.softmax(logits, axis=-1)
+    choice = jnp.argmax(logits, axis=-1)                       # (B,)
+    outs = jax.vmap(_expert_ffn, in_axes=(0, 0, 0, 0, None))(
+        params["w1"], params["b1"], params["w2"], params["b2"], tokens)
+    # outs: (E, B, D); pick each token's expert, scale by its gate
+    picked = jnp.take_along_axis(
+        outs, choice[None, :, None], axis=0)[0]                # (B, D)
+    gate = jnp.take_along_axis(gates, choice[:, None], axis=1)
+    return picked * gate
+
+
+def make_moe_apply(mesh: Mesh, expert_axis: str = "expert",
+                   capacity_factor: float = 2.0):
+    """jitted (params, tokens) → (outputs, aux) with experts sharded over
+    ``expert_axis`` and tokens sharded over the same axis.
+
+    ``aux``: dict with ``load_balance_loss`` (scalar) and
+    ``dropped_frac`` (scalar fraction of tokens past capacity, whose
+    output is zero).
+    """
+    n_exp = mesh.shape[expert_axis]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=({"router": P(), "w1": P(expert_axis), "b1": P(expert_axis),
+                   "w2": P(expert_axis), "b2": P(expert_axis)},
+                  P(expert_axis)),
+        out_specs=(P(expert_axis), P()))
+    def run(params, tokens):
+        b_local, d = tokens.shape
+        capacity = max(1, int(capacity_factor * b_local / n_exp))
+
+        logits = tokens @ params["router"]                  # (b, E)
+        gates = jax.nn.softmax(logits, axis=-1)
+        choice = jnp.argmax(logits, axis=-1)                # (b,)
+        gate = jnp.take_along_axis(gates, choice[:, None], axis=1)[:, 0]
+
+        # position of each token within its expert's capacity window
+        one_hot = jax.nn.one_hot(choice, n_exp, dtype=jnp.int32)  # (b, E)
+        # already zero outside each token's chosen column, so the row sum
+        # IS the token's slot index within its expert
+        pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1) * one_hot
+        slot = pos_in_expert.sum(axis=1)                    # (b,)
+        keep = slot < capacity                              # overflow drops
+
+        # pack: dispatch[e, c] = token routed to expert e at slot c
+        dispatch = jnp.zeros((n_exp, capacity, d), tokens.dtype)
+        src = jnp.where(keep, choice, 0)
+        slot_c = jnp.clip(slot, 0, capacity - 1)
+        dispatch = dispatch.at[src, slot_c].add(
+            tokens * keep[:, None].astype(tokens.dtype))
+
+        # (dest_expert, C, D) → every device receives its expert's slab
+        # from all source devices: (n_source, C, D)
+        arriving = jax.lax.all_to_all(dispatch, expert_axis, split_axis=0,
+                                      concat_axis=0, tiled=True)
+        local = jax.tree_util.tree_map(lambda a: a[0], (
+            params["w1"], params["b1"], params["w2"], params["b2"]))
+        out = _expert_ffn(*local, arriving.reshape(-1, d))
+        out = out.reshape(n_exp, capacity, d)
+        # route results back to the tokens' home devices
+        returned = jax.lax.all_to_all(out, expert_axis, split_axis=0,
+                                      concat_axis=0, tiled=True)
+
+        # unpack: token i's output sits at returned[choice[i], slot[i]]
+        gathered = returned[src, slot_c]                    # (b, D)
+        y = gathered * (gate * keep.astype(gate.dtype))[:, None]
+
+        # Switch load-balance loss: E · Σ_e (frac tokens to e)(mean prob e),
+        # psum'd so every shard reports the GLOBAL value.
+        frac = one_hot.astype(jnp.float32).mean(axis=0)
+        prob = gates.mean(axis=0)
+        lbl = n_exp * jnp.sum(
+            jax.lax.pmean(frac, expert_axis)
+            * jax.lax.pmean(prob, expert_axis))
+        dropped = jax.lax.pmean(1.0 - keep.mean(), expert_axis)
+        return y, {"load_balance_loss": lbl, "dropped_frac": dropped}
+
+    return jax.jit(run)

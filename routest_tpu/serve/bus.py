@@ -158,12 +158,20 @@ def make_bus(redis_url: Optional[str]):
 
 def sse_stream(subscription, keepalive_s: float = 15.0,
                max_events: Optional[int] = None) -> Iterator[bytes]:
-    """Subscription → text/event-stream byte chunks (SSE wire format)."""
+    """Subscription → text/event-stream byte chunks (SSE wire format).
+
+    A subscription that reports ``closed`` (cross-process backend died or
+    dropped us) ENDS the stream instead of keepaliving forever — the
+    browser's EventSource then reconnects with backoff (the dashboard's
+    retry loop), landing on a live subscription.
+    """
     sent = 0
     with subscription:
         while max_events is None or sent < max_events:
             data = subscription.get(timeout=keepalive_s)
             if data is None:
+                if getattr(subscription, "closed", False):
+                    return
                 yield b": keepalive\n\n"
                 continue
             yield f"data: {json.dumps(data)}\n\n".encode()

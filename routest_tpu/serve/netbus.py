@@ -73,10 +73,17 @@ class _BrokerHandler(socketserver.StreamRequestHandler):
                         socket.SOL_SOCKET, socket.SO_SNDTIMEO,
                         struct.pack("ll", int(_SEND_TIMEOUT_S),
                                     int((_SEND_TIMEOUT_S % 1) * 1e6)))
-                    # ack BEFORE the handler becomes visible to fanout,
-                    # so no pushed event can precede it on the wire
-                    self._send({"ok": True})
-                    server.add_subscriber(subscribed, self)
+                    # Register-then-ack, both under the write lock: the
+                    # ack must imply "registered" (a caller may publish
+                    # immediately after subscribe() returns), while the
+                    # lock keeps any concurrent fanout push from landing
+                    # on the wire ahead of the ack. Lock order is safe:
+                    # fanout copies its targets out of _subs_lock before
+                    # taking any handler's write lock.
+                    with self._wlock:
+                        server.add_subscriber(subscribed, self)
+                        self.wfile.write(b'{"ok": true}\n')
+                        self.wfile.flush()
                 else:
                     self._send({"ok": False, "error": f"unknown op {op!r}"})
         except (ConnectionError, OSError):
@@ -133,6 +140,18 @@ class Broker(socketserver.ThreadingTCPServer):
                 delivered += 1
             else:
                 self.drop_subscriber(channel, h)
+                # Close the socket too: the peer must see EOF (so its SSE
+                # stream ends and the client reconnects) rather than keep
+                # polling a zombie subscription that will never deliver;
+                # it also unblocks the handler thread.
+                try:
+                    h.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    h.connection.close()
+                except OSError:
+                    pass
         return delivered
 
 
@@ -154,46 +173,73 @@ def _parse(url: str) -> Tuple[str, int]:
 
 class NetBus:
     """Bus client over a :class:`Broker` (interface-equal to
-    ``InMemoryBus``/``RedisBus`` in ``serve/bus.py``)."""
+    ``InMemoryBus``/``RedisBus`` in ``serve/bus.py``).
 
-    def __init__(self, url: str, timeout: float = 2.0) -> None:
+    ``ack_timeout`` covers the broker's worst-case fanout stall: each
+    stalled subscriber may cost up to ``_SEND_TIMEOUT_S`` before being
+    dropped, so publish acks can lag several seconds without the publish
+    having failed.
+    """
+
+    def __init__(self, url: str, timeout: float = 2.0,
+                 ack_timeout: float = 10.0) -> None:
         self._addr = _parse(url)
         self._timeout = timeout
+        self._ack_timeout = ack_timeout
         self._lock = threading.Lock()  # one command in flight on the conn
         self._conn: Optional[socket.socket] = None
         self._rfile = None
 
     def _connect(self):
         conn = socket.create_connection(self._addr, timeout=self._timeout)
+        conn.settimeout(self._ack_timeout)
         return conn, conn.makefile("rb")
 
-    def _command(self, obj: dict) -> dict:
+    def _reset(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+        self._conn = None
+        self._rfile = None
+
+    def _command(self, obj: dict, retry_after_ack_loss: bool) -> dict:
+        """One request/response on the cached connection.
+
+        Failure semantics: a SEND failure is always retried once (the
+        request never reached the broker — typically a stale keep-alive).
+        A failure while waiting for the ACK is retried only when
+        ``retry_after_ack_loss`` — a publish may already have fanned out,
+        and blindly re-sending would deliver the event twice to every
+        healthy subscriber.
+        """
         payload = json.dumps(obj).encode() + b"\n"
         with self._lock:
-            for attempt in (0, 1):  # reconnect once on a dead keep-alive
+            for attempt in (0, 1):
                 try:
                     if self._conn is None:
                         self._conn, self._rfile = self._connect()
                     self._conn.sendall(payload)
+                except (ConnectionError, OSError):
+                    self._reset()
+                    if attempt:
+                        raise
+                    continue  # send never landed: always safe to retry
+                try:
                     line = self._rfile.readline()
                     if not line:
                         raise ConnectionError("broker closed connection")
                     return json.loads(line)
                 except (ConnectionError, OSError, ValueError):
-                    if self._conn is not None:
-                        try:
-                            self._conn.close()
-                        except OSError:
-                            pass
-                    self._conn = None
-                    self._rfile = None
-                    if attempt:
+                    self._reset()
+                    if attempt or not retry_after_ack_loss:
                         raise
         raise ConnectionError("unreachable")  # pragma: no cover
 
     def publish(self, channel: str, data: dict) -> int:
         resp = self._command({"op": "publish", "channel": channel,
-                              "data": data})
+                              "data": data}, retry_after_ack_loss=False)
         return int(resp.get("receivers", 0))
 
     def subscribe(self, channel: str) -> "_NetSubscription":
@@ -209,7 +255,8 @@ class NetBus:
 
     def ping(self) -> bool:
         try:
-            return bool(self._command({"op": "ping"}).get("ok"))
+            return bool(self._command({"op": "ping"},
+                                      retry_after_ack_loss=True).get("ok"))
         except Exception:
             return False
 
@@ -232,6 +279,7 @@ class _NetSubscription:
         self._conn = conn
         self._conn.setblocking(False)
         self._buf = bytearray()
+        self.closed = False  # broker gone / dropped us — stream should end
 
     def _read_line(self, timeout: float) -> Optional[bytes]:
         deadline = time.monotonic() + max(timeout, 0.001)
@@ -239,10 +287,18 @@ class _NetSubscription:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return None
+            if self.closed:
+                # A dead socket selects readable forever (EOF): returning
+                # instantly would hot-spin the consumer's keepalive loop.
+                # Sleep out the budget instead; the consumer sees
+                # ``closed`` and ends the stream.
+                time.sleep(remaining)
+                return None
             try:
                 readable, _, _ = select.select([self._conn], [], [], remaining)
             except (OSError, ValueError):  # closed fd
-                return None
+                self.closed = True
+                continue
             if not readable:
                 return None
             try:
@@ -250,9 +306,11 @@ class _NetSubscription:
             except (BlockingIOError, InterruptedError):
                 continue
             except OSError:
-                return None
+                self.closed = True
+                continue
             if not chunk:  # peer closed
-                return None
+                self.closed = True
+                continue
             self._buf += chunk
         line, _, rest = bytes(self._buf).partition(b"\n")
         self._buf = bytearray(rest)

@@ -179,6 +179,34 @@ def test_sse_event_crosses_workers(cluster):
     assert got[0]["remaining_routes"] == [[121.05, 14.55], [121.06, 14.56]]
 
 
+def test_netbus_dead_subscription_reports_closed_without_spinning():
+    """When the broker side closes a subscription (death or slow-consumer
+    drop), the client must report ``closed`` and sleep out its poll
+    budget — NOT return instantly forever (which turned the SSE keepalive
+    loop into a 100%-CPU spin)."""
+    import socket as socket_mod
+
+    broker, _ = start_broker()
+    try:
+        bus = NetBus(f"tcp://127.0.0.1:{broker.port}")
+        sub = bus.subscribe("dead")
+        with broker._subs_lock:
+            handler = next(iter(broker._subs["dead"]))
+        handler.connection.shutdown(socket_mod.SHUT_RDWR)
+        handler.connection.close()
+        t0 = time.time()
+        assert sub.get(timeout=1.0) is None
+        assert time.time() - t0 >= 0.5, "dead subscription returned instantly"
+        assert sub.closed
+        # sse_stream ends rather than keepaliving a dead subscription
+        from routest_tpu.serve.bus import sse_stream
+
+        chunks = list(sse_stream(sub, keepalive_s=0.2))
+        assert chunks == []
+    finally:
+        broker.shutdown()
+
+
 def test_netbus_stalled_subscriber_cannot_block_channel():
     """A subscriber that never reads must be DROPPED once its TCP window
     fills (SO_SNDTIMEO), not allowed to block every publish on the
