@@ -364,6 +364,94 @@ refine_relocate_batch = jax.jit(
     jax.vmap(refine_relocate, in_axes=(0, 0, 0, 0, 0, 0)))
 
 
+@jax.jit
+def refine_swap(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
+                max_distance: jax.Array, order: jax.Array,
+                trip_ids: jax.Array) -> jax.Array:
+    """Cross-trip SWAP (exchange): trade one stop between two trips.
+
+    The move relocate cannot make: when BOTH trips are at capacity, no
+    single stop can move anywhere (inserting it overloads the target),
+    yet exchanging a misassigned pair is feasible — loads change by the
+    demand DIFFERENCE only. Swaps are restricted to pairs in different
+    trips (cross-trip is the gap being closed; same-trip resequencing
+    belongs to 2-opt, and cross-trip pairs share no tour edges so the
+    O(1) delta formulas are exact).
+
+    Fixed-shape like its siblings: all O(N²) exchange deltas per
+    iteration as gathers, best feasible improving swap applied as two
+    scatters (``trip_ids`` are positional and unchanged), loop to
+    fixpoint. Requires a symmetric distance matrix.
+
+    Returns the refined ``order``.
+    """
+    n = order.shape[0]
+    pos = jnp.arange(n)
+    demands = demands.astype(dist.dtype)
+    big = jnp.asarray(jnp.inf, dist.dtype)
+
+    def analyze(order):
+        active = order >= 0
+        nodes = jnp.where(active, order + 1, 0)
+        dem = jnp.where(active, demands[jnp.clip(order, 0)], 0.0)
+        same_prev = jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_),
+             (trip_ids[1:] == trip_ids[:-1]) & (trip_ids[1:] >= 0)])
+        prev = jnp.where(
+            same_prev,
+            jnp.concatenate([jnp.zeros((1,), nodes.dtype), nodes[:-1]]), 0)
+        same_next = jnp.concatenate(
+            [(trip_ids[:-1] == trip_ids[1:]) & (trip_ids[:-1] >= 0),
+             jnp.zeros((1,), jnp.bool_)])
+        nxt = jnp.where(
+            same_next,
+            jnp.concatenate([nodes[1:], jnp.zeros((1,), nodes.dtype)]), 0)
+
+        tid_oh = ((trip_ids[None, :] == pos[:, None]) & active[None, :])
+        loads = (tid_oh * dem[None, :]).sum(axis=1)
+        leg_in = jnp.where(active, dist[prev, nodes], 0.0)
+        ret = jnp.where(active & ~same_next, dist[nodes, 0], 0.0)
+        tripdist = (tid_oh * (leg_in + ret)[None, :]).sum(axis=1)
+
+        # replace_cost[i, j] = new edge cost at position i if node_j sat
+        # there; replace_cost[i, i]-diagonal is the current cost
+        rc = (dist[prev[:, None], nodes[None, :]]
+              + dist[nodes[None, :], nxt[:, None]])              # (N, N)
+        cur = dist[prev, nodes] + dist[nodes, nxt]               # (N,)
+        delta_at = rc - cur[:, None]         # [i, j]: put j's node at i
+        delta = delta_at + delta_at.T        # full swap of positions i, j
+
+        src = trip_ids[:, None]
+        tgt = trip_ids[None, :]
+        diff_trip = (src != tgt) & active[:, None] & active[None, :]
+        dd = dem[:, None] - dem[None, :]     # [i, j]: load change at j's trip
+        cap_ok = ((loads[jnp.clip(src, 0)] - dd <= capacity)
+                  & (loads[jnp.clip(tgt, 0)] + dd <= capacity))
+        dist_ok = ((tripdist[jnp.clip(src, 0)] + delta_at <= max_distance + 1e-3)
+                   & (tripdist[jnp.clip(tgt, 0)] + delta_at.T
+                      <= max_distance + 1e-3))
+        scored = jnp.where(diff_trip & cap_ok & dist_ok
+                           & (pos[:, None] < pos[None, :]), delta, big)
+        flat = jnp.argmin(scored.reshape(-1))
+        return scored.reshape(-1)[flat], flat // n, flat % n
+
+    def improving(state):
+        order, delta, i, j, it = state
+        return (delta < -1e-3) & (it < n * n)
+
+    def apply_swap(state):
+        order, _, i, j, it = state
+        oi, oj = order[i], order[j]
+        order = order.at[i].set(oj).at[j].set(oi)
+        delta2, i2, j2 = analyze(order)
+        return order, delta2, i2, j2, it + 1
+
+    d0, i0, j0 = analyze(order)
+    out = jax.lax.while_loop(
+        improving, apply_swap, (order, d0, i0, j0, jnp.zeros((), jnp.int32)))
+    return out[0]
+
+
 def trips_cost(dist: np.ndarray, trips) -> float:
     """Host-side total closed-tour distance of a trips-list (the
     ``solve_host`` output form): Σ over trips of origin → stops → origin.
@@ -403,10 +491,11 @@ def solve_host(dist: np.ndarray, demands: np.ndarray, capacity: float,
     """Host-friendly wrapper: numpy in, plain python out (trips as lists).
 
     ``refine=True`` alternates intra-trip 2-opt with cross-trip relocate
-    until neither improves (opt-in so the default keeps exact
-    reference-greedy observable semantics). The two moves compose:
-    relocate fixes greedy's trip assignment, 2-opt then re-sequences the
-    changed trips."""
+    and cross-trip swap until none improves (opt-in so the default keeps
+    exact reference-greedy observable semantics). The moves compose:
+    relocate fixes greedy's trip assignment, swap untangles pairs that
+    capacity blocks relocate from moving, 2-opt re-sequences the changed
+    trips."""
     dist_j = jnp.asarray(dist, jnp.float32)
     dem_j = jnp.asarray(demands, jnp.float32)
     cap_j = jnp.asarray(capacity, jnp.float32)
@@ -418,6 +507,8 @@ def solve_host(dist: np.ndarray, demands: np.ndarray, capacity: float,
         for _ in range(max_refine_rounds):
             order_j = refine_2opt(dist_j, order_j, trips_j)
             order_j, trips_j = refine_relocate(
+                dist_j, dem_j, cap_j, maxd_j, order_j, trips_j)
+            order_j = refine_swap(
                 dist_j, dem_j, cap_j, maxd_j, order_j, trips_j)
             new_cost = tour_cost(dist, np.asarray(order_j), np.asarray(trips_j))
             if new_cost >= cost - 1e-3:

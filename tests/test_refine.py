@@ -179,6 +179,58 @@ def test_relocate_beats_2opt_on_multitrip_instances(rng):
     assert wins >= 3, f"relocate never improved on 2-opt ({wins})"
 
 
+def test_swap_untangles_capacity_locked_trips():
+    """The move relocate PROVABLY cannot make: both trips at capacity 2,
+    stops misassigned across sides. x-line world: a=+10, b=+10.1,
+    c=-10, d=-10.1; greedy builds trip1=[a,c], trip2=[b,d] (each zig-zags
+    across the origin, ~80 total). No single stop can move (target trip
+    would overload), but swapping c<->b reaches the {a,b},{c,d} optimum
+    (~40.4)."""
+    x = np.asarray([0.0, 10.0, 10.1, -10.0, -10.1], np.float32)
+    dist = np.abs(x[:, None] - x[None, :])
+    demands = np.ones(4, np.float32)
+
+    base = solve_host(dist, demands, 2.0, 1e12, refine=False)
+    assert sorted(sorted(t) for t in base["trips"]) == [[0, 2], [1, 3]]
+    assert trips_cost(dist, base["trips"]) > 80.0
+
+    # relocate alone is stuck at capacity 2
+    from routest_tpu.optimize.vrp import refine_relocate, refine_swap
+
+    sol = greedy_vrp(jnp.asarray(dist), jnp.asarray(demands),
+                     jnp.asarray(2.0, jnp.float32),
+                     jnp.asarray(1e12, jnp.float32))
+    rel = refine_relocate(jnp.asarray(dist), jnp.asarray(demands),
+                          jnp.asarray(2.0, jnp.float32),
+                          jnp.asarray(1e12, jnp.float32),
+                          sol.order, sol.trip_ids)
+    assert np.asarray(rel.order).tolist() == np.asarray(sol.order).tolist()
+
+    # full refinement (with swap) reaches the optimum
+    ref = solve_host(dist, demands, 2.0, 1e12, refine=True)
+    assert trips_cost(dist, ref["trips"]) < 41.0
+    assert sorted(sorted(t) for t in ref["trips"]) == [[0, 1], [2, 3]]
+    for t in ref["trips"]:
+        assert demands[t].sum() <= 2.0
+
+
+def test_swap_feasibility_random_instances(rng):
+    """Random tight instances: full refinement (now incl. swap) preserves
+    the stop multiset, respects capacity, never worsens cost."""
+    for k in range(10):
+        n = 10
+        dist = _random_instance(rng, n)
+        demands = rng.integers(1, 4, n).astype(np.float32)
+        cap = 5.0
+        base = solve_host(dist, demands, cap, 1e12, refine=False)
+        ref = solve_host(dist, demands, cap, 1e12, refine=True)
+        assert sorted(base["optimized_order"]) == sorted(ref["optimized_order"])
+        for t in ref["trips"]:
+            assert demands[t].sum() <= cap
+        assert trips_cost(dist, ref["trips"]) <= \
+            trips_cost(dist, base["trips"]) + 1e-2
+
+
 def test_relocate_single_and_empty():
     from routest_tpu.optimize.vrp import refine_relocate
 
