@@ -1,2 +1,11 @@
-from routest_tpu.optimize.vrp import greedy_vrp, greedy_vrp_batch  # noqa: F401
+from routest_tpu.optimize.vrp import (  # noqa: F401
+    greedy_vrp,
+    greedy_vrp_batch,
+    refine_2opt,
+    refine_relocate,
+    refine_swap,
+    solve_host,
+    trips_cost,
+)
 from routest_tpu.optimize.engine import optimize_route  # noqa: F401
+from routest_tpu.optimize.ranking import rank_routes  # noqa: F401

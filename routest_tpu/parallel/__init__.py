@@ -12,13 +12,27 @@ O(S²) cost. This package scales that axis across the mesh:
   its queries' attention with a running (online) softmax;
 - :mod:`routest_tpu.parallel.ulysses` — all-to-all sequence parallelism:
   ``lax.all_to_all`` re-shards sequence↔heads so every device runs full
-  attention over a head shard.
+  attention over a head shard;
+- :mod:`routest_tpu.parallel.tensor` — Megatron column/row tensor
+  parallelism over the ``model`` mesh axis (forward, training, serving);
+- :mod:`routest_tpu.parallel.pipeline` — GPipe fill-drain pipeline
+  parallelism mapping model stages onto a ``stage`` mesh axis;
+- :mod:`routest_tpu.parallel.expert` — Switch-style expert parallelism
+  (capacity-bounded all_to_all MoE dispatch) over an ``expert`` axis.
 
-Both are pure shard_map programs — XLA emits the collectives over ICI;
+All are pure shard_map programs — XLA emits the collectives over ICI;
 gradients flow through them, so the same code paths train.
 """
 
+from routest_tpu.parallel.expert import (init_moe_params, make_moe_apply,
+                                         shard_moe_params)
+from routest_tpu.parallel.pipeline import (make_pipeline_apply,
+                                           make_pipeline_train_step,
+                                           microbatch, shard_stage_params,
+                                           stack_stage_params)
 from routest_tpu.parallel.ring import ring_attention, ring_attention_sharded
+from routest_tpu.parallel.tensor import (make_tp_apply, make_tp_train_step,
+                                         shard_tp_params)
 from routest_tpu.parallel.ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
@@ -26,4 +40,15 @@ __all__ = [
     "ring_attention_sharded",
     "ulysses_attention",
     "ulysses_attention_sharded",
+    "make_tp_apply",
+    "make_tp_train_step",
+    "shard_tp_params",
+    "make_pipeline_apply",
+    "make_pipeline_train_step",
+    "microbatch",
+    "stack_stage_params",
+    "shard_stage_params",
+    "init_moe_params",
+    "make_moe_apply",
+    "shard_moe_params",
 ]
