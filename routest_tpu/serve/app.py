@@ -232,6 +232,19 @@ def create_app(config: Optional[Config] = None,
                 "eta_completion_time_ml": [str(s) if ok else None
                                            for s, ok in zip(iso, finite)]}, 200
 
+    @app.route("/api/predict", methods=("POST",))
+    def predict_alias(request):
+        """The Laravel-proxy contract (BASELINE.json north star: "the
+        Laravel backend's predict endpoint proxies to a pjit-sharded JAX
+        inference server"): ONE endpoint a proxy can point at, accepting
+        either the single-row ``/api/predict_eta`` body or the batch
+        forms, dispatched on shape. ``request.get_data`` is cached by
+        werkzeug, so delegating re-parses safely."""
+        body = get_json(request) or {}
+        if "items" in body or isinstance(body.get("distance_m"), list):
+            return predict_eta_batch(request)
+        return predict_eta(request)
+
     # ── live tracking ──────────────────────────────────────────────────
 
     @app.route("/api/confirm_route", methods=("POST",))
@@ -387,6 +400,12 @@ def create_app(config: Optional[Config] = None,
     @app.route("/api/ping", methods=("GET",))
     def ping(request):
         return {"ok": True, "service": "route-optimizer"}, 200
+
+    @app.route("/up", methods=("GET",))
+    def up(request):
+        # Laravel's stock health endpoint (reference bootstrap/app.php:12):
+        # plain HTTP 200, no body contract beyond "the app is up".
+        return Response(b"OK", mimetype="text/html")
 
     @app.route("/api/metrics", methods=("GET",))
     def metrics(request):

@@ -112,14 +112,25 @@ class App:
             response.headers["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
 
 
+_JSON_MISSING = object()
+
+
 def get_json(request: Request, silent: bool = True) -> Optional[dict]:
-    """Parse the request body as JSON (mirrors flask's get_json(silent=True))."""
+    """Parse the request body as JSON (mirrors flask's get_json(silent=True)).
+
+    The parsed value is memoized on the request: dispatch aliases (e.g.
+    ``/api/predict`` peeking at the body shape before delegating) would
+    otherwise re-run ``json.loads`` over multi-MB batch payloads."""
+    cached = getattr(request, "_rtpu_json", _JSON_MISSING)
+    if cached is not _JSON_MISSING:
+        return cached
     try:
         raw = request.get_data(as_text=True)
-        if not raw:
-            return None
-        return json.loads(raw)
+        parsed = json.loads(raw) if raw else None
     except (ValueError, UnicodeDecodeError):
         if silent:
+            request._rtpu_json = None
             return None
         raise
+    request._rtpu_json = parsed
+    return parsed

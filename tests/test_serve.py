@@ -216,6 +216,31 @@ def test_tp_serving_falls_back_on_indivisible_widths(tmp_path):
     assert m is not None and np.isfinite(m)
 
 
+def test_laravel_up_endpoint(client):
+    r = client.get("/up")
+    assert r.status_code == 200
+
+
+def test_predict_proxy_alias_dispatches_on_shape(client):
+    """/api/predict (the Laravel-proxy contract) serves BOTH forms:
+    single-row predict_eta bodies and batch bodies, same answers as the
+    dedicated endpoints."""
+    single_body = {"summary": {"distance": 6983.0}, "driver_age": 40,
+                   "weather": "Stormy", "traffic": "Jam",
+                   "pickup_time": "2026-07-29T18:00:00"}
+    via_alias = client.post("/api/predict", json=single_body).get_json()
+    direct = client.post("/api/predict_eta", json=single_body).get_json()
+    assert abs(via_alias["eta_minutes_ml"] - direct["eta_minutes_ml"]) < 1e-9
+
+    batch_body = {"distance_m": [6983.0, 12000.0], "weather": "Stormy",
+                  "traffic": "Jam", "driver_age": 40,
+                  "pickup_time": "2026-07-29T18:00:00"}
+    via_alias = client.post("/api/predict", json=batch_body).get_json()
+    direct = client.post("/api/predict_eta_batch", json=batch_body).get_json()
+    assert via_alias == direct
+    assert via_alias["count"] == 2
+
+
 def test_predict_eta_model_unavailable(model_artifact):
     eta = EtaService(ServeConfig(), model_path="/nonexistent/model.msgpack")
     app = create_app(Config(), eta_service=eta)
