@@ -1,0 +1,298 @@
+"""Route-sequence transformer: leg-time prediction over whole routes,
+trained and served with sequence-parallel attention.
+
+The framework's long-context flagship consumer (SURVEY.md §5.7 — the
+reference's longest "sequence" is a polyline walked in Python lists,
+``Flaskr/utils.py:162-167``): a delivery route is a SEQUENCE of legs,
+and per-leg travel time depends on route context (rush-hour position,
+class mixture, where in the tour the leg sits), which is exactly
+attention's shape. This model makes ``parallel/ring.py`` and
+``parallel/ulysses.py`` load-bearing rather than demonstrative: the
+SAME parameters run under full attention (one device), ring attention,
+or Ulysses — sequence parallelism is a layout choice, not a model
+change, and gradients flow through the collectives so SP *trains*.
+
+Architecture (pre-LN encoder):
+
+- per-leg features = the road GNN's edge encoding
+  (``models/gnn.py:edge_feature_array`` — log-length, speed, class
+  one-hot, cyclical hour) + sinusoidal position encoding (positions are
+  passed in explicitly so sequence shards encode their GLOBAL offsets);
+- ``n_layers`` × [LN → multi-head self-attention → residual, LN → gelu
+  MLP → residual] with a pluggable attention implementation;
+- head: per-leg POSITIVE multiplier on free-flow physics time —
+  ``pred_s = freeflow_s · softplus(w·h + b)``. The physics supplies the
+  scale; the model learns the congestion structure, mirroring how the
+  ETA MLP decomposes pace × distance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from routest_tpu.core.smap import shard_map
+from routest_tpu.models.gnn import N_EDGE_FEATURES
+from routest_tpu.parallel.ring import full_attention, ring_attention
+from routest_tpu.parallel.ulysses import ulysses_attention
+
+Params = Dict
+
+
+def positional_encoding(positions: jax.Array, d_model: int) -> jax.Array:
+    """(S,) integer positions → (S, d_model) sinusoidal encoding."""
+    half = d_model // 2
+    freqs = jnp.exp(-jnp.arange(half) * (jnp.log(10000.0) / max(half - 1, 1)))
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteTransformer:
+    n_features: int = N_EDGE_FEATURES
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_mlp: int = 128
+
+    def init(self, key: jax.Array) -> Params:
+        d, dm = self.d_model, self.d_mlp
+
+        def dense(key, din, dout):
+            k1, key = jax.random.split(key)
+            return key, {"w": jax.random.normal(k1, (din, dout))
+                         / jnp.sqrt(din), "b": jnp.zeros((dout,))}
+
+        key, embed = dense(key, self.n_features, d)
+        layers = []
+        for _ in range(self.n_layers):
+            key, wq = dense(key, d, d)
+            key, wk = dense(key, d, d)
+            key, wv = dense(key, d, d)
+            key, wo = dense(key, d, d)
+            key, w1 = dense(key, d, dm)
+            key, w2 = dense(key, dm, d)
+            layers.append({
+                "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+                "q": wq, "k": wk, "v": wv, "o": wo,
+                "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+                "mlp1": w1, "mlp2": w2,
+            })
+        key, head = dense(key, d, 1)
+        return {"embed": embed, "layers": layers, "head": head}
+
+    @staticmethod
+    def _ln(p: Params, x: jax.Array) -> jax.Array:
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-6) * p["g"] + p["b"]
+
+    def apply(self, params: Params, feats: jax.Array, freeflow_s: jax.Array,
+              positions: jax.Array,
+              key_mask: Optional[jax.Array] = None,
+              attn_impl: Optional[Callable] = None) -> jax.Array:
+        """(B, S, F) features, (B, S) free-flow seconds, (S,) GLOBAL leg
+        positions → (B, S) predicted leg seconds.
+
+        ``attn_impl(q, k, v, key_mask=...)`` defaults to single-device
+        ``full_attention``; sequence-parallel callers pass the ring /
+        Ulysses per-device programs (see :func:`make_sp_apply`).
+        """
+        attn = attn_impl if attn_impl is not None else full_attention
+        b, s, _ = feats.shape
+        h = feats @ params["embed"]["w"] + params["embed"]["b"]
+        h = h + positional_encoding(positions, self.d_model)[None, :, :]
+        dh = self.d_model // self.n_heads
+        for layer in params["layers"]:
+            z = self._ln(layer["ln1"], h)
+
+            def proj(p, z=z):
+                return (z @ p["w"] + p["b"]).reshape(b, s, self.n_heads, dh)
+
+            out = attn(proj(layer["q"]), proj(layer["k"]), proj(layer["v"]),
+                       key_mask=key_mask)
+            h = h + out.reshape(b, s, self.d_model) @ layer["o"]["w"] \
+                + layer["o"]["b"]
+            z = self._ln(layer["ln2"], h)
+            h = h + jax.nn.gelu(
+                z @ layer["mlp1"]["w"] + layer["mlp1"]["b"]
+            ) @ layer["mlp2"]["w"] + layer["mlp2"]["b"]
+        mult = jax.nn.softplus(
+            (h @ params["head"]["w"] + params["head"]["b"])[..., 0] + 1.0)
+        return freeflow_s * mult
+
+    @staticmethod
+    def squared_residual(pred, targets, freeflow_s, mask,
+                         relative: bool = True) -> Tuple[jax.Array, jax.Array]:
+        """(masked Σ residual², valid count) — THE training objective,
+        shared by the dense loss and the sequence-parallel train step so
+        the two can never drift apart.
+
+        ``relative=True`` (the training default) measures the residual in
+        MULTIPLIER space, ``(pred − target)/freeflow`` — seconds² lets a
+        handful of long arterial legs dominate the objective and
+        conditions the landscape on leg length; the multiplier residual
+        is O(congestion), uniform across legs.
+        """
+        w = mask.astype(pred.dtype)
+        resid = pred - targets
+        if relative:
+            resid = resid / jnp.maximum(freeflow_s, 1.0)
+        return jnp.sum(w * resid ** 2), w.sum()
+
+    def loss(self, params: Params, feats, freeflow_s, positions, targets,
+             mask, attn_impl=None, relative: bool = True) -> jax.Array:
+        """Masked mean of :meth:`squared_residual` over valid legs
+        (seconds² with ``relative=False`` for evaluation)."""
+        pred = self.apply(params, feats, freeflow_s, positions,
+                          key_mask=mask, attn_impl=attn_impl)
+        sq, cnt = self.squared_residual(pred, targets, freeflow_s, mask,
+                                        relative)
+        return sq / jnp.maximum(cnt, 1.0)
+
+
+def make_sp_apply(model: RouteTransformer, mesh: Mesh,
+                  seq_axis: str = "seq", flavor: str = "ring"):
+    """jitted (params, feats, freeflow_s, mask) → (B, S) with the LEG
+    axis sharded over ``seq_axis`` — the sequence-parallel forward.
+
+    ``flavor``: "ring" (ppermute K/V rotation) or "ulysses" (all-to-all
+    seq↔head re-sharding; needs ``n_heads % axis_size == 0``).
+    """
+    n = mesh.shape[seq_axis]
+    per_device = {"ring": ring_attention, "ulysses": ulysses_attention}[flavor]
+    seq_spec = P(None, seq_axis)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), seq_spec, seq_spec, seq_spec), out_specs=seq_spec)
+    def run(params, feats, freeflow_s, mask):
+        s_local = feats.shape[1]
+        # GLOBAL positions: shard i encodes offsets i*s_local..(i+1)*s_local
+        positions = jax.lax.axis_index(seq_axis) * s_local \
+            + jnp.arange(s_local)
+        attn = functools.partial(per_device, axis_name=seq_axis, axis_size=n)
+        return model.apply(params, feats, freeflow_s, positions,
+                           key_mask=mask, attn_impl=attn)
+
+    return jax.jit(run)
+
+
+def make_sp_train_step(model: RouteTransformer, optimizer, mesh: Mesh,
+                       seq_axis: str = "seq", flavor: str = "ring"):
+    """jitted (params, opt_state, batch) → (params, opt_state, loss):
+    a SEQUENCE-PARALLEL training step — gradients flow backward through
+    the ring's ppermute hops (or Ulysses' all_to_alls), so no device
+    ever materializes the full attention matrix while training.
+    ``batch`` = (feats, freeflow_s, targets, mask), leg axis sharded.
+    """
+    import optax
+
+    n = mesh.shape[seq_axis]
+    per_device = {"ring": ring_attention, "ulysses": ulysses_attention}[flavor]
+    seq_spec = P(None, seq_axis)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), seq_spec, seq_spec, seq_spec, seq_spec),
+        out_specs=(P(), P()))
+    def loss_and_grads(params, feats, freeflow_s, targets, mask):
+        s_local = feats.shape[1]
+        positions = jax.lax.axis_index(seq_axis) * s_local \
+            + jnp.arange(s_local)
+        attn = functools.partial(per_device, axis_name=seq_axis, axis_size=n)
+
+        def local_sq(p):
+            pred = model.apply(p, feats, freeflow_s, positions,
+                               key_mask=mask, attn_impl=attn)
+            sq, _ = model.squared_residual(pred, targets, freeflow_s, mask)
+            return sq
+
+        sq_val, grads = jax.value_and_grad(local_sq)(params)
+        cnt = mask.astype(jnp.float32).sum()
+        total_sq = jax.lax.psum(sq_val, seq_axis)
+        total_cnt = jnp.maximum(jax.lax.psum(cnt, seq_axis), 1.0)
+        # global-mean loss: d(mean)/dp = psum(grads of the local SUM) / count
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, seq_axis) / total_cnt, grads)
+        return total_sq / total_cnt, grads
+
+    @jax.jit
+    def step(params, opt_state, feats, freeflow_s, targets, mask):
+        loss, grads = loss_and_grads(params, feats, freeflow_s, targets, mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+# ── training data: routes sampled from the road graph ────────────────────
+
+
+def sample_route_sequences(graph: Dict[str, np.ndarray], n_routes: int,
+                           seq_len: int, seed: int = 0,
+                           noise_sigma: float = 0.06) -> Tuple[np.ndarray, ...]:
+    """Random-walk routes over a road graph → padded training tensors.
+
+    Returns (feats (R, L, F), freeflow_s (R, L), targets (R, L),
+    mask (R, L)). One observation hour per ROUTE (a vehicle drives its
+    whole tour in one congestion regime); targets from the same
+    congestion overlay the GNN trains on (``data/road_graph.py``), so
+    the two learned leg-cost models are directly comparable.
+    """
+    from routest_tpu.data.road_graph import true_edge_time_s
+    from routest_tpu.models.gnn import edge_feature_array
+
+    rng = np.random.default_rng(seed)
+    senders = np.asarray(graph["senders"])
+    receivers = np.asarray(graph["receivers"])
+    n_nodes = len(graph["node_coords"])
+    # adjacency: out-edge ids per node
+    order = np.argsort(senders, kind="stable")
+    sorted_senders = senders[order]
+    starts = np.searchsorted(sorted_senders, np.arange(n_nodes))
+    ends = np.searchsorted(sorted_senders, np.arange(n_nodes), "right")
+
+    feats = np.zeros((n_routes, seq_len, N_EDGE_FEATURES), np.float32)
+    freeflow = np.zeros((n_routes, seq_len), np.float32)
+    targets = np.zeros((n_routes, seq_len), np.float32)
+    mask = np.zeros((n_routes, seq_len), np.float32)
+
+    length = np.asarray(graph["length_m"], np.float32)
+    speed = np.asarray(graph["speed_limit"], np.float32)
+    rclass = np.asarray(graph["road_class"], np.int32)
+
+    for r in range(n_routes):
+        hour = int(rng.integers(0, 24))
+        node = int(rng.integers(0, n_nodes))
+        n_legs = int(rng.integers(seq_len // 2, seq_len + 1))
+        edge_ids = []
+        for _ in range(n_legs):
+            lo, hi = starts[node], ends[node]
+            if hi <= lo:  # dead end: restart elsewhere
+                node = int(rng.integers(0, n_nodes))
+                lo, hi = starts[node], ends[node]
+                if hi <= lo:
+                    break
+            e = int(order[rng.integers(lo, hi)])
+            edge_ids.append(e)
+            node = int(receivers[e])
+        if not edge_ids:
+            continue
+        e_ids = np.asarray(edge_ids)
+        k = len(e_ids)
+        feats[r, :k] = edge_feature_array(
+            length[e_ids], speed[e_ids], rclass[e_ids], hour)
+        freeflow[r, :k] = length[e_ids] / np.maximum(speed[e_ids], 0.1) + 4.0
+        t_true = true_edge_time_s(length[e_ids], rclass[e_ids],
+                                  np.full(k, hour))
+        targets[r, :k] = t_true * rng.lognormal(0.0, noise_sigma, k)
+        mask[r, :k] = 1.0
+    return feats, freeflow, targets, mask
