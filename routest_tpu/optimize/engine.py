@@ -159,6 +159,12 @@ def optimize_route(input_data: dict) -> dict:
                             dtype=np.float32)
     except (KeyError, TypeError, ValueError):
         return {"error": "invalid coordinates: each point needs numeric lat/lon"}
+    # Validate top_k UP FRONT: the same malformed value must fail the
+    # same way on every path, before any matrix/solve work is spent.
+    try:
+        top_k = int(input_data.get("top_k", 0) or 0)
+    except (TypeError, ValueError):
+        return {"error": "top_k must be an integer"}
 
     # Leg provider: great-circle × road factor by default; with
     # {"road_graph": true} (additive ABI) legs become true shortest paths
@@ -243,6 +249,58 @@ def optimize_route(input_data: dict) -> dict:
     }
     if refine:
         feature["properties"]["refined"] = True
+
+    # Additive ABI: {"top_k": N} returns up to N ALTERNATIVE visit orders
+    # (BASELINE config 3 — top-k candidate-path ranking — on the request
+    # path, not just the bench). Candidates are scored on device over the
+    # distance matrix (perturbed-greedy pool + this solution as seed),
+    # then the winners are re-priced with the live leg provider — COST
+    # ONLY, no polyline construction — so alternative distances/durations
+    # are exactly comparable to the main summary without its geometry
+    # work. The shipped order itself is excluded (these are alternatives,
+    # not echoes). Single-trip solutions only: reordering within one trip
+    # keeps the load identical, so every alternative that fits
+    # maximum_distance is feasible by construction.
+    if top_k > 1 and sol["n_trips"] == 1 and len(destinations) >= 2:
+        from routest_tpu.optimize.ranking import rank_routes
+
+        price = legs.cost if use_road else leg_cost
+        # ask for extra candidates: the seed order + dedup eat slots
+        ranked = rank_routes(
+            dist, k=min(top_k, 10) + 2, speed_mps=speed,
+            max_candidates=2048,
+            greedy_order=np.asarray(sol["optimized_order"], np.int32))
+        main_key = tuple(int(i) for i in sol["optimized_order"])
+        seen = {main_key}
+        if not use_road:  # great-circle matrix is symmetric; a closed
+            seen.add(main_key[::-1])  # tour costs the same reversed
+        alternatives = []
+        for order_alt in ranked.orders:
+            if len(alternatives) >= min(top_k, 10):
+                break
+            key = tuple(int(i) for i in order_alt)
+            if key in seen:
+                continue
+            seen.add(key)
+            if not use_road:
+                # reversal twins waste slots ONLY when costs are
+                # symmetric — road graphs respect one-ways (directed)
+                seen.add(key[::-1])
+            seq = [0] + [int(i) + 1 for i in order_alt] + [0]
+            alt_m = alt_s = 0.0
+            for a, b in zip(seq[:-1], seq[1:]):
+                leg_m, leg_s = price(a, b)
+                alt_m += leg_m
+                alt_s += leg_s
+            if not math.isfinite(alt_m) or alt_m > max_dist:
+                continue
+            alternatives.append({
+                "optimized_order": [int(i) for i in order_alt],
+                "distance": round(alt_m, 1),
+                "duration": round(alt_s, 1),
+            })
+        feature["properties"]["alternatives"] = alternatives
+
     if use_road:
         feature["properties"]["road_graph"] = True
         # Which pricer produced the durations: "gnn" (learned per-edge

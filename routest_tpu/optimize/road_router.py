@@ -443,6 +443,34 @@ class RoadLegs:
             + snap_m[:, None] + snap_m[None, :]
         np.fill_diagonal(self.dist_m, 0.0)
         self._memo: Dict[Tuple[int, int], Tuple[float, float, list]] = {}
+        self._cost_memo: Dict[Tuple[int, int], Tuple[float, float]] = {}
+
+    def cost(self, i: int, j: int) -> Tuple[float, float]:
+        """(distance_m, duration_s) for leg i→j WITHOUT building its
+        polyline — the accessor for callers pricing many candidate
+        orders (e.g. top-k alternatives) where geometry is never
+        rendered. Shares the full-leg memo; a cost-only result is also
+        memoized so a later ``leg`` call only adds the geometry pass."""
+        if i == j:
+            return 0.0, 0.0
+        full = self._memo.get((i, j))
+        if full is not None:
+            return full[0], full[1]
+        cached = self._cost_memo.get((i, j))
+        if cached is not None:
+            return cached
+        node_seq = self._r._walk(self._pred[i], int(self._nodes[i]),
+                                 int(self._nodes[j]))
+        if not node_seq:
+            out = (float("inf"), float("inf"))
+        else:
+            dur = self._time_scale * (
+                float(sum(self._time_s[int(self._pred[i][b])]
+                          for b in node_seq[1:]))
+                + (self._snap_m[i] + self._snap_m[j]) / _SNAP_SPEED_MPS)
+            out = (float(self.dist_m[i, j]), float(dur))
+        self._cost_memo[(i, j)] = out
+        return out
 
     def leg(self, i: int, j: int) -> Tuple[float, float, List[List[float]]]:
         """(distance_m, duration_s, [[lon, lat], …]) for waypoint leg i→j."""

@@ -87,6 +87,84 @@ def test_informed_candidates_are_greedy_like(rng):
     assert d_inf.min() <= d_uni.min() + 1e-3
 
 
+def test_top_k_alternatives_on_request_path(rng):
+    """{"top_k": N} in the optimize payload surfaces config-3 ranking on
+    the serving ABI: alternatives are real visit orders, priced with the
+    same leg provider as the main summary, within max_distance."""
+    from routest_tpu.optimize.engine import optimize_route
+
+    pts = [{"lat": 14.58, "lon": 121.04}] + [
+        {"lat": 14.42 + 0.22 * float(rng.random()),
+         "lon": 120.96 + 0.15 * float(rng.random()), "payload": 1}
+        for _ in range(8)
+    ]
+    payload = {
+        "source_point": pts[0],
+        "destination_points": pts[1:],
+        "driver_details": {"driver_name": "t", "vehicle_type": "car",
+                           "vehicle_capacity": 9999,
+                           "maximum_distance": 10_000_000},
+        "top_k": 5,
+    }
+    out = optimize_route(dict(payload))
+    assert "error" not in out
+    alts = out["properties"]["alternatives"]
+    assert 1 <= len(alts) <= 5
+    n = len(pts) - 1
+    main_order = out["properties"]["optimized_order"]
+    for alt in alts:
+        assert sorted(alt["optimized_order"]) == list(range(n))
+        assert alt["distance"] > 0 and alt["duration"] > 0
+        # alternatives are ALTERNATIVES: never the shipped order (or its
+        # reversal — closed tours cost the same both ways on GC legs)
+        assert alt["optimized_order"] != main_order
+        assert alt["optimized_order"] != main_order[::-1]
+    # distinct orders throughout
+    keys = [tuple(a["optimized_order"]) for a in alts]
+    assert len(set(keys)) == len(keys)
+
+    # multi-trip solutions don't offer (possibly-infeasible) alternatives
+    tight = dict(payload)
+    tight["driver_details"] = {**payload["driver_details"],
+                               "vehicle_capacity": 3}
+    out2 = optimize_route(tight)
+    if out2["properties"]["summary"].get("trips", 1) > 1:
+        assert "alternatives" not in out2["properties"]
+
+    # bad type is a client error — on EVERY path, including 1 destination
+    assert "error" in optimize_route({**payload, "top_k": "many"})
+    single = {**payload, "destination_points": payload["destination_points"][:1],
+              "top_k": "many"}
+    assert "error" in optimize_route(single)
+
+
+def test_top_k_alternatives_over_road_graph(rng):
+    """Alternatives on the road-graph path price via the cost-only
+    accessor and must be consistent with full leg pricing."""
+    from routest_tpu.optimize.engine import optimize_route
+
+    pts = [{"lat": 14.5836, "lon": 121.0409}] + [
+        {"lat": 14.45 + 0.2 * float(rng.random()),
+         "lon": 120.97 + 0.13 * float(rng.random()), "payload": 1}
+        for _ in range(6)
+    ]
+    out = optimize_route({
+        "source_point": pts[0],
+        "destination_points": pts[1:],
+        "driver_details": {"driver_name": "t", "vehicle_type": "car",
+                           "vehicle_capacity": 9999,
+                           "maximum_distance": 10_000_000},
+        "road_graph": True,
+        "top_k": 3,
+    })
+    assert "error" not in out
+    alts = out["properties"]["alternatives"]
+    assert 1 <= len(alts) <= 3
+    for alt in alts:
+        assert np.isfinite(alt["distance"]) and np.isfinite(alt["duration"])
+        assert alt["duration"] > 0
+
+
 def test_ranked_scores_sorted(rng):
     dist = _random_dist(rng, 5)
     ranked = rank_routes(dist, k=10)
