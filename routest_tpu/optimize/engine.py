@@ -265,10 +265,15 @@ def optimize_route(input_data: dict) -> dict:
         from routest_tpu.optimize.ranking import rank_routes
 
         price = legs.cost if use_road else leg_cost
-        # ask for extra candidates: the seed order + dedup eat slots
+        k_want = min(top_k, 10)
+        # Over-request candidates: the seed order eats one slot, and on
+        # the symmetric great-circle path EVERY tour occupies two ranked
+        # slots (its reversal scores identically), so k+2 would
+        # under-fill the response — verified: 4 stops, top_k=5 returned
+        # only 3 of 11 distinct tours.
+        k_ask = (k_want + 2) if use_road else (2 * k_want + 2)
         ranked = rank_routes(
-            dist, k=min(top_k, 10) + 2, speed_mps=speed,
-            max_candidates=2048,
+            dist, k=k_ask, speed_mps=speed, max_candidates=2048,
             greedy_order=np.asarray(sol["optimized_order"], np.int32))
         main_key = tuple(int(i) for i in sol["optimized_order"])
         seen = {main_key}
@@ -276,7 +281,7 @@ def optimize_route(input_data: dict) -> dict:
             seen.add(main_key[::-1])  # tour costs the same reversed
         alternatives = []
         for order_alt in ranked.orders:
-            if len(alternatives) >= min(top_k, 10):
+            if len(alternatives) >= k_want:
                 break
             key = tuple(int(i) for i in order_alt)
             if key in seen:

@@ -445,32 +445,38 @@ class RoadLegs:
         self._memo: Dict[Tuple[int, int], Tuple[float, float, list]] = {}
         self._cost_memo: Dict[Tuple[int, int], Tuple[float, float]] = {}
 
-    def cost(self, i: int, j: int) -> Tuple[float, float]:
-        """(distance_m, duration_s) for leg i→j WITHOUT building its
-        polyline — the accessor for callers pricing many candidate
-        orders (e.g. top-k alternatives) where geometry is never
-        rendered. Shares the full-leg memo; a cost-only result is also
-        memoized so a later ``leg`` call only adds the geometry pass."""
-        if i == j:
-            return 0.0, 0.0
-        full = self._memo.get((i, j))
-        if full is not None:
-            return full[0], full[1]
+    def _walk_cost(self, i: int, j: int):
+        """Memoized shared core: (node_seq, distance_m, duration_s) for
+        leg i→j — ONE place owns the predecessor walk and the duration
+        formula so the cost-only and geometry accessors can never price
+        a leg differently. ``node_seq`` is [] when unreachable."""
         cached = self._cost_memo.get((i, j))
         if cached is not None:
             return cached
         node_seq = self._r._walk(self._pred[i], int(self._nodes[i]),
                                  int(self._nodes[j]))
         if not node_seq:
-            out = (float("inf"), float("inf"))
+            out = ([], float("inf"), float("inf"))
         else:
+            # pred[i][b] is by construction the edge that enters b here
             dur = self._time_scale * (
                 float(sum(self._time_s[int(self._pred[i][b])]
                           for b in node_seq[1:]))
                 + (self._snap_m[i] + self._snap_m[j]) / _SNAP_SPEED_MPS)
-            out = (float(self.dist_m[i, j]), float(dur))
+            out = (node_seq, float(self.dist_m[i, j]), float(dur))
         self._cost_memo[(i, j)] = out
         return out
+
+    def cost(self, i: int, j: int) -> Tuple[float, float]:
+        """(distance_m, duration_s) for leg i→j WITHOUT building its
+        polyline — for callers pricing many candidate orders (e.g.
+        top-k alternatives) where geometry is never rendered. A later
+        ``leg`` call reuses the memoized walk and only adds the
+        geometry pass."""
+        if i == j:
+            return 0.0, 0.0
+        _, dist_m, dur = self._walk_cost(i, j)
+        return dist_m, dur
 
     def leg(self, i: int, j: int) -> Tuple[float, float, List[List[float]]]:
         """(distance_m, duration_s, [[lon, lat], …]) for waypoint leg i→j."""
@@ -479,16 +485,10 @@ class RoadLegs:
         key = (i, j)
         if key in self._memo:
             return self._memo[key]
-        node_seq = self._r._walk(self._pred[i], int(self._nodes[i]),
-                                 int(self._nodes[j]))
+        node_seq, dist_m, dur = self._walk_cost(i, j)
         if not node_seq:
             out = (float("inf"), float("inf"), [])
         else:
-            # pred[i][b] is by construction the edge that enters b here
-            dur = self._time_scale * (
-                float(sum(self._time_s[int(self._pred[i][b])]
-                          for b in node_seq[1:]))
-                + (self._snap_m[i] + self._snap_m[j]) / _SNAP_SPEED_MPS)
             poly = [[float(self._r.coords[n, 1]), float(self._r.coords[n, 0])]
                     for n in node_seq]
             # endpoints: exact request coordinates, not snapped nodes
@@ -496,7 +496,7 @@ class RoadLegs:
             poly.append([float(self._points[j, 1]), float(self._points[j, 0])])
             # plain python floats: np.float32 would survive into the JSON
             # serializer (json.dumps rejects it)
-            out = (float(self.dist_m[i, j]), float(dur), poly)
+            out = (dist_m, dur, poly)
         self._memo[key] = out
         return out
 
