@@ -66,6 +66,12 @@ def child_main() -> None:
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
 
+    # Persistent XLA cache: repeat bench runs (and the driver's end-of-round
+    # run after a warm dev session) skip recompilation of the loop programs.
+    from routest_tpu.core.cache import enable_compile_cache
+
+    enable_compile_cache()
+
     import jax.numpy as jnp
     import numpy as np
 

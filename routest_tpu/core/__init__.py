@@ -1,3 +1,4 @@
+from routest_tpu.core.cache import enable_compile_cache  # noqa: F401
 from routest_tpu.core.config import Config, load_config  # noqa: F401
 from routest_tpu.core.dtypes import Policy  # noqa: F401
 from routest_tpu.core.mesh import MeshRuntime, pad_to_multiple  # noqa: F401

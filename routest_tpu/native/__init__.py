@@ -15,7 +15,6 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import tempfile
 import threading
 from typing import Optional
 
@@ -31,18 +30,18 @@ def _cache_dir() -> Optional[str]:
     """Per-user 0700 cache dir. The .so path must not be forgeable by
     another local user (a planted library would be dlopen'd into this
     process), so anything not owned by us / group- or world-writable is
-    rejected. ROUTEST_NATIVE_CACHE overrides (explicit operator choice)."""
+    rejected (shared policy: ``utils/paths.secure_user_cache_dir``).
+    ROUTEST_NATIVE_CACHE overrides (explicit operator choice)."""
     base = os.environ.get("ROUTEST_NATIVE_CACHE")
     if base:
-        os.makedirs(base, exist_ok=True)
+        try:
+            os.makedirs(base, exist_ok=True)
+        except OSError:
+            return None  # unusable override: fall back to numpy, not a crash
         return base
-    base = os.path.join(tempfile.gettempdir(),
-                        f"routest_tpu_native_{os.getuid()}")
-    os.makedirs(base, mode=0o700, exist_ok=True)
-    st = os.stat(base)
-    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
-        return None  # hijacked path: fall back to numpy rather than trust it
-    return base
+    from routest_tpu.utils.paths import secure_user_cache_dir
+
+    return secure_user_cache_dir("routest_tpu_native")
 
 
 def _build() -> Optional[str]:

@@ -45,6 +45,14 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    # Persistent XLA cache: server restarts skip the ~20-40 s first-compile
+    # cost of the serving buckets and the road solver (RTPU_COMPILE_CACHE=0
+    # opts out).
+    from routest_tpu.core.cache import enable_compile_cache
+
+    cache_dir = enable_compile_cache()
+    if cache_dir:
+        print(f"[serve] persistent compile cache at {cache_dir}")
     config = load_config()
     ensure_model(default_model_path(config.model))
     # Production serving shards the OD batch over every visible device

@@ -1,0 +1,77 @@
+"""Persistent XLA compilation cache (core/cache.py): resolution rules
+in-process, and the actual hit/miss behavior across process restarts via
+subprocesses (the cache config is process-global, so the round trip must
+not run inside the shared test interpreter)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from routest_tpu.core.cache import enable_compile_cache
+
+
+def test_disabled_by_env_flag():
+    for off in ("0", "off", "false", "no", "NONE", " disabled "):
+        assert enable_compile_cache(env={"RTPU_COMPILE_CACHE": off}) is None
+
+
+def test_explicit_path_wins_and_is_created(tmp_path):
+    target = str(tmp_path / "xla-cache")
+    got = enable_compile_cache(path=target,
+                               env={"RTPU_COMPILE_CACHE": "/elsewhere"})
+    assert got == target and os.path.isdir(target)
+    # A programmatic path wins even over an env opt-out.
+    assert enable_compile_cache(
+        path=target, env={"RTPU_COMPILE_CACHE": "0"}) == target
+
+
+def test_unusable_path_degrades_to_disabled(tmp_path):
+    planted = tmp_path / "planted"
+    planted.write_text("not a directory")
+    assert enable_compile_cache(
+        env={"RTPU_COMPILE_CACHE": str(planted)}) is None
+    nested = str(planted / "sub")  # mkdir under a file fails too
+    assert enable_compile_cache(env={"RTPU_COMPILE_CACHE": nested}) is None
+
+
+def test_env_path_used(tmp_path):
+    target = str(tmp_path / "from-env")
+    assert enable_compile_cache(env={"RTPU_COMPILE_CACHE": target}) == target
+
+
+_CHILD = textwrap.dedent("""
+    import os, sys, time
+    import jax, jax.numpy as jnp
+    jax.config.update("jax_platforms", "cpu")
+    from routest_tpu.core.cache import enable_compile_cache
+    assert enable_compile_cache() == sys.argv[1]
+    t0 = time.perf_counter()
+    out = jax.jit(lambda x: jnp.tanh(x @ x).sum())(jnp.ones((256, 256)))
+    out.block_until_ready()
+    print(f"compile_s={time.perf_counter() - t0:.4f}")
+""")
+
+
+def test_cache_persists_across_processes(tmp_path):
+    cache = str(tmp_path / "xla")
+    env = dict(os.environ, RTPU_COMPILE_CACHE=cache, JAX_PLATFORMS="cpu")
+
+    def run():
+        return subprocess.run([sys.executable, "-c", _CHILD, cache],
+                              env=env, capture_output=True, text=True,
+                              timeout=120)
+
+    first = run()
+    assert first.returncode == 0, first.stderr
+    entries = os.listdir(cache)
+    assert entries, "first run wrote no cache entries"
+    mtimes = {e: os.path.getmtime(os.path.join(cache, e)) for e in entries}
+
+    second = run()
+    assert second.returncode == 0, second.stderr
+    # The second process reused the entries rather than recompiling:
+    # nothing new for this program was written, nothing rewritten.
+    after = {e: os.path.getmtime(os.path.join(cache, e))
+             for e in os.listdir(cache)}
+    assert after == mtimes
