@@ -48,11 +48,38 @@ _INTERNAL_FEATURES = 4 + 4 + _N_WEEKDAYS + _N_HOURS + 3
 
 @dataclasses.dataclass(frozen=True)
 class EtaMLP:
-    """Configured model; ``init``/``apply`` are pure functions of params."""
+    """Configured model; ``init``/``apply`` are pure functions of params.
+
+    ``quantiles`` (empty by default = point model) turns the two heads
+    into 2·Q quantile heads: per quantile a (pace, overhead) pair, with
+    pace/overhead parameterized as a positive base plus cumulative
+    softplus increments across the quantile axis — so predicted ETA
+    quantiles are non-crossing *by construction*, not by regularization.
+    The reference's model family is a point regressor (``Flaskr/ml.py``);
+    calibrated uncertainty is an additive capability of this framework.
+    """
 
     hidden: Tuple[int, ...] = (256, 256, 128)
     n_features: int = N_FEATURES
     policy: Policy = DEFAULT_POLICY
+    quantiles: Tuple[float, ...] = ()
+
+    def __post_init__(self) -> None:
+        q = self.quantiles
+        if q:
+            if list(q) != sorted(q) or len(set(q)) != len(q):
+                raise ValueError(f"quantiles must be strictly increasing: {q}")
+            if not all(0.0 < v < 1.0 for v in q):
+                raise ValueError(f"quantiles must lie in (0, 1): {q}")
+            if 0.5 not in q:
+                # apply() serves the median as THE eta (the reference ABI
+                # is a single number); a head set without it has no
+                # defensible point estimate.
+                raise ValueError(f"quantiles must include 0.5: {q}")
+
+    @property
+    def n_heads(self) -> int:
+        return 2 * max(1, len(self.quantiles))
 
     @classmethod
     def from_config(cls, cfg, policy: Policy = DEFAULT_POLICY) -> "EtaMLP":
@@ -62,7 +89,8 @@ class EtaMLP:
     def init(self, key: jax.Array,
              norm_mean: Optional[np.ndarray] = None,
              norm_std: Optional[np.ndarray] = None) -> Params:
-        dims = (_INTERNAL_FEATURES,) + tuple(self.hidden) + (2,)  # pace, overhead
+        # point model: (pace, overhead); quantile model: Q pairs
+        dims = (_INTERNAL_FEATURES,) + tuple(self.hidden) + (self.n_heads,)
         params: Params = {"layers": []}
         for d_in, d_out in zip(dims[:-1], dims[1:]):
             key, sub = jax.random.split(key)
@@ -108,8 +136,8 @@ class EtaMLP:
         )
         return feats, dist_km
 
-    def apply(self, params: Params, x: jax.Array) -> jax.Array:
-        """(B, 12) ABI features → (B,) ETA minutes. bf16 trunk, f32 out."""
+    def _trunk(self, params: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Shared forward: raw head outputs (B, n_heads) f32 + distance."""
         feats, dist_km = self._expand(params, x)
         h = feats.astype(self.policy.compute_dtype)
         layers = params["layers"]
@@ -121,10 +149,38 @@ class EtaMLP:
         out = h @ last["w"].astype(self.policy.compute_dtype) + last["b"].astype(
             self.policy.compute_dtype
         )
-        out = out.astype(self.policy.output_dtype)
+        return (out.astype(self.policy.output_dtype),
+                dist_km.astype(self.policy.output_dtype))
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        """(B, 12) ABI features → (B,) ETA minutes. bf16 trunk, f32 out.
+
+        For a quantile model this is the median head — the reference ABI's
+        single number (``Flaskr/ml.py:53``)."""
+        if self.quantiles:
+            q50 = self.quantiles.index(0.5)
+            return self.apply_quantiles(params, x)[..., q50]
+        out, dist_km = self._trunk(params, x)
         pace = jax.nn.softplus(out[..., 0])       # min/km, positive
         overhead = jax.nn.softplus(out[..., 1])   # min, positive
-        return pace * dist_km.astype(self.policy.output_dtype) + overhead
+        return pace * dist_km + overhead
+
+    def apply_quantiles(self, params: Params, x: jax.Array) -> jax.Array:
+        """(B, 12) → (B, Q) ETA minutes per quantile, non-crossing.
+
+        pace/overhead for quantile 0 are softplus-positive; each later
+        quantile adds a softplus-positive increment (cumulative sum), so
+        ``eta[:, i] <= eta[:, i+1]`` holds for every input and parameter
+        setting — crossing quantiles are unrepresentable.
+        """
+        if not self.quantiles:
+            raise ValueError("apply_quantiles on a point model; "
+                             "construct EtaMLP(quantiles=...)")
+        n_q = len(self.quantiles)
+        out, dist_km = self._trunk(params, x)
+        pace = jnp.cumsum(jax.nn.softplus(out[..., :n_q]), axis=-1)
+        overhead = jnp.cumsum(jax.nn.softplus(out[..., n_q:]), axis=-1)
+        return pace * dist_km[..., None] + overhead
 
 
 def fit_normalizer(features: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

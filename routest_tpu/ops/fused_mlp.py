@@ -96,6 +96,13 @@ def pack_eta_params(model, params) -> Packed:
     ``-mean/std · row``. All dims pad up to multiples of 128 (MXU tiles);
     padding rows/cols are zero so they are exact no-ops through gelu.
     """
+    if getattr(model, "quantiles", ()):
+        # The kernel's epilogue is the 2-head point model (pace·d +
+        # overhead from heads 0/1); for quantile models those heads are
+        # the q0/q1 pace increments — refuse rather than mis-serve
+        # (EtaService catches this and keeps the XLA path).
+        raise ValueError("fused kernel supports point models only; "
+                         "quantile models serve the XLA path")
     layers = params["layers"]
     norm = params["norm"]
     mean = np.asarray(norm["mean"], np.float32)

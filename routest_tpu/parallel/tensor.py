@@ -78,6 +78,14 @@ def tp_param_specs(model: EtaMLP, data_axis: str = "data",
 
 
 def _validate(model: EtaMLP, tp: int) -> None:
+    if getattr(model, "quantiles", ()):
+        # The epilogue below hard-codes heads 0/1 as (pace, overhead); a
+        # quantile model's heads 0/1 are the q0/q1 pace increments —
+        # running it would be silently wrong, so refuse for every caller
+        # (EtaService catches this and serves the replicated XLA path).
+        raise ValueError(
+            "tensor-parallel apply/loss implement the 2-head point "
+            "epilogue; quantile models are not supported")
     dims = tuple(model.hidden) + (2,)
     modes = _layer_modes(len(dims))
     for i, (mode, d_out) in enumerate(zip(modes, dims)):

@@ -100,7 +100,7 @@ def create_app(config: Optional[Config] = None,
             props = result.setdefault("properties", {}) or {}
             summary = props.get("summary", {}) or {}
             ctx = payload.get("context") or {}
-            eta_min, eta_iso = state.eta.predict_eta_minutes(
+            eta_min, eta_iso, eta_bands = state.eta.predict_eta_quantiles(
                 weather=ctx.get("weather", "Sunny"),
                 traffic=ctx.get("traffic", "Low"),
                 distance_m=float(summary.get("distance") or 0),
@@ -111,6 +111,10 @@ def create_app(config: Optional[Config] = None,
             if eta_min is not None:
                 props["eta_minutes_ml"] = eta_min
                 props["eta_completion_time_ml"] = eta_iso
+                # Additive: calibrated uncertainty band when the serving
+                # model has quantile heads (point models add nothing).
+                for level, val in eta_bands.items():
+                    props[f"eta_minutes_ml_{level}"] = round(val, 4)
 
         # Best-effort persistence: failures are logged, never fatal
         # (``Flaskr/routes.py:118-125``).
@@ -131,7 +135,7 @@ def create_app(config: Optional[Config] = None,
     def predict_eta(request):
         body = get_json(request) or {}
         summary = body.get("summary") or {}
-        eta_min, eta_iso = state.eta.predict_eta_minutes(
+        eta_min, eta_iso, eta_bands = state.eta.predict_eta_quantiles(
             weather=body.get("weather", "Sunny"),
             traffic=body.get("traffic", "Low"),
             distance_m=float(summary.get("distance") or 0),
@@ -140,7 +144,10 @@ def create_app(config: Optional[Config] = None,
         )
         if eta_min is None:
             return {"error": "model unavailable"}, 503
-        return {"eta_minutes_ml": eta_min, "eta_completion_time_ml": eta_iso}, 200
+        out = {"eta_minutes_ml": eta_min, "eta_completion_time_ml": eta_iso}
+        for level, val in eta_bands.items():  # additive uncertainty band
+            out[f"eta_minutes_ml_{level}"] = round(val, 4)
+        return out, 200
 
     @app.route("/api/predict_eta_batch", methods=("POST",))
     def predict_eta_batch(request):
@@ -212,9 +219,9 @@ def create_app(config: Optional[Config] = None,
             # AttributeError: non-dict items / summary ("items": ["foo"])
             return {"error": f"malformed batch: {e}"}, 400
         try:
-            minutes, iso = state.eta.predict_eta_batch(
+            minutes, iso, bands = state.eta.predict_eta_batch(
                 weather=weather, traffic=traffic, distance_m=distance,
-                pickup_time=pickup, driver_age=age)
+                pickup_time=pickup, driver_age=age, return_quantiles=True)
         except Exception as e:
             _log.error("predict_batch_failed", error=str(e))
             minutes = None
@@ -226,11 +233,18 @@ def create_app(config: Optional[Config] = None,
         # invalid JSON; its timestamp is NaT) — the batch-shaped analog
         # of the single-row (None, None) contract.
         finite = [math.isfinite(m) for m in minutes]
-        return {"count": len(distance),
-                "eta_minutes_ml": [round(float(m), 4) if ok else None
-                                   for m, ok in zip(minutes, finite)],
-                "eta_completion_time_ml": [str(s) if ok else None
-                                           for s, ok in zip(iso, finite)]}, 200
+        out = {"count": len(distance),
+               "eta_minutes_ml": [round(float(m), 4) if ok else None
+                                  for m, ok in zip(minutes, finite)],
+               "eta_completion_time_ml": [str(s) if ok else None
+                                          for s, ok in zip(iso, finite)]}
+        for level, vals in bands.items():  # additive uncertainty columns
+            # null where the MEDIAN row is null, and also where the band
+            # value itself is non-finite (NaN/Inf are invalid JSON).
+            out[f"eta_minutes_ml_{level}"] = [
+                round(float(v), 4) if ok and math.isfinite(v) else None
+                for v, ok in zip(vals, finite)]
+        return out, 200
 
     @app.route("/api/predict", methods=("POST",))
     def predict_alias(request):

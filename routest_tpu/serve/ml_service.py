@@ -178,7 +178,12 @@ class EtaService:
 
         native.available()
         if self.available:
-            apply_jit = jax.jit(self._model.apply)
+            # Quantile models score ALL heads per row — (B, Q) through the
+            # batcher — so one device call serves both the median (the
+            # reference ABI's single eta) and the uncertainty band.
+            forward = (self._model.apply_quantiles if self.quantiles
+                       else self._model.apply)
+            apply_jit = jax.jit(forward)
             # load_model returns host numpy arrays; pin them on device once
             # or every scoring call re-uploads the whole param tree.
             if runtime is not None:
@@ -354,6 +359,14 @@ class EtaService:
         return self._model is not None
 
     @property
+    def quantiles(self) -> Tuple[float, ...]:
+        """Quantile levels the serving model predicts; () for point models
+        (including the GBDT path)."""
+        if self._model is None:
+            return ()
+        return tuple(getattr(self._model, "quantiles", ()) or ())
+
+    @property
     def load_error(self) -> Optional[str]:
         return self._error
 
@@ -399,17 +412,56 @@ class EtaService:
             preds = self.predict_batch(rows)
         except Exception:
             return None, None
-        if preds is None or not np.isfinite(preds[0]):
+        if preds is None:
             return None, None
-        eta_minutes = float(preds[0])
-        eta_ts = (pickup_dt + dt.timedelta(minutes=eta_minutes)).isoformat()
-        return eta_minutes, eta_ts
+        row = np.atleast_1d(preds[0])
+        q = self.quantiles
+        # Finiteness policy (shared with predict_eta_quantiles): the row
+        # is servable iff its MEDIAN is finite — a degenerate tail head
+        # must not turn a servable point estimate into "model
+        # unavailable".
+        median = float(row[q.index(0.5)] if q else row[0])
+        if not np.isfinite(median):
+            return None, None
+        eta_ts = (pickup_dt + dt.timedelta(minutes=median)).isoformat()
+        return median, eta_ts
+
+    def predict_eta_quantiles(
+        self, *, weather: str, traffic: str, distance_m: float,
+        pickup_time, driver_age: float = 30.0,
+    ) -> Tuple[Optional[float], Optional[str], dict]:
+        """Single prediction plus the uncertainty band: (eta_median,
+        completion_iso, {"p10": …, "p90": …}). The dict is empty for
+        point models — callers add response fields only when the serving
+        model actually calibrates them."""
+        if not self.quantiles:
+            eta, iso = self.predict_eta_minutes(
+                weather=weather, traffic=traffic, distance_m=distance_m,
+                pickup_time=pickup_time, driver_age=driver_age)
+            return eta, iso, {}
+        try:
+            minutes, iso, bands = self.predict_eta_batch(
+                weather=[weather], traffic=[traffic], distance_m=[distance_m],
+                pickup_time=pickup_time, driver_age=[driver_age],
+                return_quantiles=True)
+        except Exception:
+            # Same degrade-gracefully contract as predict_eta_minutes: a
+            # scoring failure is (None, None), never an exception — the
+            # route response must still be served without ML fields.
+            return None, None, {}
+        if minutes is None or not np.isfinite(minutes[0]):
+            return None, None, {}
+        # Non-finite band entries are dropped, not serialized: the point
+        # estimate stands on its own (NaN/Inf would also be invalid JSON).
+        return (float(minutes[0]), str(iso[0]),
+                {k: float(v[0]) for k, v in bands.items()
+                 if np.isfinite(v[0])})
 
     def predict_eta_batch(
         self, *, weather: Sequence[str], traffic: Sequence[str],
         distance_m: Sequence[float], pickup_time,
-        driver_age: Sequence[float],
-    ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        driver_age: Sequence[float], return_quantiles: bool = False,
+    ):
         """Batched scoring: N OD pairs → (minutes (N,), completion ISO (N,)).
 
         The serving-side half of the 10k preds/sec north star
@@ -418,9 +470,14 @@ class EtaService:
         OD batch straight into the device batcher. ``pickup_time`` may be
         a single ISO string (shared by the batch) or a sequence of N.
         Returns (None, None) when no model is serving.
+
+        With ``return_quantiles=True`` a third element is returned: a
+        dict of per-level minute arrays (``{"p10": (N,), "p90": (N,)}``),
+        empty for point models. Minutes are always the median for
+        quantile models.
         """
         if not self.available:
-            return None, None
+            return (None, None, {}) if return_quantiles else (None, None)
         n = len(distance_m)
         if isinstance(pickup_time, (str, dt.datetime)) or pickup_time is None:
             pickup_time = [pickup_time] * n
@@ -451,14 +508,23 @@ class EtaService:
         )
         preds = self.predict_batch(rows)
         if preds is None:
-            return None, None
-        minutes = np.asarray(preds, np.float64)
+            return (None, None, {}) if return_quantiles else (None, None)
+        preds = np.asarray(preds, np.float64)
+        q = self.quantiles
+        bands: dict = {}
+        if q:
+            minutes = preds[:, q.index(0.5)]
+            if return_quantiles:
+                bands = {f"p{round(level * 100)}": preds[:, i]
+                         for i, level in enumerate(q) if level != 0.5}
+        else:
+            minutes = preds
         # Vectorized completion stamps: datetime64 arithmetic beats a
         # per-row datetime+timedelta loop ~50x at batch sizes that matter.
         base = np.asarray([np.datetime64(p, "ms") for p in pickups])
         completion = base + (minutes * 60_000.0).astype("timedelta64[ms]")
         iso = np.datetime_as_string(completion, unit="s")
-        return minutes, iso
+        return (minutes, iso, bands) if return_quantiles else (minutes, iso)
 
     @property
     def stats(self) -> dict:

@@ -26,22 +26,27 @@ from routest_tpu.models.eta_mlp import EtaMLP, Params
 
 MAGIC = b"RTPU1\n"
 ARTIFACT_VERSION = 2
+QUANTILE_ARTIFACT_VERSION = 3
 
 
 def save_model(path: str, model: EtaMLP, params: Params) -> None:
     """Serving artifact: MAGIC + json header line + msgpack params."""
-    header = json.dumps(
-        {
-            "format": "routest_tpu.eta_mlp",
-            # v2: internal one-hot expansion + [pace, overhead] heads
-            # (first layer is 42-wide, output is 2-wide). v1 artifacts
-            # (12-wide input, 1 head) are incompatible and rejected on load.
-            "version": ARTIFACT_VERSION,
-            "hidden": list(model.hidden),
-            "n_features": model.n_features,
-            "compute_dtype": np.dtype(model.policy.compute_dtype).name,
-        }
-    ).encode() + b"\n"
+    header_dict = {
+        "format": "routest_tpu.eta_mlp",
+        # v2: internal one-hot expansion + [pace, overhead] heads
+        # (first layer is 42-wide, output is 2-wide). v1 artifacts
+        # (12-wide input, 1 head) are incompatible and rejected on load.
+        # v3 = v2 + quantile heads (output 2·Q-wide); point models keep
+        # writing v2 so older builds load them unchanged.
+        "version": ARTIFACT_VERSION,
+        "hidden": list(model.hidden),
+        "n_features": model.n_features,
+        "compute_dtype": np.dtype(model.policy.compute_dtype).name,
+    }
+    if model.quantiles:
+        header_dict["version"] = QUANTILE_ARTIFACT_VERSION
+        header_dict["quantiles"] = list(model.quantiles)
+    header = json.dumps(header_dict).encode() + b"\n"
     host_params = jax.tree_util.tree_map(np.asarray, params)
     blob = serialization.msgpack_serialize(host_params)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -61,11 +66,16 @@ def load_model(path: str) -> Tuple[EtaMLP, Params]:
     if header.get("format") != "routest_tpu.eta_mlp":
         raise ValueError(f"{path}: unknown artifact format {header.get('format')}")
     version = header.get("version")
-    if version != ARTIFACT_VERSION:
+    if version not in (ARTIFACT_VERSION, QUANTILE_ARTIFACT_VERSION):
         raise ValueError(
             f"{path}: artifact version {version} is incompatible with this "
-            f"build (expects v{ARTIFACT_VERSION}); retrain via scripts/train_eta.py"
+            f"build (expects v{ARTIFACT_VERSION}/v{QUANTILE_ARTIFACT_VERSION}); "
+            f"retrain via scripts/train_eta.py"
         )
+    quantiles = tuple(header.get("quantiles", ()))
+    if version == QUANTILE_ARTIFACT_VERSION and not quantiles:
+        raise ValueError(f"{path}: v{QUANTILE_ARTIFACT_VERSION} artifact "
+                         f"missing its quantiles header")
     import jax.numpy as jnp
 
     from routest_tpu.core.dtypes import DEFAULT_POLICY
@@ -74,7 +84,7 @@ def load_model(path: str) -> Tuple[EtaMLP, Params]:
     compute = header.get("compute_dtype", "bfloat16")
     policy = _dc.replace(DEFAULT_POLICY, compute_dtype=jnp.dtype(compute).type)
     model = EtaMLP(hidden=tuple(header["hidden"]), n_features=header["n_features"],
-                   policy=policy)
+                   policy=policy, quantiles=quantiles)
     params = serialization.msgpack_restore(blob)
     params = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
     return model, params

@@ -64,10 +64,19 @@ def make_optimizer(cfg: TrainConfig, total_steps: int = 1000) -> optax.GradientT
 
 
 def loss_fn(model: EtaMLP, params: Params, batch: Batch) -> jax.Array:
-    pred = model.apply(params, batch.features)
-    # Huber on minutes: robust to the log-normal noise tail.
-    per_row = optax.huber_loss(pred, batch.targets, delta=10.0)
     denom = jnp.maximum(batch.weights.sum(), 1.0)
+    if getattr(model, "quantiles", ()):
+        # Pinball (quantile) loss, averaged over the head axis: the unique
+        # proper scoring rule whose minimizer is the target quantile, so
+        # calibration is a property of convergence, not a regularizer.
+        pred = model.apply_quantiles(params, batch.features)   # (B, Q)
+        q = jnp.asarray(model.quantiles, pred.dtype)
+        err = batch.targets[:, None] - pred
+        per_row = jnp.maximum(q * err, (q - 1.0) * err).mean(axis=-1)
+    else:
+        pred = model.apply(params, batch.features)
+        # Huber on minutes: robust to the log-normal noise tail.
+        per_row = optax.huber_loss(pred, batch.targets, delta=10.0)
     return (per_row * batch.weights).sum() / denom
 
 

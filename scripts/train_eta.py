@@ -35,6 +35,10 @@ def main() -> None:
                              "synthetic generator")
     parser.add_argument("--quick", action="store_true",
                         help="small run for smoke testing")
+    parser.add_argument("--quantiles", type=str, default=None,
+                        help="comma-separated quantile levels (must include "
+                             "0.5), e.g. 0.1,0.5,0.9 — trains calibrated "
+                             "uncertainty heads with pinball loss")
     args = parser.parse_args()
     if args.quick:
         args.n, args.epochs = 50_000, 8
@@ -67,8 +71,11 @@ def main() -> None:
           f"single-row={baseline['single_row_preds_per_sec']:.0f}/s  "
           f"bulk={baseline['bulk_preds_per_sec']:.0f}/s → {path}")
 
-    print(f"[3/4] JAX MLP: epochs={args.epochs}")
-    model = EtaMLP()
+    quantiles = (tuple(float(v) for v in args.quantiles.split(","))
+                 if args.quantiles else ())
+    print(f"[3/4] JAX MLP: epochs={args.epochs}"
+          + (f" quantiles={list(quantiles)}" if quantiles else ""))
+    model = EtaMLP(quantiles=quantiles)
     t0 = time.time()
     result = fit(model, train, ev, TrainConfig(epochs=args.epochs, seed=args.seed),
                  log_every=max(1, args.epochs // 5))

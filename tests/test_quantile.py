@@ -1,0 +1,238 @@
+"""Quantile ETA heads (models/eta_mlp.py quantiles=...): non-crossing by
+construction, pinball training calibrates coverage on synthetic data, the
+v3 artifact round-trips, and the serving surface exposes the band as
+additive fields. The reference's model family is a point regressor
+(``Flaskr/ml.py``) — this capability is additive."""
+
+import jax
+import numpy as np
+import pytest
+
+from routest_tpu.core.config import TrainConfig
+from routest_tpu.core.dtypes import F32_POLICY
+from routest_tpu.data.features import batch_from_mapping
+from routest_tpu.data.synthetic import generate_dataset, train_eval_split
+from routest_tpu.models.eta_mlp import EtaMLP, fit_normalizer
+
+Q = (0.1, 0.5, 0.9)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="strictly increasing"):
+        EtaMLP(quantiles=(0.9, 0.1, 0.5))
+    with pytest.raises(ValueError, match="include 0.5"):
+        EtaMLP(quantiles=(0.1, 0.9))
+    with pytest.raises(ValueError, match="lie in"):
+        EtaMLP(quantiles=(0.0, 0.5, 0.9))
+    with pytest.raises(ValueError):
+        EtaMLP(quantiles=(0.1, 0.1, 0.5))
+
+
+def test_noncrossing_for_random_params_and_median_is_apply():
+    model = EtaMLP(hidden=(16,), policy=F32_POLICY, quantiles=Q)
+    x = batch_from_mapping(generate_dataset(256, seed=3))
+    for seed in range(3):
+        params = model.init(jax.random.PRNGKey(seed))
+        preds = np.asarray(model.apply_quantiles(params, x))
+        assert preds.shape == (256, 3)
+        # monotone across the quantile axis for EVERY row, untrained —
+        # the cumulative-softplus parameterization, not luck
+        assert (np.diff(preds, axis=1) >= 0).all()
+        np.testing.assert_array_equal(
+            np.asarray(model.apply(params, x)), preds[:, 1])
+
+
+def test_point_model_rejects_apply_quantiles():
+    model = EtaMLP(hidden=(8,), policy=F32_POLICY)
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="point model"):
+        model.apply_quantiles(params, np.zeros((1, 12), np.float32))
+
+
+@pytest.fixture(scope="module")
+def trained():
+    from routest_tpu.train.loop import fit
+
+    train, ev = train_eval_split(generate_dataset(40_000, seed=7))
+    model = EtaMLP(hidden=(64, 32), policy=F32_POLICY, quantiles=Q)
+    result = fit(model, train, ev, TrainConfig(epochs=12, batch_size=4096))
+    return model, result, ev
+
+
+def test_pinball_training_calibrates_coverage(trained):
+    model, result, ev = trained
+    assert result.train_losses[-1] < result.train_losses[0] * 0.7
+    x = batch_from_mapping(ev)
+    y = np.asarray(ev["eta_minutes"], np.float32)
+    preds = np.asarray(model.apply_quantiles(result.state.params, x))
+    cover_p10 = float((y <= preds[:, 0]).mean())
+    cover_p90 = float((y <= preds[:, 2]).mean())
+    # The synthetic generator's noise is heteroscedastic; calibration
+    # can't be exact on a 12-epoch run — bound it meaningfully instead:
+    # each tail within ±6 points of its nominal level, and the band is
+    # a real band (median strictly between the tails on average).
+    assert 0.04 <= cover_p10 <= 0.16, cover_p10
+    assert 0.84 <= cover_p90 <= 0.96, cover_p90
+    assert (preds[:, 2] - preds[:, 0]).mean() > 1.0  # non-degenerate width
+    # median head tracks the point target on eval data
+    assert result.eval_rmse < float(np.std(y)) * 0.6
+
+
+def test_artifact_roundtrip_and_v2_still_loads(trained, tmp_path):
+    from routest_tpu.train.checkpoint import load_model, save_model
+
+    model, result, ev = trained
+    path = str(tmp_path / "q.msgpack")
+    save_model(path, model, result.state.params)
+    loaded_model, loaded_params = load_model(path)
+    assert loaded_model.quantiles == Q
+    x = batch_from_mapping(ev)[:64]
+    np.testing.assert_allclose(
+        np.asarray(loaded_model.apply_quantiles(loaded_params, x)),
+        np.asarray(model.apply_quantiles(result.state.params, x)),
+        rtol=1e-6)
+    # point models keep writing the v2 header (forward compat unbroken)
+    pm = EtaMLP(hidden=(8,), policy=F32_POLICY)
+    pp = pm.init(jax.random.PRNGKey(0))
+    p2 = str(tmp_path / "p.msgpack")
+    save_model(p2, pm, pp)
+    import json
+
+    with open(p2, "rb") as f:
+        f.readline()
+        header = json.loads(f.readline())
+    assert header["version"] == 2 and "quantiles" not in header
+
+
+def test_serving_exposes_uncertainty_band(trained, tmp_path):
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config, ServeConfig
+    from routest_tpu.serve.app import create_app
+    from routest_tpu.serve.ml_service import EtaService
+    from routest_tpu.train.checkpoint import save_model
+
+    model, result, _ = trained
+    path = str(tmp_path / "serve.msgpack")
+    save_model(path, model, result.state.params)
+    svc = EtaService(ServeConfig(), model_path=path)
+    assert svc.quantiles == Q
+    client = Client(create_app(Config(), eta_service=svc))
+
+    body = {"summary": {"distance": 12_000}, "weather": "Stormy",
+            "traffic": "Jam", "driver_age": 45}
+    r = client.post("/api/predict_eta", json=body)
+    assert r.status_code == 200
+    out = r.get_json()
+    assert out["eta_minutes_ml_p10"] <= out["eta_minutes_ml"] \
+        <= out["eta_minutes_ml_p90"]
+
+    rb = client.post("/api/predict_eta_batch", json={
+        "distance_m": [12_000, 3_000], "weather": "Sunny", "traffic": "Low"})
+    assert rb.status_code == 200
+    outb = rb.get_json()
+    assert len(outb["eta_minutes_ml_p10"]) == 2
+    for lo, mid, hi in zip(outb["eta_minutes_ml_p10"],
+                           outb["eta_minutes_ml"],
+                           outb["eta_minutes_ml_p90"]):
+        assert lo <= mid <= hi
+    # row parity between the two endpoints
+    assert abs(outb["eta_minutes_ml_p10"][1]
+               - client.post("/api/predict_eta", json={
+                   "summary": {"distance": 3_000}}).get_json()
+               .get("eta_minutes_ml_p10")) < 1e-3
+
+
+def test_tp_and_fused_helpers_refuse_quantile_models(mesh_runtime):
+    # The TP shard_map epilogue and the Pallas pack hard-code heads 0/1
+    # as (pace, overhead); for a quantile model those are q0/q1 pace
+    # increments — the shared helpers must refuse for EVERY caller, not
+    # rely on EtaService remembering to check.
+    from routest_tpu.ops.fused_mlp import pack_eta_params
+    from routest_tpu.parallel.tensor import make_tp_apply
+
+    model = EtaMLP(hidden=(16, 8), policy=F32_POLICY, quantiles=Q)
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="quantile"):
+        make_tp_apply(model, mesh_runtime.mesh)
+    with pytest.raises(ValueError, match="quantile"):
+        pack_eta_params(model, params)
+
+
+def test_scoring_failure_degrades_not_raises(trained, tmp_path):
+    # predict_eta_quantiles must keep the (None, None) degrade contract
+    # when the device dies mid-flight — /api/optimize_route serves the
+    # route without ML fields instead of 500ing.
+    from routest_tpu.core.config import ServeConfig
+    from routest_tpu.serve.ml_service import EtaService
+    from routest_tpu.train.checkpoint import save_model
+
+    model, result, _ = trained
+    path = str(tmp_path / "dead.msgpack")
+    save_model(path, model, result.state.params)
+    svc = EtaService(ServeConfig(), model_path=path)
+
+    def dead(rows):
+        raise RuntimeError("device lost")
+
+    svc._batcher._score = dead
+    eta, iso, bands = svc.predict_eta_quantiles(
+        weather="Sunny", traffic="Low", distance_m=1000.0,
+        pickup_time=None)
+    assert (eta, iso, bands) == (None, None, {})
+
+
+def test_nonfinite_band_values_drop_to_null(trained, tmp_path):
+    # A degenerate tail head (inf p90, finite median) must not leak
+    # NaN/Inf into JSON: the point estimate serves, the band drops.
+    from routest_tpu.core.config import ServeConfig
+    from routest_tpu.serve.ml_service import EtaService
+    from routest_tpu.train.checkpoint import save_model
+
+    model, result, _ = trained
+    path = str(tmp_path / "band.msgpack")
+    save_model(path, model, result.state.params)
+    svc = EtaService(ServeConfig(), model_path=path)
+    real = svc._batcher._score
+
+    def poisoned(rows):
+        out = np.asarray(real(rows)).copy()
+        out[:, 2] = np.inf  # p90 head blows up, median stays finite
+        return out
+
+    svc._batcher._score = poisoned
+    eta, iso, bands = svc.predict_eta_quantiles(
+        weather="Sunny", traffic="Low", distance_m=1000.0, pickup_time=None)
+    assert eta is not None and np.isfinite(eta)
+    assert "p90" not in bands and "p10" in bands
+    minutes, iso_b, bands_b = svc.predict_eta_batch(
+        weather=["Sunny"], traffic=["Low"], distance_m=[1000.0],
+        pickup_time=None, driver_age=[30.0], return_quantiles=True)
+    assert np.isfinite(minutes[0])
+    assert not np.isfinite(bands_b["p90"][0])  # service returns raw …
+    # … and the endpoint is where it becomes null:
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config
+    from routest_tpu.serve.app import create_app
+
+    client = Client(create_app(Config(), eta_service=svc))
+    out = client.post("/api/predict_eta_batch",
+                      json={"distance_m": [1000.0]}).get_json()
+    assert out["eta_minutes_ml"][0] is not None
+    assert out["eta_minutes_ml_p90"] == [None]
+    assert out["eta_minutes_ml_p10"][0] is not None
+
+
+def test_point_model_serving_adds_no_band_fields():
+    # The default in-repo artifact is a point model: responses must stay
+    # byte-compatible with the reference ABI (no surprise keys).
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config
+    from routest_tpu.serve.app import create_app
+
+    client = Client(create_app(Config()))
+    r = client.post("/api/predict_eta", json={"summary": {"distance": 5000}})
+    assert r.status_code == 200
+    assert set(r.get_json()) == {"eta_minutes_ml", "eta_completion_time_ml"}
