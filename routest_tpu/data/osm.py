@@ -160,6 +160,51 @@ def load_osm(path: str) -> Dict[str, np.ndarray]:
     }
 
 
+# road class → representative highway tag (inverse of _HIGHWAY_CLASS for
+# the writer; load_osm maps these back to the same class).
+_CLASS_HIGHWAY = {0: "primary", 1: "secondary", 2: "residential"}
+
+
+def save_osm(path: str, graph: Dict[str, np.ndarray]) -> None:
+    """Inverse of :func:`load_osm`: write a road-graph dict as an OSM XML
+    extract (gzipped when ``path`` ends in ``.gz``).
+
+    Every directed edge becomes a two-node ``oneway`` way carrying its
+    class (highway tag) and speed (maxspeed, km/h), so topology, classes
+    and speed limits round-trip exactly. Lengths do NOT: ``load_osm``
+    recomputes pure haversine from coordinates, while generated graphs
+    carry a street-detour factor in ``length_m`` — a property of their
+    lengths, not their geometry. Used to exercise the real-extract
+    ingest path at metro scale without shipping a real (licensed) city
+    extract.
+    """
+    coords = np.asarray(graph["node_coords"], np.float64)
+    senders = np.asarray(graph["senders"])
+    receivers = np.asarray(graph["receivers"])
+    road_class = np.asarray(graph["road_class"])
+    speed = np.asarray(graph["speed_limit"], np.float64)
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wt", encoding="utf-8") as f:
+        f.write('<?xml version="1.0" encoding="UTF-8"?>\n')
+        f.write('<osm version="0.6" generator="routest_tpu.data.osm">\n')
+        for i, (lat, lon) in enumerate(coords):
+            f.write(f'  <node id="{i + 1}" lat="{lat:.7f}" '
+                    f'lon="{lon:.7f}"/>\n')
+        for e in range(len(senders)):
+            highway = _CLASS_HIGHWAY[int(road_class[e])]
+            kmh = speed[e] * 3.6
+            f.write(
+                f'  <way id="{len(coords) + e + 1}">\n'
+                f'    <nd ref="{int(senders[e]) + 1}"/>\n'
+                f'    <nd ref="{int(receivers[e]) + 1}"/>\n'
+                f'    <tag k="highway" v="{highway}"/>\n'
+                f'    <tag k="maxspeed" v="{kmh:.8g}"/>\n'
+                f'    <tag k="oneway" v="yes"/>\n'
+                f'  </way>\n')
+        f.write("</osm>\n")
+
+
 def _ingest_way(way_nodes, way_tags, segments) -> None:
     highway = way_tags.get("highway")
     cls = _HIGHWAY_CLASS.get(highway) if highway else None

@@ -30,6 +30,29 @@ from routest_tpu.models.eta_mlp import EtaMLP, Params
 from routest_tpu.train.checkpoint import default_model_path, load_model
 
 
+def _parse_pickup_single(pickup_time) -> dt.datetime:
+    """Single-row pickup parsing (reference semantics, ``Flaskr/ml.py``):
+    ISO string → datetime (offset preserved), datetime passes through,
+    anything else → now. Both single-row entry points share this so the
+    completion timestamp keeps the caller's offset regardless of which
+    model family serves."""
+    if isinstance(pickup_time, str):
+        try:
+            return dt.datetime.fromisoformat(pickup_time)
+        except ValueError:
+            return dt.datetime.now()
+    if isinstance(pickup_time, dt.datetime):
+        return pickup_time
+    return dt.datetime.now()
+
+
+def _band_label(level: float) -> str:
+    """Quantile level → response-field suffix: 0.1 → "p10", 0.975 →
+    "p97.5" — exact and collision-free where percent-rounding would fold
+    0.015 and 0.025 into the same key."""
+    return f"p{level * 100:.10g}"
+
+
 class _Pending:
     __slots__ = ("rows", "event", "result", "error")
 
@@ -392,15 +415,7 @@ class EtaService:
         returns (eta_minutes, completion_iso) or (None, None)."""
         if not self.available:
             return None, None
-        if isinstance(pickup_time, str):
-            try:
-                pickup_dt = dt.datetime.fromisoformat(pickup_time)
-            except ValueError:
-                pickup_dt = dt.datetime.now()
-        elif isinstance(pickup_time, dt.datetime):
-            pickup_dt = pickup_time
-        else:
-            pickup_dt = dt.datetime.now()
+        pickup_dt = _parse_pickup_single(pickup_time)
 
         rows = encode_requests(
             weather=[weather], traffic=[traffic],
@@ -439,10 +454,11 @@ class EtaService:
                 weather=weather, traffic=traffic, distance_m=distance_m,
                 pickup_time=pickup_time, driver_age=driver_age)
             return eta, iso, {}
+        pickup_dt = _parse_pickup_single(pickup_time)
         try:
-            minutes, iso, bands = self.predict_eta_batch(
+            minutes, _iso, bands = self.predict_eta_batch(
                 weather=[weather], traffic=[traffic], distance_m=[distance_m],
-                pickup_time=pickup_time, driver_age=[driver_age],
+                pickup_time=pickup_dt, driver_age=[driver_age],
                 return_quantiles=True)
         except Exception:
             # Same degrade-gracefully contract as predict_eta_minutes: a
@@ -451,9 +467,15 @@ class EtaService:
             return None, None, {}
         if minutes is None or not np.isfinite(minutes[0]):
             return None, None, {}
+        # Completion stamp via the SINGLE-ROW formula, not the batch
+        # path's datetime64 string: the response format (sub-second
+        # precision, preserved UTC offset) must not change just because
+        # the serving artifact gained quantile heads.
+        eta_minutes = float(minutes[0])
+        iso = (pickup_dt + dt.timedelta(minutes=eta_minutes)).isoformat()
         # Non-finite band entries are dropped, not serialized: the point
         # estimate stands on its own (NaN/Inf would also be invalid JSON).
-        return (float(minutes[0]), str(iso[0]),
+        return (eta_minutes, iso,
                 {k: float(v[0]) for k, v in bands.items()
                  if np.isfinite(v[0])})
 
@@ -515,7 +537,7 @@ class EtaService:
         if q:
             minutes = preds[:, q.index(0.5)]
             if return_quantiles:
-                bands = {f"p{round(level * 100)}": preds[:, i]
+                bands = {_band_label(level): preds[:, i]
                          for i, level in enumerate(q) if level != 0.5}
         else:
             minutes = preds

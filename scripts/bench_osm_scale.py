@@ -1,0 +1,117 @@
+"""Metro-scale OSM ingest + routing benchmark → artifacts/osm_scale.json.
+
+The OSM path (``data/osm.py`` → ``RoadRouter``) was proven on an
+18-node fixture; this script proves it at city scale without shipping a
+licensed extract: generate a metro-sized street network, WRITE it as
+OSM XML (``save_osm``), then ingest it back through the exact parser a
+real extract would use and route over it. Reported: parse time, router
+build time, cold/warm 16-waypoint solve — the numbers that decide
+whether a deploy can point ``ROAD_GRAPH_OSM`` at a city.
+
+Usage: python scripts/bench_osm_scale.py [--nodes 8192] [--cpu]
+(…then ``python scripts/train_gnn.py --osm <written path>`` trains the
+learned leg costs on the same extract.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=8192)
+    parser.add_argument("--waypoints", type=int, default=16)
+    parser.add_argument("--keep", metavar="PATH", default=None,
+                        help="also write the generated extract here "
+                             "(e.g. to feed train_gnn --osm)")
+    parser.add_argument("--cpu", action="store_true")
+    args = parser.parse_args()
+    if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import numpy as np
+
+    from routest_tpu.core.cache import enable_compile_cache
+    from routest_tpu.data.osm import load_osm, save_osm
+    from routest_tpu.data.road_graph import generate_road_graph
+    from routest_tpu.optimize.road_router import RoadRouter
+
+    enable_compile_cache()
+    backend = jax.default_backend()
+    print(f"[1/4] generating {args.nodes}-node street network…")
+    graph = generate_road_graph(n_nodes=args.nodes, seed=0)
+
+    path = args.keep or os.path.join(tempfile.gettempdir(),
+                                     f"metro_{args.nodes}.osm.gz")
+    t0 = time.time()
+    save_osm(path, graph)
+    write_s = time.time() - t0
+    size_mb = os.path.getsize(path) / 1e6
+    print(f"      extract → {path} ({size_mb:.1f} MB, {write_s:.1f}s)")
+
+    print("[2/4] ingesting through the OSM parser…")
+    t0 = time.time()
+    loaded = load_osm(path)
+    parse_s = time.time() - t0
+    n_edges = len(loaded["senders"])
+    print(f"      {len(loaded['node_coords'])} nodes / {n_edges} edges "
+          f"in {parse_s:.1f}s")
+
+    print("[3/4] building router (bridging + device upload)…")
+    t0 = time.time()
+    router = RoadRouter(graph=loaded, use_gnn=False)
+    build_s = time.time() - t0
+
+    print(f"[4/4] {args.waypoints}-waypoint solves on {backend}…")
+    rng = np.random.default_rng(0)
+    lat = rng.uniform(14.40, 14.80, args.waypoints)
+    lon = rng.uniform(120.90, 121.15, args.waypoints)
+    pts = np.stack([lat, lon], axis=1).astype(np.float32)
+    t0 = time.time()
+    legs = router.route_legs(pts)
+    cold_ms = (time.time() - t0) * 1000
+    t0 = time.time()
+    legs = router.route_legs(pts + 1e-3)
+    warm_ms = (time.time() - t0) * 1000
+    finite = float(np.isfinite(legs.dist_m).mean())
+    print(f"      cold {cold_ms:.0f} ms, warm {warm_ms:.0f} ms, "
+          f"matrix finite {finite:.2f}")
+
+    report = {
+        "backend": backend,
+        "nodes": int(router.n_nodes),
+        "edges": int(len(router.senders)),
+        "extract_mb": round(size_mb, 2),
+        "write_s": round(write_s, 2),
+        "parse_s": round(parse_s, 2),
+        "router_build_s": round(build_s, 2),
+        "waypoints": args.waypoints,
+        "solve_cold_ms": round(cold_ms, 1),
+        "solve_warm_ms": round(warm_ms, 1),
+        "matrix_finite_frac": finite,
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = os.path.join(repo, "artifacts", "osm_scale.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"      report → {out}")
+    sys.exit(0 if finite == 1.0 else 1)
+
+
+if __name__ == "__main__":
+    main()

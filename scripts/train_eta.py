@@ -86,17 +86,35 @@ def main() -> None:
     save_model(model_path, model, result.state.params)
     print(f"      artifact → {model_path}")
 
-    print("[4/4] acceptance: TPU RMSE ≤ CPU baseline RMSE × 1.02")
-    ok = result.eval_rmse <= baseline["rmse_minutes"] * 1.02
+    # Pinball-trained medians minimize absolute error, not squared error;
+    # on skewed heteroscedastic targets the conditional median carries a
+    # systematic RMSE penalty vs the squared-error-trained baseline, so
+    # quantile runs get headroom (1.10) where point runs must match (1.02).
+    margin = 1.10 if quantiles else 1.02
+    print(f"[4/4] acceptance: TPU RMSE ≤ CPU baseline RMSE × {margin}")
+    ok = result.eval_rmse <= baseline["rmse_minutes"] * margin
     report = {
         "n": args.n,
         "epochs": args.epochs,
         "cpu_baseline_rmse_minutes": baseline["rmse_minutes"],
         "mlp_rmse_minutes": result.eval_rmse,
         "rmse_ratio": result.eval_rmse / baseline["rmse_minutes"],
+        "rmse_margin": margin,
         "mlp_fit_seconds": fit_s,
         "passed": bool(ok),
     }
+    if quantiles:
+        from routest_tpu.data.features import batch_from_mapping
+
+        x = batch_from_mapping(ev)
+        y = np.asarray(ev["eta_minutes"], np.float32)
+        preds = np.asarray(
+            model.apply_quantiles(result.state.params, x))
+        report["quantiles"] = list(quantiles)
+        report["coverage"] = {
+            f"{q:g}": float((y <= preds[:, i]).mean())
+            for i, q in enumerate(quantiles)}
+        print(f"      coverage: {report['coverage']}")
     report_path = os.path.join(os.path.dirname(path), "training_report.json")
     with open(report_path, "w") as f:
         json.dump(report, f, indent=2)

@@ -157,3 +157,48 @@ def test_malformed_and_empty_inputs(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         load_osm(str(tmp_path / "missing.osm"))
+
+
+def test_save_osm_roundtrip(tmp_path):
+    # Writer → parser round trip: topology, classes, and speeds are
+    # preserved exactly; lengths are recomputed as pure haversine (the
+    # generator's detour factor lives in its length_m, not geometry).
+    from routest_tpu.data.osm import save_osm
+    from routest_tpu.data.road_graph import generate_road_graph, haversine_np
+
+    graph = generate_road_graph(n_nodes=128, seed=5)
+    path = str(tmp_path / "roundtrip.osm.gz")
+    save_osm(path, graph)
+    back = load_osm(path)
+
+    assert back["node_coords"].shape == graph["node_coords"].shape
+    np.testing.assert_allclose(back["node_coords"], graph["node_coords"],
+                               atol=1e-6)
+    # edge multiset identical (load order may differ)
+    def key(g):
+        return sorted(zip(g["senders"].tolist(), g["receivers"].tolist(),
+                          g["road_class"].tolist(),
+                          np.round(g["speed_limit"], 3).tolist()))
+
+    assert key(back) == key(graph)
+    want = haversine_np(
+        back["node_coords"][back["senders"], 0],
+        back["node_coords"][back["senders"], 1],
+        back["node_coords"][back["receivers"], 0],
+        back["node_coords"][back["receivers"], 1])
+    np.testing.assert_allclose(back["length_m"], want, rtol=1e-5)
+
+
+def test_saved_extract_routes(tmp_path):
+    # The written extract must be directly consumable by the router.
+    from routest_tpu.data.osm import save_osm
+    from routest_tpu.data.road_graph import generate_road_graph
+    from routest_tpu.optimize.road_router import RoadRouter
+
+    path = str(tmp_path / "mini.osm")
+    save_osm(path, generate_road_graph(n_nodes=96, seed=2))
+    router = RoadRouter(graph=load_osm(path), use_gnn=False)
+    pts = np.asarray([[14.58, 121.04], [14.55, 121.06]], np.float32)
+    legs = router.route_legs(pts)
+    d, dur, poly = legs.leg(0, 1)
+    assert np.isfinite(d) and d > 0 and dur > 0 and len(poly) >= 3
