@@ -201,6 +201,33 @@ class EtaService:
 
         native.available()
         if self.available:
+            from routest_tpu.train.checkpoint import ExportedServingModel
+
+            if isinstance(self._model, ExportedServingModel):
+                # AOT export: the traced program IS the artifact (weights
+                # baked in as constants) — call it directly; no params to
+                # place, nothing to jit. Single-logical-device by
+                # construction, so a mesh runtime cannot shard it.
+                exported = self._model
+                from routest_tpu.utils.logging import get_logger
+
+                if runtime is not None:
+                    get_logger("routest_tpu.serve").warning(
+                        "aot_serving_unsharded",
+                        reason="StableHLO exports are single-logical-"
+                               "device programs; mesh runtime ignored")
+                if os.environ.get("ROUTEST_FUSED") == "1":
+                    get_logger("routest_tpu.serve").warning(
+                        "fused_kernel_ignored",
+                        reason="AOT exports run their serialized program "
+                               "as-is; ROUTEST_FUSED has no effect")
+
+                def aot_score(x: np.ndarray) -> np.ndarray:
+                    return exported(np.asarray(x, np.float32))
+
+                self.kernel = "stablehlo_aot"
+                self._finish_init(aot_score, align=1)
+                return
             # Quantile models score ALL heads per row — (B, Q) through the
             # batcher — so one device call serves both the median (the
             # reference ABI's single eta) and the uncertainty band.
@@ -231,30 +258,37 @@ class EtaService:
                     return apply_jit(params, x)
 
                 score = self._maybe_fused_score(score)
-            self._score = score
-            self._batcher = DynamicBatcher(
-                score, cfg.batch_buckets, cfg.max_batch, cfg.max_wait_ms,
-                align=runtime.n_data if runtime is not None else 1,
-            )
-            # Self-check: an artifact can deserialize fine yet be unusable
-            # (e.g. stale layer shapes). Run one dummy row now so breakage
-            # surfaces in health as model:degraded instead of per-request
-            # 503s with health claiming ok.
-            try:
-                probe = np.zeros((1, self._model.n_features), np.float32)
-                if not np.isfinite(self._batcher.submit(probe)).all():
-                    raise ValueError("self-check produced non-finite output")
-            except Exception as e:
-                self._error = f"model self-check failed: {type(e).__name__}: {e}"
-                self._model = None
-                self._params = None
-                self._batcher = None
-                self.kernel = "xla"  # nothing is serving; don't claim fused
-                # drop the score closure too — it captures the device-pinned
-                # param tree and would hold device memory forever
-                self._score = None
-            else:
-                self._warm_buckets()
+            self._finish_init(
+                score, align=runtime.n_data if runtime is not None else 1)
+
+    def _finish_init(self, score, align: int) -> None:
+        """Shared serving bring-up: batcher, one-row self-check, bucket
+        warmup. Used by the jit/TP/fused paths and the AOT-export path."""
+        cfg = self._cfg
+        self._score = score
+        self._batcher = DynamicBatcher(
+            score, cfg.batch_buckets, cfg.max_batch, cfg.max_wait_ms,
+            align=align,
+        )
+        # Self-check: an artifact can deserialize fine yet be unusable
+        # (e.g. stale layer shapes). Run one dummy row now so breakage
+        # surfaces in health as model:degraded instead of per-request
+        # 503s with health claiming ok.
+        try:
+            probe = np.zeros((1, self._model.n_features), np.float32)
+            if not np.isfinite(self._batcher.submit(probe)).all():
+                raise ValueError("self-check produced non-finite output")
+        except Exception as e:
+            self._error = f"model self-check failed: {type(e).__name__}: {e}"
+            self._model = None
+            self._params = None
+            self._batcher = None
+            self.kernel = "xla"  # nothing is serving; don't claim fused
+            # drop the score closure too — it captures the device-pinned
+            # param tree and would hold device memory forever
+            self._score = None
+        else:
+            self._warm_buckets()
 
     def _warm_buckets(self) -> None:
         """Compile EVERY batch bucket at startup.
@@ -360,6 +394,23 @@ class EtaService:
             return fallback
 
     def _load(self, path: str) -> None:
+        # AOT export? Sniff the magic so a .stablehlo artifact gets a
+        # real error from ITS loader instead of "not a msgpack artifact".
+        try:
+            from routest_tpu.train.checkpoint import (EXPORT_MAGIC,
+                                                      load_exported_serving_fn)
+
+            with open(path, "rb") as f:
+                is_export = f.read(len(EXPORT_MAGIC)) == EXPORT_MAGIC
+            if is_export:
+                self._model = load_exported_serving_fn(path)
+                self._params = None  # weights are constants in the program
+                return
+        except FileNotFoundError:
+            pass  # fall through: load_model reports the missing path
+        except Exception as e:
+            self._error = f"{type(e).__name__}: {e}"
+            return
         try:
             self._model, self._params = load_model(path)
             return

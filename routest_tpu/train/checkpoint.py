@@ -29,6 +29,29 @@ ARTIFACT_VERSION = 2
 QUANTILE_ARTIFACT_VERSION = 3
 
 
+def _read_artifact(path: str, magic: bytes, fmt: str, versions,
+                   kind: str, retrain_hint: str):
+    """Shared artifact reader: magic prefix + one-line JSON header +
+    binary blob, with format/version validation. All three artifact
+    families (eta msgpack, road-GNN msgpack, StableHLO export) speak
+    this layout; keeping ONE reader keeps their error contracts in sync.
+    Returns (header, blob)."""
+    with open(path, "rb") as f:
+        if f.read(len(magic)) != magic:
+            raise ValueError(f"{path}: not a {kind}")
+        header = json.loads(f.readline().decode())
+        blob = f.read()
+    if header.get("format") != fmt:
+        raise ValueError(f"{path}: unknown artifact format "
+                         f"{header.get('format')}")
+    if header.get("version") not in versions:
+        expected = "/".join(f"v{v}" for v in versions)
+        raise ValueError(
+            f"{path}: artifact version {header.get('version')} is "
+            f"incompatible (expects {expected}); {retrain_hint}")
+    return header, blob
+
+
 def save_model(path: str, model: EtaMLP, params: Params) -> None:
     """Serving artifact: MAGIC + json header line + msgpack params."""
     header_dict = {
@@ -57,21 +80,12 @@ def save_model(path: str, model: EtaMLP, params: Params) -> None:
 
 
 def load_model(path: str) -> Tuple[EtaMLP, Params]:
-    with open(path, "rb") as f:
-        magic = f.read(len(MAGIC))
-        if magic != MAGIC:
-            raise ValueError(f"{path}: not a routest_tpu model artifact")
-        header = json.loads(f.readline().decode())
-        blob = f.read()
-    if header.get("format") != "routest_tpu.eta_mlp":
-        raise ValueError(f"{path}: unknown artifact format {header.get('format')}")
+    header, blob = _read_artifact(
+        path, MAGIC, "routest_tpu.eta_mlp",
+        (ARTIFACT_VERSION, QUANTILE_ARTIFACT_VERSION),
+        kind="routest_tpu model artifact",
+        retrain_hint="retrain via scripts/train_eta.py")
     version = header.get("version")
-    if version not in (ARTIFACT_VERSION, QUANTILE_ARTIFACT_VERSION):
-        raise ValueError(
-            f"{path}: artifact version {version} is incompatible with this "
-            f"build (expects v{ARTIFACT_VERSION}/v{QUANTILE_ARTIFACT_VERSION}); "
-            f"retrain via scripts/train_eta.py"
-        )
     quantiles = tuple(header.get("quantiles", ()))
     if version == QUANTILE_ARTIFACT_VERSION and not quantiles:
         raise ValueError(f"{path}: v{QUANTILE_ARTIFACT_VERSION} artifact "
@@ -88,6 +102,101 @@ def load_model(path: str) -> Tuple[EtaMLP, Params]:
     params = serialization.msgpack_restore(blob)
     params = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
     return model, params
+
+
+EXPORT_MAGIC = b"RTPUX1\n"
+EXPORT_VERSION = 1
+
+
+def export_serving_fn(path: str, model: EtaMLP, params: Params,
+                      platforms: Tuple[str, ...] = ("cpu", "tpu")) -> None:
+    """AOT-export the serving forward as serialized StableHLO.
+
+    The msgpack artifact (``save_model``) needs this package's model
+    code to rebuild the forward; this artifact does not — the traced
+    computation with the params baked in as constants IS the file, with
+    a symbolic batch dimension so one export covers every batch bucket.
+    That pins the serving numerics against model-code drift (the
+    deployed function can't change when ``eta_mlp.py`` does) and drops
+    the Python model from the serving dependency chain — the TPU-native
+    analog of exporting the reference's pickled booster to a
+    self-contained format. Multi-platform by default: the same file
+    serves the CPU conftest backend and the TPU.
+
+    Layout mirrors ``save_model``: EXPORT_MAGIC + JSON header line
+    (n_features / quantiles / platforms — what the serving layer needs
+    without executing anything) + the StableHLO bytes.
+    """
+    from jax import export as jax_export
+
+    quantiles = tuple(getattr(model, "quantiles", ()) or ())
+    forward = model.apply_quantiles if quantiles else model.apply
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+
+    def fn(x):
+        return forward(host_params, x)
+
+    (batch,) = jax_export.symbolic_shape("b")
+    spec = jax.ShapeDtypeStruct((batch, model.n_features), np.float32)
+    exported = jax_export.export(jax.jit(fn), platforms=tuple(platforms))(spec)
+    header = json.dumps({
+        "format": "routest_tpu.eta_stablehlo",
+        "version": EXPORT_VERSION,
+        "n_features": model.n_features,
+        "quantiles": list(quantiles),
+        "platforms": list(platforms),
+        "hidden": list(model.hidden),  # informational; not needed to run
+    }).encode() + b"\n"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(EXPORT_MAGIC)
+        f.write(header)
+        f.write(exported.serialize())
+
+
+class ExportedServingModel:
+    """A deserialized AOT export, shaped like a model for the serving
+    layer: ``n_features``/``quantiles`` attributes + ``__call__``.
+    No params pytree exists — weights are constants inside the program."""
+
+    def __init__(self, call, header: dict) -> None:
+        self._call = call
+        self.header = header
+        self.n_features = int(header["n_features"])
+        self.quantiles = tuple(header.get("quantiles", ()))
+        self.hidden = tuple(header.get("hidden", ()))
+
+    def __call__(self, x):
+        return self._call(x)
+
+
+def backend_platforms(backend: Optional[str] = None) -> Tuple[str, ...]:
+    """jax backend name → the export-platform names it can execute.
+    Vocabularies differ on GPU: ``jax.default_backend()`` says "gpu",
+    exports say "cuda"/"rocm"."""
+    backend = backend or jax.default_backend()
+    if backend == "gpu":
+        return ("cuda", "rocm")
+    return (backend,)
+
+
+def load_exported_serving_fn(path: str) -> ExportedServingModel:
+    """Deserialize an ``export_serving_fn`` artifact. Raises ValueError
+    for wrong magic/format/version (same contract as ``load_model``)."""
+    from jax import export as jax_export
+
+    header, blob = _read_artifact(
+        path, EXPORT_MAGIC, "routest_tpu.eta_stablehlo", (EXPORT_VERSION,),
+        kind="routest_tpu AOT export",
+        retrain_hint="re-export via scripts/export_model.py")
+    exported = jax_export.deserialize(blob)
+    runnable = backend_platforms()
+    if not any(p in exported.platforms for p in runnable):
+        raise ValueError(
+            f"{path}: exported for platforms {list(exported.platforms)}, "
+            f"but the running backend is {jax.default_backend()}; "
+            f"re-export with --platforms {','.join(runnable)}")
+    return ExportedServingModel(exported.call, header)
 
 
 def default_model_path(cfg=None) -> str:
@@ -162,19 +271,10 @@ def load_gnn(path: str):
     """→ (RoadGNN, params, graph fingerprint dict)."""
     from routest_tpu.models.gnn import RoadGNN
 
-    with open(path, "rb") as f:
-        magic = f.read(len(MAGIC))
-        if magic != MAGIC:
-            raise ValueError(f"{path}: not a routest_tpu model artifact")
-        header = json.loads(f.readline().decode())
-        blob = f.read()
-    if header.get("format") != "routest_tpu.road_gnn":
-        raise ValueError(f"{path}: unknown artifact format {header.get('format')}")
-    if header.get("version") != GNN_ARTIFACT_VERSION:
-        raise ValueError(
-            f"{path}: road_gnn artifact version {header.get('version')} is "
-            f"incompatible (expects v{GNN_ARTIFACT_VERSION}); retrain via "
-            f"scripts/train_gnn.py")
+    header, blob = _read_artifact(
+        path, MAGIC, "routest_tpu.road_gnn", (GNN_ARTIFACT_VERSION,),
+        kind="routest_tpu model artifact",
+        retrain_hint="retrain via scripts/train_gnn.py")
     import jax.numpy as jnp
 
     from routest_tpu.core.dtypes import DEFAULT_POLICY
