@@ -66,6 +66,9 @@ class ServeConfig:
     max_wait_ms: float = 2.0
     # Bucketed pad sizes to avoid recompiles.
     batch_buckets: Tuple[int, ...] = (8, 64, 512, 4096)
+    # Model hot-reload: poll the artifact every N seconds and swap a
+    # changed file in without a restart. 0 (default) disables.
+    reload_sec: float = 0.0
     # External services — all optional; absent ⇒ hermetic in-memory fakes.
     supabase_url: Optional[str] = None
     supabase_service_key: Optional[str] = None
@@ -115,11 +118,26 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         seed=_int("RTPU_SEED", 0),
         checkpoint_dir=env.get("RTPU_CKPT_DIR"),
     )
+    def _float_tolerant(name: str, default: float) -> float:
+        # Ops knob: a malformed value must not abort server boot — fall
+        # back to the default (= feature off for reload_sec) instead.
+        raw = env.get(name)
+        if not raw:
+            return default
+        try:
+            return float(raw)
+        except ValueError:
+            import warnings
+
+            warnings.warn(f"{name}={raw!r} is not a number; using {default}")
+            return default
+
     serve = ServeConfig(
         host=env.get("RTPU_HOST", "127.0.0.1"),
         port=_int("PORT", _int("RTPU_PORT", 5000)),
         max_batch=_int("RTPU_MAX_BATCH", 4096),
         max_wait_ms=_float("RTPU_MAX_WAIT_MS", 2.0),
+        reload_sec=_float_tolerant("ROUTEST_RELOAD_SEC", 0.0),
         supabase_url=env.get("SUPABASE_URL"),
         supabase_service_key=env.get("SUPABASE_SERVICE_ROLE_KEY"),
         redis_url=env.get("REDIS_URL"),

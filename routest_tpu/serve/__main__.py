@@ -80,6 +80,11 @@ def main() -> None:
     eta = EtaService(config.serve,
                      model_path=default_model_path(config.model),
                      runtime=runtime)
+    if config.serve.reload_sec > 0:
+        # EtaService started the watcher itself (it owns the lifecycle);
+        # just surface it on the boot line.
+        print(f"[serve] model hot-reload watcher every "
+              f"{config.serve.reload_sec:g}s")
     app = create_app(config, eta_service=eta)
     # HTTP/1.1 keep-alive: werkzeug defaults to 1.0 (connection-per-
     # request), which taxes every call with TCP setup + a fresh handler

@@ -30,6 +30,32 @@ from routest_tpu.models.eta_mlp import EtaMLP, Params
 from routest_tpu.train.checkpoint import default_model_path, load_model
 
 
+class _ServingState:
+    """One immutable bundle of everything a prediction needs — model,
+    batcher, quantile levels. Readers snapshot ``self._serving`` ONCE
+    per request and use only the snapshot, so a hot-reload (which swaps
+    the single attribute) can never hand a request the OLD batcher's
+    output shape with the NEW model's quantile metadata (a torn read
+    that would mis-index or mis-label the row)."""
+
+    __slots__ = ("model", "batcher", "quantiles")
+
+    def __init__(self, model, batcher, quantiles) -> None:
+        self.model = model
+        self.batcher = batcher
+        self.quantiles = tuple(quantiles or ())
+
+
+_EMPTY_SERVING = _ServingState(None, None, ())
+
+
+class _InReload(threading.local):
+    flag = False
+
+
+_in_reload = _InReload()
+
+
 def _parse_pickup_single(pickup_time) -> dt.datetime:
     """Single-row pickup parsing (reference semantics, ``Flaskr/ml.py``):
     ISO string → datetime (offset preserved), datetime passes through,
@@ -191,9 +217,19 @@ class EtaService:
         self._model: Optional[EtaMLP] = None
         self._params: Optional[Params] = None
         self._error: Optional[str] = None
-        self._load(model_path or default_model_path())
+        self._path = model_path or default_model_path()
+        self._loaded_mtime_ns = self._artifact_mtime_ns()
+        self._reload_lock = threading.Lock()
+        self._load(self._path)
         self._batcher: Optional[DynamicBatcher] = None
+        self._serving = _EMPTY_SERVING
         self.kernel = "xla"  # which forward path serves: xla | pallas_fused
+        # Hot-reload watcher (cfg.reload_sec > 0): the SERVICE owns it,
+        # so embedders constructing EtaService directly get it too —
+        # not only `python -m routest_tpu.serve`. Suppressed inside a
+        # reload's own replacement construction (the parent watches).
+        if cfg.reload_sec > 0 and not _in_reload.flag:
+            self._watcher_stop = self.start_reload_watcher(cfg.reload_sec)
         # Warm the native encoder now: its first use triggers a g++
         # build (content-cached), which must happen at startup, not
         # inside the first customer request's batcher flush.
@@ -287,7 +323,10 @@ class EtaService:
             # drop the score closure too — it captures the device-pinned
             # param tree and would hold device memory forever
             self._score = None
+            self._serving = _EMPTY_SERVING
         else:
+            self._serving = _ServingState(self._model, self._batcher,
+                                          self.quantiles)
             self._warm_buckets()
 
     def _warm_buckets(self) -> None:
@@ -428,6 +467,80 @@ class EtaService:
         except Exception:
             self._error = first_error
 
+    def _artifact_mtime_ns(self) -> Optional[int]:
+        try:
+            return os.stat(self._path).st_mtime_ns
+        except OSError:
+            return None
+
+    def reload_if_changed(self) -> bool:
+        """Hot-reload the serving artifact when its file changed.
+
+        The reference's only way to pick up a new model is a process
+        restart (the pickle loads once, ``Flaskr/ml.py:11-21``); here a
+        changed ``ETA_MODEL_PATH`` file swaps in WITHOUT dropping
+        requests: a complete replacement service (model + batcher, self-
+        checked and bucket-warmed) is built off to the side, then the
+        references flip — in-flight requests finish on the old batcher's
+        closures, new requests land on the new one. A broken replacement
+        (missing/corrupt/failed self-check) keeps the old model serving
+        and returns False. Returns True only after a successful swap.
+        """
+        with self._reload_lock:
+            mtime = self._artifact_mtime_ns()
+            if mtime is None or mtime == self._loaded_mtime_ns:
+                return False
+            from routest_tpu.utils.logging import get_logger
+
+            log = get_logger("routest_tpu.serve")
+            _in_reload.flag = True
+            try:
+                fresh = EtaService(self._cfg, model_path=self._path,
+                                   runtime=self._runtime)
+            finally:
+                _in_reload.flag = False
+            if not fresh.available:
+                log.warning("model_reload_rejected", path=self._path,
+                            error=fresh.load_error)
+                # remember the bad mtime: don't rebuild-and-reject on
+                # every poll until the file changes again
+                self._loaded_mtime_ns = mtime
+                return False
+            # ONE reference flip makes the swap atomic for readers (they
+            # snapshot _serving once per request); the individual fields
+            # are updated too for stats/health introspection.
+            self._serving = fresh._serving
+            self._model = fresh._model
+            self._params = fresh._params
+            self._batcher = fresh._batcher
+            self._score = fresh._score
+            self.kernel = fresh.kernel
+            self._error = None
+            self._loaded_mtime_ns = fresh._loaded_mtime_ns
+            log.info("model_reloaded", path=self._path, kernel=self.kernel)
+            return True
+
+    def start_reload_watcher(self, interval_s: float) -> threading.Event:
+        """Poll the artifact mtime every ``interval_s`` seconds on a
+        daemon thread (``ROUTEST_RELOAD_SEC`` wires this in serve boot).
+        Returns the stop event."""
+        stop = threading.Event()
+
+        def watch() -> None:
+            while not stop.wait(interval_s):
+                try:
+                    self.reload_if_changed()
+                except Exception as e:  # never kill the watcher
+                    from routest_tpu.utils.logging import get_logger
+
+                    get_logger("routest_tpu.serve").error(
+                        "model_reload_failed",
+                        error=f"{type(e).__name__}: {e}")
+
+        threading.Thread(target=watch, name="eta-reload-watcher",
+                         daemon=True).start()
+        return stop
+
     @property
     def available(self) -> bool:
         return self._model is not None
@@ -445,17 +558,26 @@ class EtaService:
         return self._error
 
     def predict_batch(self, rows: np.ndarray) -> Optional[np.ndarray]:
-        if not self.available or self._batcher is None:
+        return self._predict_rows(self._serving, rows)
+
+    @staticmethod
+    def _predict_rows(serving: _ServingState,
+                      rows: np.ndarray) -> Optional[np.ndarray]:
+        """Score rows against ONE serving snapshot (hot-reload-safe:
+        callers must pair the result with the SAME snapshot's quantile
+        metadata)."""
+        batcher = serving.batcher
+        if batcher is None:
             return None
         rows = np.asarray(rows, np.float32)
         # Chunk oversize batches to the largest compile bucket: arbitrary
         # row counts would each compile a fresh executable (a client
         # sweeping sizes = recompile storm + unbounded jit cache).
-        cap = self._batcher._buckets[-1]
+        cap = batcher._buckets[-1]
         if len(rows) <= cap:
-            return self._batcher.submit(rows)
+            return batcher.submit(rows)
         return np.concatenate([
-            self._batcher.submit(rows[i: i + cap])
+            batcher.submit(rows[i: i + cap])
             for i in range(0, len(rows), cap)])
 
     def predict_eta_minutes(
@@ -464,7 +586,11 @@ class EtaService:
     ) -> Tuple[Optional[float], Optional[str]]:
         """Reference-signature single prediction (``Flaskr/ml.py:23``):
         returns (eta_minutes, completion_iso) or (None, None)."""
-        if not self.available:
+        # ONE snapshot for both scoring and quantile metadata: a
+        # concurrent hot-reload must not pair the old batcher's output
+        # shape with the new model's quantile levels.
+        serving = self._serving
+        if serving.batcher is None:
             return None, None
         pickup_dt = _parse_pickup_single(pickup_time)
 
@@ -475,13 +601,13 @@ class EtaService:
             driver_age=[float(driver_age or 30.0)],
         )
         try:
-            preds = self.predict_batch(rows)
+            preds = self._predict_rows(serving, rows)
         except Exception:
             return None, None
         if preds is None:
             return None, None
         row = np.atleast_1d(preds[0])
-        q = self.quantiles
+        q = serving.quantiles
         # Finiteness policy (shared with predict_eta_quantiles): the row
         # is servable iff its MEDIAN is finite — a degenerate tail head
         # must not turn a servable point estimate into "model
@@ -549,7 +675,8 @@ class EtaService:
         empty for point models. Minutes are always the median for
         quantile models.
         """
-        if not self.available:
+        serving = self._serving  # one snapshot: scoring + metadata
+        if serving.batcher is None:
             return (None, None, {}) if return_quantiles else (None, None)
         n = len(distance_m)
         if isinstance(pickup_time, (str, dt.datetime)) or pickup_time is None:
@@ -579,11 +706,11 @@ class EtaService:
             distance_km=[float(d or 0) / 1000.0 for d in distance_m],
             driver_age=[float(a or 30.0) for a in driver_age],
         )
-        preds = self.predict_batch(rows)
+        preds = self._predict_rows(serving, rows)
         if preds is None:
             return (None, None, {}) if return_quantiles else (None, None)
         preds = np.asarray(preds, np.float64)
-        q = self.quantiles
+        q = serving.quantiles
         bands: dict = {}
         if q:
             minutes = preds[:, q.index(0.5)]
