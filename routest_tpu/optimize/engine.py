@@ -21,11 +21,12 @@ import datetime as dt
 import math
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from routest_tpu.data import geo
-from routest_tpu.optimize.vrp import solve_host
+from routest_tpu.optimize.vrp import solve_host, solve_host_batch
 
 ENGINE_TAG = "backend:jax-tpu"
 
@@ -130,9 +131,10 @@ def _build_trip_feature_parts(all_points: List[Dict], trip: Sequence[int],
     return coords, segments, total_dist, total_dur
 
 
-def optimize_route(input_data: dict) -> dict:
-    """Drop-in equivalent of the reference's optimizer entry point
-    (``Flaskr/utils.py:10-48``): dict in, GeoJSON Feature (or error) out."""
+def _parse_problem(input_data: dict) -> dict:
+    """Validate one optimize-route request body → either ``{"error"}``
+    or the parsed problem dict (shared by the single and batch paths so
+    a malformed item fails identically on both)."""
     if not input_data or not input_data.get("destination_points"):
         return {"error": "no destination points specified."}
     if not input_data.get("source_point"):
@@ -141,8 +143,6 @@ def optimize_route(input_data: dict) -> dict:
     driver_details = input_data.get("driver_details") or {}
     vehicle_type = (driver_details.get("vehicle_type") or "car").lower().strip()
     profile = geo.profile_for_vehicle(vehicle_type)
-    road_factor = geo.PROFILE_ROAD_FACTOR[profile]
-    speed = geo.PROFILE_SPEED_MPS[profile]
 
     source = input_data["source_point"]
     destinations = input_data["destination_points"]
@@ -152,6 +152,12 @@ def optimize_route(input_data: dict) -> dict:
         max_dist = float(driver_details.get("maximum_distance", 9e12))
     except (TypeError, ValueError):
         return {"error": "invalid driver_details: vehicle_capacity/maximum_distance must be numeric"}
+    # Non-finite constraints would make the solver's feasibility mask
+    # vacuous and its while_loop spin forever on device (NaN compares
+    # False both ways; json.loads happily parses NaN/Infinity) — reject
+    # up front, before any item reaches a (possibly shared batch) solve.
+    if not (math.isfinite(cap) and math.isfinite(max_dist)):
+        return {"error": "invalid driver_details: vehicle_capacity/maximum_distance must be finite"}
 
     all_points = [source] + list(destinations)
     try:
@@ -159,18 +165,65 @@ def optimize_route(input_data: dict) -> dict:
                             dtype=np.float32)
     except (KeyError, TypeError, ValueError):
         return {"error": "invalid coordinates: each point needs numeric lat/lon"}
+    if not np.isfinite(latlon).all():
+        return {"error": "invalid coordinates: each point needs numeric lat/lon"}
     # Validate top_k UP FRONT: the same malformed value must fail the
     # same way on every path, before any matrix/solve work is spent.
     try:
         top_k = int(input_data.get("top_k", 0) or 0)
     except (TypeError, ValueError):
         return {"error": "top_k must be an integer"}
+    try:
+        demands = np.asarray(
+            [float(p.get("payload", 0) or 0) for p in destinations],
+            dtype=np.float32)
+    except (TypeError, ValueError, AttributeError):
+        return {"error": "invalid destination payload: must be numeric"}
+    if not np.isfinite(demands).all():
+        return {"error": "invalid destination payload: must be finite"}
+
+    return {
+        "source": source,
+        "destinations": destinations,
+        "all_points": all_points,
+        "latlon": latlon,
+        "demands": demands,
+        "driver_details": driver_details,
+        "vehicle_type": vehicle_type,
+        "road_factor": geo.PROFILE_ROAD_FACTOR[profile],
+        "speed": geo.PROFILE_SPEED_MPS[profile],
+        "cap": cap,
+        "max_dist": max_dist,
+        "top_k": top_k,
+        "refine": bool(input_data.get("refine")),
+        "use_road": bool(input_data.get("road_graph")),
+        "pickup_time": input_data.get("pickup_time"),
+    }
+
+
+def optimize_route(input_data: dict) -> dict:
+    """Drop-in equivalent of the reference's optimizer entry point
+    (``Flaskr/utils.py:10-48``): dict in, GeoJSON Feature (or error) out."""
+    p = _parse_problem(input_data)
+    if "error" in p:
+        return p
+    driver_details = p["driver_details"]
+    vehicle_type = p["vehicle_type"]
+    road_factor = p["road_factor"]
+    speed = p["speed"]
+    source = p["source"]
+    destinations = p["destinations"]
+    all_points = p["all_points"]
+    latlon = p["latlon"]
+    cap = p["cap"]
+    max_dist = p["max_dist"]
+    top_k = p["top_k"]
 
     # Leg provider: great-circle × road factor by default; with
     # {"road_graph": true} (additive ABI) legs become true shortest paths
     # over the on-device road network — street-following geometry,
     # congestion-model durations (optimize/road_router.py).
-    use_road = bool(input_data.get("road_graph"))
+    use_road = p["use_road"]
     legs = None
     if use_road:
         from routest_tpu.optimize.road_router import default_router
@@ -178,7 +231,7 @@ def optimize_route(input_data: dict) -> dict:
         car_speed = geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("car")]
         legs = default_router().route_legs(
             latlon, car_speed / speed,
-            hour=_pickup_hour(input_data.get("pickup_time")))
+            hour=_pickup_hour(p["pickup_time"]))
         dist = legs.dist_m
 
         def leg_cost(a: int, b: int):
@@ -198,16 +251,28 @@ def optimize_route(input_data: dict) -> dict:
             feature["properties"]["leg_cost_model"] = legs.cost_model
         return feature
 
-    try:
-        demands = np.asarray([float(p.get("payload", 0) or 0) for p in destinations],
-                             dtype=np.float32)
-    except (TypeError, ValueError):
-        return {"error": "invalid destination payload: must be numeric"}
     # Additive ABI: {"refine": true} runs 2-opt on the greedy order —
     # strictly shorter or equal routes, same response shape. Default off
     # to keep exact reference-greedy semantics.
-    refine = bool(input_data.get("refine"))
-    sol = solve_host(dist, demands, cap, max_dist, refine=refine)
+    sol = solve_host(dist, p["demands"], cap, max_dist, refine=p["refine"])
+    return _assemble_multi(p, sol, dist, leg_cost, leg_geom, legs)
+
+
+def _assemble_multi(p: dict, sol: dict, dist, leg_cost, leg_geom,
+                    legs) -> dict:
+    """Solved multi-stop problem → GeoJSON Feature (host-side geometry,
+    segments, summary, top-k alternatives). Shared by the single path
+    and ``optimize_route_batch``."""
+    source = p["source"]
+    destinations = p["destinations"]
+    all_points = p["all_points"]
+    driver_details = p["driver_details"]
+    vehicle_type = p["vehicle_type"]
+    speed = p["speed"]
+    max_dist = p["max_dist"]
+    top_k = p["top_k"]
+    use_road = p["use_road"]
+    refine = p["refine"]
     if sol["unroutable"]:
         which = ", ".join(str(i) for i in sol["unroutable"])
         return {"error": f"stops not routable under constraints (indices: {which})"}
@@ -314,6 +379,103 @@ def optimize_route(input_data: dict) -> dict:
         feature["properties"]["leg_cost_model"] = legs.cost_model
     _annotate(feature, driver_details, vehicle_type)
     return feature
+
+
+MAX_BATCH_PROBLEMS = 256
+
+# (B, P, 2) points + (B,) road factors → (B, P, P) matrices in one call.
+_distance_matrix_batch = jax.jit(jax.vmap(geo.distance_matrix_m))
+
+
+def optimize_route_batch(items) -> list:
+    """Solve MANY optimize-route requests in one vmapped device call.
+
+    Additive capability (the reference optimizes one problem per HTTP
+    request, each costing it an ORS matrix round trip —
+    ``Flaskr/utils.py:94-109``): one batched haversine builds every
+    problem's distance matrix, then all multi-stop problems run the
+    greedy solver (plus refiners when requested) as one ``(B, P+1,
+    P+1)`` device program via ``solve_host_batch``. Geometry/segment
+    assembly stays host-side per item, identical to the single path
+    (shared ``_assemble_multi``).
+
+    Per-item errors are returned in place — one malformed problem never
+    poisons the batch. ``road_graph`` and ``top_k`` items are rejected
+    here (their device work is per-item by nature; the single endpoint
+    serves them). Point-to-point items are priced host-side directly.
+    """
+    if not isinstance(items, list) or not items:
+        return [{"error": "items must be a non-empty list"}]
+    if len(items) > MAX_BATCH_PROBLEMS:
+        return [{"error": f"batch too large (max {MAX_BATCH_PROBLEMS} "
+                          f"problems)"}]
+    results: list = [None] * len(items)
+    solve: list = []  # (index, parsed, dist, leg_cost, leg_geom)
+
+    for i, item in enumerate(items):
+        p = _parse_problem(item if isinstance(item, dict) else {})
+        if "error" in p:
+            results[i] = p
+            continue
+        # top_k == 1 is a no-op on the single path (alternatives only
+        # trigger above 1) — reject only what genuinely needs a
+        # per-problem device program.
+        if p["use_road"] or p["top_k"] > 1:
+            results[i] = {"error": "road_graph/top_k are per-problem "
+                                   "features; use /api/optimize_route"}
+            continue
+        solve.append([i, p, None, None, None])
+
+    # ONE batched haversine builds every problem's distance matrix
+    # (points padded with origin copies; the pad region is never read —
+    # solve_host_batch re-masks it and assembly slices the real block).
+    if solve:
+        max_pts = max(len(s[1]["all_points"]) for s in solve)
+        pts_pad = 1 << max(0, (max_pts - 1)).bit_length()
+        latlon_b = np.zeros((len(solve), pts_pad, 2), np.float32)
+        factor_b = np.zeros((len(solve),), np.float32)
+        for j, s in enumerate(solve):
+            ll = s[1]["latlon"]
+            latlon_b[j] = ll[0]  # origin copies fill the pad
+            latlon_b[j, : len(ll)] = ll
+            factor_b[j] = s[1]["road_factor"]
+        mats = np.asarray(_distance_matrix_batch(
+            jnp.asarray(latlon_b), jnp.asarray(factor_b)))
+        for j, s in enumerate(solve):
+            n_pts = len(s[1]["all_points"])
+            s[2] = mats[j, :n_pts, :n_pts]
+            s[3], s[4] = _gc_legs(s[1]["all_points"], s[2], s[1]["speed"])
+
+    # Point-to-point items price host-side directly (one leg each).
+    still: list = []
+    for s in solve:
+        i, p, dist, leg_cost, leg_geom = s
+        if len(p["destinations"]) == 1:
+            results[i] = _point_to_point(
+                p["source"], p["destinations"][0], p["all_points"],
+                leg_cost, leg_geom, p["driver_details"], p["vehicle_type"],
+                p["cap"], p["max_dist"], False)
+        else:
+            still.append(s)
+    solve = still
+
+    # One batched device solve per refine flavor (refiners change the
+    # program; two compiled variants max).
+    for flavor in (False, True):
+        group = [s for s in solve if s[1]["refine"] is flavor]
+        if not group:
+            continue
+        sols = solve_host_batch(
+            [g[2] for g in group],
+            [g[1]["demands"] for g in group],
+            [g[1]["cap"] for g in group],
+            [g[1]["max_dist"] for g in group],
+            refine=flavor,
+        )
+        for (i, p, dist, leg_cost, leg_geom), sol in zip(group, sols):
+            results[i] = _assemble_multi(p, sol, dist, leg_cost, leg_geom,
+                                         None)
+    return results
 
 
 def _point_to_point(source, destination, all_points,

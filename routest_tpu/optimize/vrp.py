@@ -452,6 +452,10 @@ def refine_swap(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
     return out[0]
 
 
+refine_swap_batch = jax.jit(
+    jax.vmap(refine_swap, in_axes=(0, 0, 0, 0, 0, 0)))
+
+
 def trips_cost(dist: np.ndarray, trips) -> float:
     """Host-side total closed-tour distance of a trips-list (the
     ``solve_host`` output form): Σ over trips of origin → stops → origin.
@@ -485,6 +489,104 @@ def tour_cost(dist: np.ndarray, order: np.ndarray,
     return trips_cost(dist, trips)
 
 
+def _unpack_solution(order: np.ndarray, trip_ids: np.ndarray,
+                     n_routed: int, unroutable: np.ndarray,
+                     n_real: int) -> dict:
+    """Padded solver arrays → host dict (shared by single and batch).
+    ``n_real`` masks batch padding out of the unroutable report."""
+    trips: list = []
+    for pos in range(n_routed):
+        tid = int(trip_ids[pos])
+        while len(trips) <= tid:
+            trips.append([])
+        trips[tid].append(int(order[pos]))
+    # relocate may empty a trip entirely; compact so trip counts stay dense
+    trips = [t for t in trips if t]
+    return {
+        "trips": trips,
+        "optimized_order": [int(i) for i in order[:n_routed]],
+        "n_trips": len(trips),
+        "unroutable": [int(i) for i in np.flatnonzero(unroutable[:n_real])],
+    }
+
+
+def solve_host_batch(dists, demands, capacities, max_distances,
+                     refine: bool = False,
+                     max_refine_rounds: int = 4) -> list:
+    """Solve MANY VRPs in one device call — the batch-of-problems axis
+    the module docstring promises, on the serving path.
+
+    Inputs are per-problem lists (matrices of varying size); problems
+    pad to the batch's max stop count (next power of two, so request
+    mixes reuse one compiled program). Padded stops get infinite demand,
+    which the solver's feasibility mask treats as pre-visited — they can
+    never be routed, cost nothing, and are sliced out of the report.
+
+    ``refine=True`` runs the same 2-opt → relocate → swap rounds as
+    ``solve_host``, vmapped across the batch; rounds are fixed at
+    ``max_refine_rounds`` for the whole batch (every move is
+    strictly-no-worse, so extra rounds are no-ops for converged
+    problems — per-problem early exit would force host sync per round).
+    """
+    b = len(dists)
+    if b == 0:
+        return []
+    caps_np = np.asarray(capacities, np.float32)
+    maxd_np = np.asarray(max_distances, np.float32)
+    # Non-finite constraints make the feasibility mask vacuous (NaN
+    # compares False both ways; inf capacity lets the padded phantom
+    # stops through) and the while_loop would spin forever / route
+    # phantoms. The request path rejects these in _parse_problem; guard
+    # the library boundary too.
+    if not (np.isfinite(caps_np).all() and np.isfinite(maxd_np).all()):
+        raise ValueError("solve_host_batch: capacity/max_distance must be "
+                         "finite")
+    n_real = [d.shape[0] - 1 for d in dists]
+    p = 1 << max(0, (max(n_real) - 1)).bit_length()  # padded stop count
+    # Pad the BATCH axis too (dummy all-unroutable problems, sliced off
+    # below): otherwise every distinct problem count compiles a fresh
+    # while_loop program on the serving path.
+    b_pad = 1 << max(0, (b - 1)).bit_length()
+
+    # Padded stops must be structurally unroutable regardless of the
+    # problem's constraints: infinite demand (> any finite capacity) AND
+    # a huge origin round trip (> any finite max_distance) — belt and
+    # suspenders, since either alone can be defeated by extreme but
+    # finite inputs on one side.
+    _FAR = np.float32(1e30)
+    dist_b = np.full((b_pad, p + 1, p + 1), _FAR, np.float32)
+    dem_b = np.full((b_pad, p), np.inf, np.float32)
+    for i, (d, dem, n) in enumerate(zip(dists, demands, n_real)):
+        dist_b[i, : n + 1, : n + 1] = d
+        dem_b[i, :n] = dem
+    cap_b = jnp.asarray(np.concatenate(
+        [caps_np, np.ones(b_pad - b, np.float32)]))
+    maxd_b = jnp.asarray(np.concatenate(
+        [maxd_np, np.ones(b_pad - b, np.float32)]))
+    dist_j = jnp.asarray(dist_b)
+    dem_j = jnp.asarray(dem_b)
+
+    sol = greedy_vrp_batch(dist_j, dem_j, cap_b, maxd_b)
+    order_j, trips_j = sol.order, sol.trip_ids
+    if refine:
+        for _ in range(max_refine_rounds):
+            order_j = refine_2opt_batch(dist_j, order_j, trips_j)
+            order_j, trips_j = refine_relocate_batch(
+                dist_j, dem_j, cap_b, maxd_b, order_j, trips_j)
+            order_j = refine_swap_batch(
+                dist_j, dem_j, cap_b, maxd_b, order_j, trips_j)
+
+    order = np.asarray(order_j)
+    trip_ids = np.asarray(trips_j)
+    n_routed = np.asarray(sol.n_routed)
+    unroutable = np.asarray(sol.unroutable)
+    return [
+        _unpack_solution(order[i], trip_ids[i], int(n_routed[i]),
+                         unroutable[i], n_real[i])
+        for i in range(b)
+    ]
+
+
 def solve_host(dist: np.ndarray, demands: np.ndarray, capacity: float,
                max_distance: float, refine: bool = False,
                max_refine_rounds: int = 4) -> dict:
@@ -515,20 +617,6 @@ def solve_host(dist: np.ndarray, demands: np.ndarray, capacity: float,
                 break
             cost = new_cost
         sol = sol._replace(order=order_j, trip_ids=trips_j)
-    order = np.asarray(sol.order)
-    trip_ids = np.asarray(sol.trip_ids)
-    n_routed = int(sol.n_routed)
-    trips: list = []
-    for pos in range(n_routed):
-        tid = int(trip_ids[pos])
-        while len(trips) <= tid:
-            trips.append([])
-        trips[tid].append(int(order[pos]))
-    # relocate may empty a trip entirely; compact so trip counts stay dense
-    trips = [t for t in trips if t]
-    return {
-        "trips": trips,
-        "optimized_order": [int(i) for i in order[:n_routed]],
-        "n_trips": len(trips),
-        "unroutable": [int(i) for i in np.flatnonzero(np.asarray(sol.unroutable))],
-    }
+    return _unpack_solution(np.asarray(sol.order), np.asarray(sol.trip_ids),
+                            int(sol.n_routed), np.asarray(sol.unroutable),
+                            len(demands))

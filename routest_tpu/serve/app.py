@@ -24,7 +24,8 @@ from werkzeug.wrappers import Response
 
 from routest_tpu.core.config import Config, load_config
 from routest_tpu.data.locations import locations_table
-from routest_tpu.optimize.engine import optimize_route
+from routest_tpu.optimize.engine import (MAX_BATCH_PROBLEMS, optimize_route,
+                                         optimize_route_batch)
 from routest_tpu.serve import sim
 from routest_tpu.serve import auth as auth_mod
 from routest_tpu.serve.auth import AuthService, mount_auth
@@ -128,6 +129,61 @@ def create_app(config: Optional[Config] = None,
                        store=state.store.kind)
 
         return result, 200
+
+    @app.route("/api/optimize_route_batch", methods=("POST",))
+    def optimize_route_batch_endpoint(request):
+        """Batch route optimization — additive ABI.
+
+        ``{"items": [<optimize_route bodies>], "use_ml_eta": bool}`` →
+        ``{"count": N, "items": [<Feature or {"error"}>]}``. All
+        multi-stop problems solve in ONE vmapped device call
+        (``optimize/vrp.solve_host_batch``); with ``use_ml_eta`` every
+        successful route's ETA scores in ONE model batch. Per-item
+        errors come back in place; nothing here persists (batch scoring
+        is an analysis surface, not dispatch — use the single endpoint
+        to dispatch + save a route).
+        """
+        body = get_json(request) or {}
+        items = body.get("items")
+        if not isinstance(items, list) or not items:
+            return {"error": "items must be a non-empty list"}, 400
+        if len(items) > MAX_BATCH_PROBLEMS:
+            return {"error": f"batch too large (max {MAX_BATCH_PROBLEMS} "
+                             f"problems)"}, 400
+        if not all(isinstance(it, dict) for it in items):
+            return {"error": "every item must be an optimize_route body"}, 400
+        results = optimize_route_batch(items)
+
+        if body.get("use_ml_eta"):
+            ok = [(i, r) for i, r in enumerate(results)
+                  if isinstance(r, dict) and "error" not in r]
+            if ok:
+                ctx = body.get("context") or {}
+                try:
+                    minutes, iso = state.eta.predict_eta_batch(
+                        weather=[ctx.get("weather", "Sunny")] * len(ok),
+                        traffic=[ctx.get("traffic", "Low")] * len(ok),
+                        distance_m=[
+                            float((r["properties"].get("summary") or {})
+                                  .get("distance") or 0) for _, r in ok],
+                        pickup_time=None,
+                        driver_age=[
+                            float((items[i].get("driver_details") or {})
+                                  .get("driver_age", 30) or 30)
+                            for i, _ in ok],
+                    )
+                except Exception as e:
+                    _log.error("batch_eta_failed", error=str(e))
+                    minutes = None
+                if minutes is not None:
+                    import math
+
+                    for (i, r), m, ts in zip(ok, minutes, iso):
+                        if math.isfinite(m):
+                            r["properties"]["eta_minutes_ml"] = round(
+                                float(m), 4)
+                            r["properties"]["eta_completion_time_ml"] = str(ts)
+        return {"count": len(items), "items": results}, 200
 
     # ── prediction ─────────────────────────────────────────────────────
 

@@ -1,0 +1,188 @@
+"""Batched route optimization (optimize/engine.optimize_route_batch +
+/api/optimize_route_batch): one vmapped device solve for many problems,
+with per-item results identical to the single path."""
+
+import numpy as np
+import pytest
+
+from routest_tpu.optimize.engine import optimize_route, optimize_route_batch
+from routest_tpu.optimize.vrp import solve_host, solve_host_batch, trips_cost
+
+PTS = [[14.5836, 121.0409], [14.5355, 121.0621], [14.5866, 121.0566],
+       [14.5507, 121.0262], [14.6091, 121.0223], [14.5657, 121.0614],
+       [14.5531, 121.0513], [14.6368, 121.0327]]
+
+
+def _body(n_dest, cap=9999, maxd=1_000_000, start=1, vehicle="car", **extra):
+    body = {
+        "source_point": {"lat": PTS[0][0], "lon": PTS[0][1]},
+        "destination_points": [
+            {"lat": p[0], "lon": p[1], "payload": 1}
+            for p in PTS[start:start + n_dest]],
+        "driver_details": {"driver_name": "t", "vehicle_type": vehicle,
+                           "vehicle_capacity": cap,
+                           "maximum_distance": maxd},
+    }
+    body.update(extra)
+    return body
+
+
+def test_solve_host_batch_matches_single():
+    rng = np.random.default_rng(0)
+    dists, dems, caps, maxds = [], [], [], []
+    for n in (3, 5, 9, 2):  # mixed sizes pad to one program
+        m = rng.uniform(100, 5000, (n + 1, n + 1)).astype(np.float32)
+        m = (m + m.T) / 2
+        np.fill_diagonal(m, 0)
+        dists.append(m)
+        dems.append(rng.uniform(0.5, 2.0, n).astype(np.float32))
+        caps.append(4.0)
+        maxds.append(30_000.0)
+    batch = solve_host_batch(dists, dems, caps, maxds)
+    for i in range(len(dists)):
+        single = solve_host(dists[i], dems[i], caps[i], maxds[i])
+        assert batch[i] == single
+
+
+def test_solve_host_batch_refine_matches_single_cost_or_better():
+    rng = np.random.default_rng(1)
+    dists, dems = [], []
+    for n in (6, 10):
+        pts = rng.uniform(0, 10_000, (n + 1, 2))
+        m = np.linalg.norm(pts[:, None] - pts[None, :],
+                           axis=-1).astype(np.float32)
+        dists.append(m)
+        dems.append(np.ones(n, np.float32))
+    caps = [4.0, 4.0]
+    maxds = [1e9, 1e9]
+    batch = solve_host_batch(dists, dems, caps, maxds, refine=True)
+    for i in range(2):
+        greedy = solve_host(dists[i], dems[i], caps[i], maxds[i])
+        single = solve_host(dists[i], dems[i], caps[i], maxds[i], refine=True)
+        cb = trips_cost(dists[i], batch[i]["trips"])
+        # batch refine runs fixed rounds (no early exit): no worse than
+        # greedy, and matching the single refiner within rounding.
+        assert cb <= trips_cost(dists[i], greedy["trips"]) + 1e-3
+        assert cb <= trips_cost(dists[i], single["trips"]) + 1.0
+
+
+def test_engine_batch_matches_single_features():
+    items = [_body(3), _body(5, start=2), _body(2, vehicle="truck"),
+             _body(4, refine=True)]
+    batch = optimize_route_batch(items)
+    for item, got in zip(items, batch):
+        want = optimize_route(item)
+        assert got == want
+
+
+def test_engine_batch_point_to_point_and_errors_in_place():
+    items = [
+        _body(1),                                  # point-to-point
+        {"destination_points": [{"lat": 1, "lon": 2}]},  # missing source
+        _body(3, road_graph=True),                 # rejected in batch
+        _body(2, cap="NaN-ish"),                   # malformed details
+        _body(3),                                  # valid after errors
+    ]
+    out = optimize_route_batch(items)
+    assert out[0] == optimize_route(items[0])
+    assert out[1]["error"] == "no source point specified."
+    assert "per-problem" in out[2]["error"]
+    assert "vehicle_capacity" in out[3]["error"]
+    assert out[4] == optimize_route(items[4])
+
+
+def test_nonfinite_constraints_rejected_not_hung():
+    # NaN capacity makes greedy_vrp's feasibility mask vacuous — the
+    # while_loop would spin forever on device. Both paths must reject it
+    # up front (json.loads happily parses NaN/Infinity).
+    nan_item = _body(3, cap=float("nan"))
+    inf_item = _body(3, cap=float("inf"))
+    nan_pay = _body(2)
+    nan_pay["destination_points"][0]["payload"] = float("nan")
+    nan_coord = _body(2)
+    nan_coord["destination_points"][0]["lat"] = float("nan")
+    for item in (nan_item, inf_item):
+        assert "finite" in optimize_route(item)["error"]
+    assert "finite" in optimize_route(nan_pay)["error"]
+    assert "lat/lon" in optimize_route(nan_coord)["error"]
+    out = optimize_route_batch([nan_item, _body(3), nan_pay, nan_coord])
+    assert "finite" in out[0]["error"]
+    assert out[1] == optimize_route(_body(3))  # batch-mates unaffected
+    assert "finite" in out[2]["error"]
+    assert "lat/lon" in out[3]["error"]
+    # the library boundary guards too (inf capacity would let padded
+    # phantom stops through)
+    with pytest.raises(ValueError, match="finite"):
+        solve_host_batch([np.zeros((3, 3), np.float32)],
+                         [np.ones(2, np.float32)], [np.inf], [1e9])
+
+
+def test_top_k_one_allowed_in_batch():
+    # top_k=1 is a no-op on the single path; batch must accept it too.
+    item = _body(3, top_k=1)
+    out = optimize_route_batch([item])
+    assert out[0] == optimize_route(item)
+    assert "alternatives" not in out[0]["properties"]
+    assert "per-problem" in optimize_route_batch(
+        [_body(3, top_k=3)])[0]["error"]
+
+
+def test_varying_batch_sizes_share_programs():
+    # Batch-axis padding: different problem counts must reuse the padded
+    # (b_pad, p) programs — assert correctness across counts (the
+    # compile-sharing itself shows as identical padded shapes).
+    for count in (1, 2, 3, 5):
+        items = [_body(2 + (j % 3)) for j in range(count)]
+        out = optimize_route_batch(items)
+        for item, got in zip(items, out):
+            assert got == optimize_route(item)
+
+
+def test_engine_batch_size_guard():
+    out = optimize_route_batch([_body(2)] * 257)
+    assert "batch too large" in out[0]["error"]
+    assert optimize_route_batch([]) == [{"error":
+                                         "items must be a non-empty list"}]
+
+
+@pytest.fixture(scope="module")
+def client():
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config
+    from routest_tpu.serve.app import create_app
+
+    return Client(create_app(Config()))
+
+
+def test_http_batch_endpoint(client):
+    r = client.post("/api/optimize_route_batch", json={
+        "items": [_body(3), _body(1), {"bogus": True}],
+        "use_ml_eta": True,
+        "context": {"weather": "Cloudy", "traffic": "High"},
+    })
+    assert r.status_code == 200
+    out = r.get_json()
+    assert out["count"] == 3
+    f0, f1, f2 = out["items"]
+    assert f0["properties"]["summary"]["distance"] > 0
+    assert "eta_minutes_ml" in f0["properties"]
+    assert "eta_minutes_ml" in f1["properties"]
+    assert "error" in f2  # in place, not poisoning the rest
+    # ETA parity with the single endpoint's scoring on the same summary
+    single = client.post("/api/predict_eta", json={
+        "summary": f0["properties"]["summary"],
+        "weather": "Cloudy", "traffic": "High"}).get_json()
+    assert abs(single["eta_minutes_ml"]
+               - f0["properties"]["eta_minutes_ml"]) < 0.01
+
+
+def test_http_batch_endpoint_guards(client):
+    assert client.post("/api/optimize_route_batch",
+                       json={}).status_code == 400
+    assert client.post("/api/optimize_route_batch",
+                       json={"items": ["nope"]}).status_code == 400
+    big = client.post("/api/optimize_route_batch",
+                      json={"items": [_body(2)] * 257})
+    assert big.status_code == 400
+    assert "batch too large" in big.get_json()["error"]
