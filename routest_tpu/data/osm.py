@@ -27,6 +27,7 @@ Only nodes referenced by kept ways survive, re-indexed contiguously.
 from __future__ import annotations
 
 import gzip
+import math
 import os
 import xml.etree.ElementTree as ET
 from typing import Dict, IO, Tuple
@@ -49,13 +50,28 @@ _KMH_TO_MPS = 1.0 / 3.6
 
 def _parse_maxspeed(value: str) -> float:
     """OSM maxspeed text → m/s; raises ValueError on non-numeric forms
-    (``"walk"``, ``"none"``, zone refs) so the caller falls back."""
+    (``"walk"``, ``"none"``, zone refs) so the caller falls back.
+
+    Deliberately stricter than bare ``float()``: hex forms, digit
+    underscores, and inf/nan are rejected too — they never appear in
+    real OSM data, and the native scanner
+    (``native/fastfeat.cpp:parse_float``) applies the identical rule so
+    the two paths stay observably identical."""
+
+    def strict(text: str) -> float:
+        if not text or any(c not in "0123456789.+-eE" for c in text):
+            raise ValueError(f"non-numeric maxspeed: {text!r}")
+        out = float(text)
+        if not math.isfinite(out):
+            raise ValueError(f"non-finite maxspeed: {text!r}")
+        return out
+
     text = value.strip().lower()
     if text.endswith("mph"):
-        return float(text[:-3].strip()) * _MPH_TO_MPS
+        return strict(text[:-3].strip()) * _MPH_TO_MPS
     if text.endswith("km/h"):
         text = text[:-4].strip()
-    return float(text) * _KMH_TO_MPS
+    return strict(text) * _KMH_TO_MPS
 
 
 def _open(path: str) -> IO[bytes]:
@@ -74,6 +90,35 @@ def load_osm(path: str) -> Dict[str, np.ndarray]:
     """
     if not os.path.exists(path):
         raise FileNotFoundError(path)
+
+    # Native fast path (routest_tpu/native: C++ scanner, ~10× the
+    # ElementTree walk on metro extracts): exact-parity semantics,
+    # verified by tests; ANY parser anomaly returns None and this
+    # function proceeds with the ElementTree path below, which owns the
+    # slow-path semantics and all error messages. ROUTEST_NATIVE=0
+    # disables, like every native path.
+    from routest_tpu import native
+
+    if native.available():
+        # The scanner needs the (decompressed) bytes in memory; cap the
+        # slurp so a country-scale extract streams through the O(1)-
+        # memory ElementTree path below instead of ballooning host RSS.
+        cap = int(os.environ.get("ROUTEST_NATIVE_OSM_MAX_BYTES",
+                                 str(256 * 1024 * 1024)))
+        with _open(path) as f:
+            buf = f.read(cap + 1)
+        parsed = (native.parse_osm(buf, _CLASS_SPEED_MPS)
+                  if len(buf) <= cap else None)
+        del buf
+        if parsed is not None:
+            senders = parsed["senders"]
+            receivers = parsed["receivers"]
+            node_coords = parsed["node_coords"]
+            parsed["length_m"] = haversine_np(
+                node_coords[senders, 0], node_coords[senders, 1],
+                node_coords[receivers, 0], node_coords[receivers, 1],
+            ).astype(np.float32)
+            return parsed
 
     coords: Dict[int, Tuple[float, float]] = {}
     # per edge: (from_osm_id, to_osm_id, road_class, speed, both_ways)

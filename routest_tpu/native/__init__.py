@@ -98,7 +98,12 @@ def load() -> Optional[ctypes.CDLL]:
             i32p, i32p, i32p, i32p, f32p, f32p, f32p,
             ctypes.POINTER(ctypes.c_int64)]
         lib.ff_parse_csv.restype = ctypes.c_int64
-        if lib.ff_abi_version() != 1:
+        lib.ff_osm_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")]
+        lib.ff_osm_parse.restype = ctypes.POINTER(_FfOsmResult)
+        lib.ff_osm_free.argtypes = [ctypes.POINTER(_FfOsmResult)]
+        if lib.ff_abi_version() != 2:
             return None
         _lib = lib
         return _lib
@@ -125,6 +130,53 @@ def encode_batch(weather_idx: np.ndarray, traffic_idx: np.ndarray,
         np.ascontiguousarray(driver_age, np.float32),
         n, out)
     return out
+
+
+class _FfOsmResult(ctypes.Structure):
+    _fields_ = [
+        ("code", ctypes.c_int32),
+        ("n_nodes", ctypes.c_int32),
+        ("n_edges", ctypes.c_int64),
+        ("lat", ctypes.POINTER(ctypes.c_double)),
+        ("lon", ctypes.POINTER(ctypes.c_double)),
+        ("senders", ctypes.POINTER(ctypes.c_int32)),
+        ("receivers", ctypes.POINTER(ctypes.c_int32)),
+        ("cls", ctypes.POINTER(ctypes.c_int32)),
+        ("speed", ctypes.POINTER(ctypes.c_float)),
+    ]
+
+
+def parse_osm(buf: bytes, class_speed_mps) -> Optional[dict]:
+    """Native OSM XML parse → partial road-graph dict (topology, classes,
+    speeds; lengths are computed by the caller from coordinates, same as
+    the Python path). Returns None when the parser reports ANY anomaly —
+    the caller falls back to the ElementTree path, which owns both the
+    slow-path semantics and the error messages. Caller guarantees
+    ``available()``."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    speeds = np.ascontiguousarray(class_speed_mps, np.float32)
+    assert len(speeds) == 3
+    ptr = lib.ff_osm_parse(buf, len(buf), speeds)
+    if not ptr:
+        return None
+    try:
+        r = ptr.contents
+        if r.code != 0 or r.n_edges == 0:
+            return None
+        n, e = int(r.n_nodes), int(r.n_edges)
+        lat = np.ctypeslib.as_array(r.lat, (n,)).copy()
+        lon = np.ctypeslib.as_array(r.lon, (n,)).copy()
+        out = {
+            "node_coords": np.stack([lat, lon], axis=1).astype(np.float32),
+            "senders": np.ctypeslib.as_array(r.senders, (e,)).copy(),
+            "receivers": np.ctypeslib.as_array(r.receivers, (e,)).copy(),
+            "road_class": np.ctypeslib.as_array(r.cls, (e,)).copy(),
+            "speed_limit": np.ctypeslib.as_array(r.speed, (e,)).copy(),
+        }
+        return out
+    finally:
+        lib.ff_osm_free(ptr)
 
 
 def _pack_vocab(vocab) -> bytes:

@@ -217,7 +217,338 @@ int64_t ff_parse_csv(const char* path,
     return row;
 }
 
+// ── OSM XML road-network parsing ───────────────────────────────────────
+// Native fast path for routest_tpu/data/osm.py:load_osm — same observable
+// semantics (drivable-highway filter, maxspeed parsing, oneway handling,
+// used-node compaction in ascending-osm-id order, document-order edge
+// emission) so the Python wrapper can assert exact parity. On ANY parse
+// anomaly the parser returns a nonzero code and Python falls back to the
+// ElementTree path, which owns the error messages.
+
+}  // extern "C"
+
+#include <algorithm>
+#include <cctype>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct OsmSegment {
+    int64_t a, b;
+    int32_t cls;
+    float speed;
+    uint8_t both;
+};
+
+// Mirrors data/osm.py:_HIGHWAY_CLASS exactly.
+int32_t highway_class(const std::string& v) {
+    static const std::unordered_map<std::string, int32_t> m = {
+        {"motorway", 0}, {"motorway_link", 0}, {"trunk", 0},
+        {"trunk_link", 0}, {"primary", 0}, {"primary_link", 0},
+        {"secondary", 1}, {"secondary_link", 1}, {"tertiary", 1},
+        {"tertiary_link", 1}, {"unclassified", 2}, {"residential", 2},
+        {"living_street", 2}, {"service", 2},
+    };
+    auto it = m.find(v);
+    return it == m.end() ? -1 : it->second;
+}
+
+std::string lower_strip(const std::string& s) {
+    size_t b = 0, e = s.size();
+    while (b < e && std::isspace((unsigned char)s[b])) ++b;
+    while (e > b && std::isspace((unsigned char)s[e - 1])) --e;
+    std::string out = s.substr(b, e - b);
+    for (char& c : out) c = (char)std::tolower((unsigned char)c);
+    return out;
+}
+
+std::string to_lower(const std::string& s) {
+    std::string out = s;
+    for (char& c : out) c = (char)std::tolower((unsigned char)c);
+    return out;
+}
+
+// Strict decimal float parse. Deliberately NARROWER than both strtod and
+// Python float(): hex forms ("0x20"), inf/nan, and digit underscores
+// ("1_0") are rejected — the Python path's _parse_maxspeed applies the
+// same strictness so the two stay observably identical (none of these
+// forms appear in real OSM data; they only matter for parity).
+bool parse_float(const std::string& s, double* out) {
+    if (s.empty()) return false;
+    for (char c : s) {
+        if (!(std::isdigit((unsigned char)c) || c == '.' || c == '+' ||
+              c == '-' || c == 'e' || c == 'E'))
+            return false;
+    }
+    char* end = nullptr;
+    *out = strtod(s.c_str(), &end);
+    return end && *end == '\0' && std::isfinite(*out);
+}
+
+// data/osm.py:_parse_maxspeed: "50" | "50 km/h" (kmh) | "30 mph".
+bool parse_maxspeed(const std::string& raw, double* mps) {
+    std::string t = lower_strip(raw);
+    double v;
+    if (t.size() > 3 && t.compare(t.size() - 3, 3, "mph") == 0) {
+        if (!parse_float(lower_strip(t.substr(0, t.size() - 3)), &v))
+            return false;
+        *mps = v * 0.44704;
+        return true;
+    }
+    if (t.size() > 4 && t.compare(t.size() - 4, 4, "km/h") == 0)
+        t = lower_strip(t.substr(0, t.size() - 4));
+    if (!parse_float(t, &v)) return false;
+    *mps = v / 3.6;
+    return true;
+}
+
+struct Scanner {
+    const char* p;
+    const char* end;
+
+    // Parse attributes of the tag at p (p just past the name) until the
+    // closing '>'; returns false on EOF/malformation. Handles both quote
+    // styles. Leaves p past '>'.
+    bool attrs(std::vector<std::pair<std::string, std::string>>* out) {
+        out->clear();
+        while (p < end) {
+            while (p < end && std::isspace((unsigned char)*p)) ++p;
+            if (p >= end) return false;
+            if (*p == '/' || *p == '?') { ++p; continue; }
+            if (*p == '>') { ++p; return true; }
+            const char* ks = p;
+            while (p < end && *p != '=' && *p != '>' &&
+                   !std::isspace((unsigned char)*p)) ++p;
+            if (p >= end || *p != '=') return false;
+            std::string key(ks, p - ks);
+            ++p;
+            if (p >= end || (*p != '"' && *p != '\'')) return false;
+            const char q = *p++;
+            const char* vs = p;
+            while (p < end && *p != q) ++p;
+            if (p >= end) return false;
+            out->emplace_back(std::move(key), std::string(vs, p - vs));
+            ++p;
+        }
+        return false;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+struct FfOsmResult {
+    int32_t code;        // 0 ok; 1 malformed; 2 nothing drivable/usable
+    int32_t n_nodes;
+    int64_t n_edges;
+    double* lat;
+    double* lon;
+    int32_t* senders;
+    int32_t* receivers;
+    int32_t* cls;
+    float* speed;
+};
+
+void ff_osm_free(FfOsmResult* r) {
+    if (!r) return;
+    free(r->lat); free(r->lon); free(r->senders); free(r->receivers);
+    free(r->cls); free(r->speed); free(r);
+}
+
+FfOsmResult* ff_osm_parse(const char* buf, int64_t len,
+                          const float* class_speed /* 3 defaults, m/s */) {
+    FfOsmResult* res = (FfOsmResult*)calloc(1, sizeof(FfOsmResult));
+    if (!res) return nullptr;
+    std::unordered_map<int64_t, std::pair<double, double>> coords;
+    std::vector<OsmSegment> segments;
+
+    Scanner sc{buf, buf + len};
+    std::vector<std::pair<std::string, std::string>> at;
+    bool in_way = false;
+    bool root_seen = false, root_closed = false;
+    std::string root_name;
+    std::vector<int64_t> way_nodes;
+    int32_t way_cls = -1;
+    std::string way_maxspeed;      // raw LAST maxspeed tag value
+    bool way_has_maxspeed = false;
+    std::string way_oneway = "no";
+
+    auto flush_way = [&]() {
+        if (way_cls < 0 || way_nodes.size() < 2) return;
+        // Python keeps the LAST maxspeed tag and falls back to the class
+        // default only if THAT value fails to parse — so parse at flush,
+        // not per tag.
+        double spd = (double)class_speed[way_cls];
+        double mps;
+        if (way_has_maxspeed && parse_maxspeed(way_maxspeed, &mps))
+            spd = mps;
+        // Python lowercases WITHOUT stripping ("yes " stays two-way).
+        std::string ow = to_lower(way_oneway);
+        bool rev = ow == "-1";
+        bool both = !(ow == "yes" || ow == "true" || ow == "1" || rev);
+        for (size_t i = 0; i + 1 < way_nodes.size(); ++i) {
+            int64_t a = way_nodes[i], b = way_nodes[i + 1];
+            if (rev) { int64_t t = a; a = b; b = t; }
+            segments.push_back({a, b, way_cls, (float)spd,
+                                (uint8_t)(both ? 1 : 0)});
+        }
+    };
+
+    while (sc.p < sc.end) {
+        const char* lt = (const char*)memchr(sc.p, '<', sc.end - sc.p);
+        if (!lt) break;
+        sc.p = lt + 1;
+        if (sc.p >= sc.end) { res->code = 1; return res; }
+        if (*sc.p == '!') {  // comment/decl: skip past it wholesale so a
+            // '<' inside "<!-- ... -->" can't be misread as a tag
+            if (sc.end - sc.p >= 3 && sc.p[1] == '-' && sc.p[2] == '-') {
+                const char* close = nullptr;
+                for (const char* q = sc.p + 3; q + 2 < sc.end; ++q)
+                    if (q[0] == '-' && q[1] == '-' && q[2] == '>') {
+                        close = q + 3;
+                        break;
+                    }
+                if (!close) { res->code = 1; return res; }
+                sc.p = close;
+            }
+            continue;
+        }
+        if (*sc.p == '?') continue;  // xml declaration
+        bool closing = *sc.p == '/';
+        if (closing) ++sc.p;
+        const char* ns = sc.p;
+        while (sc.p < sc.end && !std::isspace((unsigned char)*sc.p) &&
+               *sc.p != '>' && *sc.p != '/') ++sc.p;
+        std::string name(ns, sc.p - ns);
+        if (closing) {
+            if (name == "way") {
+                if (!in_way) { res->code = 1; return res; }
+                flush_way();
+                in_way = false;
+            }
+            if (root_seen && name == root_name) root_closed = true;
+            continue;  // skip to '>' via next memchr
+        }
+        if (!root_seen) {
+            root_seen = true;
+            root_name = name;
+        }
+        if (!sc.attrs(&at)) { res->code = 1; return res; }
+        if (name == "node") {
+            int64_t id = 0; double la = 0, lo = 0;
+            bool has_id = false, has_la = false, has_lo = false;
+            for (auto& kv : at) {
+                double v;
+                if (kv.first == "id") {
+                    char* e = nullptr;
+                    id = strtoll(kv.second.c_str(), &e, 10);
+                    has_id = e && *e == '\0' && !kv.second.empty();
+                } else if (kv.first == "lat" && parse_float(kv.second, &v)) {
+                    la = v; has_la = true;
+                } else if (kv.first == "lon" && parse_float(kv.second, &v)) {
+                    lo = v; has_lo = true;
+                }
+            }
+            if (has_id && has_la && has_lo) coords[id] = {la, lo};
+        } else if (name == "way") {
+            if (in_way) { res->code = 1; return res; }  // unclosed way
+            in_way = true;
+            way_nodes.clear();
+            way_cls = -1;
+            way_has_maxspeed = false;
+            way_oneway = "no";
+        } else if (name == "nd" && in_way) {
+            for (auto& kv : at)
+                if (kv.first == "ref") {
+                    char* e = nullptr;
+                    int64_t r = strtoll(kv.second.c_str(), &e, 10);
+                    if (e && *e == '\0' && !kv.second.empty())
+                        way_nodes.push_back(r);
+                }
+        } else if (name == "tag" && in_way) {
+            std::string k, v;
+            bool has_v = false;
+            for (auto& kv : at) {
+                if (kv.first == "k") k = kv.second;
+                else if (kv.first == "v") { v = kv.second; has_v = true; }
+            }
+            if (!has_v) continue;  // Python skips tags with no v attribute
+            if (k == "highway") way_cls = highway_class(v);
+            else if (k == "maxspeed") {
+                way_maxspeed = v;       // last tag wins; parsed at flush
+                way_has_maxspeed = true;
+            } else if (k == "oneway") way_oneway = v;
+        }
+    }
+    // Truncated document (no root close, or a way left open at EOF):
+    // the ElementTree path raises — never hand back a silently partial
+    // street network.
+    if (!root_seen || !root_closed || in_way) { res->code = 1; return res; }
+    if (segments.empty()) { res->code = 2; return res; }
+
+    // Used-node compaction in ascending osm-id order (matches Python's
+    // sorted-set indexing exactly).
+    std::vector<int64_t> used;
+    used.reserve(coords.size());
+    {
+        std::unordered_map<int64_t, uint8_t> seen;
+        for (auto& s : segments) {
+            for (int64_t ref : {s.a, s.b}) {
+                if (coords.count(ref) && !seen.count(ref)) {
+                    seen[ref] = 1;
+                    used.push_back(ref);
+                }
+            }
+        }
+    }
+    std::sort(used.begin(), used.end());
+    std::unordered_map<int64_t, int32_t> index;
+    index.reserve(used.size());
+    for (size_t i = 0; i < used.size(); ++i)
+        index[used[i]] = (int32_t)i;
+
+    std::vector<int32_t> snd, rcv, cls;
+    std::vector<float> spd;
+    for (auto& s : segments) {
+        auto ia = index.find(s.a), ib = index.find(s.b);
+        if (ia == index.end() || ib == index.end() || s.a == s.b) continue;
+        snd.push_back(ia->second); rcv.push_back(ib->second);
+        cls.push_back(s.cls); spd.push_back(s.speed);
+        if (s.both) {
+            snd.push_back(ib->second); rcv.push_back(ia->second);
+            cls.push_back(s.cls); spd.push_back(s.speed);
+        }
+    }
+    if (snd.empty()) { res->code = 2; return res; }
+
+    res->n_nodes = (int32_t)used.size();
+    res->n_edges = (int64_t)snd.size();
+    res->lat = (double*)malloc(sizeof(double) * used.size());
+    res->lon = (double*)malloc(sizeof(double) * used.size());
+    res->senders = (int32_t*)malloc(sizeof(int32_t) * snd.size());
+    res->receivers = (int32_t*)malloc(sizeof(int32_t) * snd.size());
+    res->cls = (int32_t*)malloc(sizeof(int32_t) * snd.size());
+    res->speed = (float*)malloc(sizeof(float) * snd.size());
+    if (!res->lat || !res->lon || !res->senders || !res->receivers ||
+        !res->cls || !res->speed) {
+        ff_osm_free(res);
+        return nullptr;
+    }
+    for (size_t i = 0; i < used.size(); ++i) {
+        res->lat[i] = coords[used[i]].first;
+        res->lon[i] = coords[used[i]].second;
+    }
+    memcpy(res->senders, snd.data(), sizeof(int32_t) * snd.size());
+    memcpy(res->receivers, rcv.data(), sizeof(int32_t) * rcv.size());
+    memcpy(res->cls, cls.data(), sizeof(int32_t) * cls.size());
+    memcpy(res->speed, spd.data(), sizeof(float) * spd.size());
+    return res;
+}
+
 // ── version stamp (cache invalidation for the build wrapper) ───────────
-int ff_abi_version() { return 1; }
+int ff_abi_version() { return 2; }
 
 }  // extern "C"

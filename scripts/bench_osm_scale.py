@@ -94,6 +94,9 @@ def main() -> None:
 
     report = {
         "backend": backend,
+        "extract": (args.keep if args.keep else "regenerate via --keep"),
+        "generator": f"routest_tpu.data.road_graph.generate_road_graph("
+                     f"n_nodes={args.nodes}, seed=0) via this script",
         "nodes": int(router.n_nodes),
         "edges": int(len(router.senders)),
         "extract_mb": round(size_mb, 2),

@@ -202,3 +202,136 @@ def test_saved_extract_routes(tmp_path):
     legs = router.route_legs(pts)
     d, dur, poly = legs.leg(0, 1)
     assert np.isfinite(d) and d > 0 and dur > 0 and len(poly) >= 3
+
+
+def test_native_parser_parity_with_elementtree(tmp_path, monkeypatch):
+    # The native C++ scanner must be observably identical to the
+    # ElementTree path on everything it accepts: same node compaction
+    # order, same edge order, same classes/speeds/lengths.
+    from routest_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    from routest_tpu.data.osm import save_osm
+    from routest_tpu.data.road_graph import generate_road_graph
+
+    gen = str(tmp_path / "gen.osm.gz")
+    save_osm(gen, generate_road_graph(n_nodes=160, seed=11))
+    for path in (FIXTURE, gen):
+        fast = load_osm(path)
+        monkeypatch.setattr(native, "available", lambda: False)
+        slow = load_osm(path)
+        monkeypatch.undo()
+        assert set(fast) == set(slow)
+        for key in slow:
+            np.testing.assert_array_equal(fast[key], slow[key], err_msg=key)
+
+
+def test_native_parser_handles_oneway_and_maxspeed_variants(tmp_path,
+                                                            monkeypatch):
+    from routest_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    xml = """<?xml version="1.0"?>
+<osm>
+  <!-- comment with <node id="99" lat="0" lon="0"/> inside -->
+  <node id="1" lat="14.50" lon="121.00"/>
+  <node id="2" lat="14.51" lon="121.01"/>
+  <node id="3" lat="14.52" lon="121.02"/>
+  <way id="10"><nd ref="1"/><nd ref="2"/><nd ref="3"/>
+    <tag k="highway" v="primary"/><tag k="maxspeed" v="30 mph"/>
+    <tag k="oneway" v="-1"/></way>
+  <way id="11"><nd ref="2"/><nd ref="3"/>
+    <tag k="highway" v="residential"/>
+    <tag k="maxspeed" v="walk"/></way>
+  <way id="12"><nd ref="1"/><nd ref="3"/>
+    <tag k="highway" v="footway"/></way>
+</osm>"""
+    path = tmp_path / "variants.osm"
+    path.write_text(xml)
+    fast = load_osm(str(path))
+    monkeypatch.setattr(native, "available", lambda: False)
+    slow = load_osm(str(path))
+    monkeypatch.undo()
+    for key in slow:
+        np.testing.assert_array_equal(fast[key], slow[key], err_msg=key)
+    # oneway=-1 reverses the drawing direction: edges 2->1 and 3->2
+    assert (fast["senders"][:2].tolist(),
+            fast["receivers"][:2].tolist()) == ([1, 2], [0, 1])
+    np.testing.assert_allclose(fast["speed_limit"][0], 30 * 0.44704,
+                               rtol=1e-6)
+    # non-numeric maxspeed falls back to the residential default
+    assert fast["speed_limit"][2] == np.float32(5.6)
+
+
+def test_native_parity_on_review_divergence_cases(tmp_path, monkeypatch):
+    # Cases found diverging in review, now locked to parity: truncated
+    # document, whitespace-padded oneway, last-maxspeed-wins, hex/inf
+    # maxspeed, v-less highway tag.
+    from routest_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+
+    def both(path):
+        fast = load_osm(path)
+        monkeypatch.setattr(native, "available", lambda: False)
+        slow = load_osm(path)
+        monkeypatch.undo()
+        for key in slow:
+            np.testing.assert_array_equal(fast[key], slow[key], err_msg=key)
+        return fast
+
+    head = ('<osm><node id="1" lat="14.5" lon="121.0"/>'
+            '<node id="2" lat="14.51" lon="121.01"/>'
+            '<node id="3" lat="14.52" lon="121.02"/>')
+    way = ('<way id="9"><nd ref="1"/><nd ref="2"/>'
+           '<tag k="highway" v="primary"/>{extra}</way>')
+
+    cases = {
+        "oneway_pad": way.format(extra='<tag k="oneway" v="yes "/>'),
+        "maxspeed_last": way.format(
+            extra='<tag k="maxspeed" v="50"/><tag k="maxspeed" v="walk"/>'),
+        "maxspeed_hex": way.format(extra='<tag k="maxspeed" v="0x20"/>'),
+        "maxspeed_inf": way.format(extra='<tag k="maxspeed" v="inf"/>'),
+        "highway_no_v": way.format(extra='<tag k="highway"/>'),
+    }
+    for name, body in cases.items():
+        p = tmp_path / f"{name}.osm"
+        p.write_text(head + body + "</osm>")
+        both(str(p))
+    # padded oneway counts as TWO-way on both paths (python lowercases
+    # without stripping)
+    pad = load_osm(str(tmp_path / "oneway_pad.osm"))
+    assert len(pad["senders"]) == 2
+    # last maxspeed tag wins, and unparseable LAST means class default
+    last = load_osm(str(tmp_path / "maxspeed_last.osm"))
+    assert last["speed_limit"][0] == np.float32(11.1)
+    for bad in ("maxspeed_hex", "maxspeed_inf"):
+        assert load_osm(str(tmp_path / f"{bad}.osm"))["speed_limit"][0] \
+            == np.float32(11.1)
+
+    # truncation: BOTH paths refuse a partial street network
+    full = head + way.format(extra="") + \
+        way.format(extra="").replace('id="9"', 'id="10"') + "</osm>"
+    trunc = tmp_path / "trunc.osm"
+    trunc.write_text(full[: int(len(full) * 0.7)])
+    with pytest.raises(ValueError):
+        load_osm(str(trunc))
+    monkeypatch.setattr(native, "available", lambda: False)
+    with pytest.raises(ValueError):
+        load_osm(str(trunc))
+    monkeypatch.undo()
+
+
+def test_native_slurp_cap_falls_back_to_streaming(monkeypatch):
+    from routest_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    # An extract over the cap must still load (ElementTree path), not
+    # OOM or error.
+    monkeypatch.setenv("ROUTEST_NATIVE_OSM_MAX_BYTES", "100")
+    g = load_osm(FIXTURE)
+    assert len(g["node_coords"]) == 18
