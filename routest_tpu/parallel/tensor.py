@@ -78,15 +78,7 @@ def tp_param_specs(model: EtaMLP, data_axis: str = "data",
 
 
 def _validate(model: EtaMLP, tp: int) -> None:
-    if getattr(model, "quantiles", ()):
-        # The epilogue below hard-codes heads 0/1 as (pace, overhead); a
-        # quantile model's heads 0/1 are the q0/q1 pace increments —
-        # running it would be silently wrong, so refuse for every caller
-        # (EtaService catches this and serves the replicated XLA path).
-        raise ValueError(
-            "tensor-parallel apply/loss implement the 2-head point "
-            "epilogue; quantile models are not supported")
-    dims = tuple(model.hidden) + (2,)
+    dims = tuple(model.hidden) + (model.n_heads,)
     modes = _layer_modes(len(dims))
     for i, (mode, d_out) in enumerate(zip(modes, dims)):
         if mode == "col" and d_out % tp:
@@ -145,9 +137,20 @@ def make_tp_apply(model: EtaMLP, mesh: Mesh, data_axis: str = "data",
             if i < n_layers - 1:
                 h = jax.nn.gelu(h)
         out = h.astype(model.policy.output_dtype)
+        d = dist_km.astype(model.policy.output_dtype)
+        n_q = len(getattr(model, "quantiles", ()) or ())
+        if n_q:
+            # Same non-crossing cumulative epilogue as
+            # EtaMLP.apply_quantiles — the head activation is full-width
+            # on every device here (a row-parallel final layer psums, a
+            # replicated one never sharded), so the epilogue is
+            # layout-independent. Output (B, Q).
+            pace = jnp.cumsum(jax.nn.softplus(out[..., :n_q]), axis=-1)
+            overhead = jnp.cumsum(jax.nn.softplus(out[..., n_q:]), axis=-1)
+            return pace * d[..., None] + overhead
         pace = jax.nn.softplus(out[..., 0])
         overhead = jax.nn.softplus(out[..., 1])
-        return pace * dist_km.astype(model.policy.output_dtype) + overhead
+        return pace * d + overhead
 
     return jax.jit(tp_forward)
 
@@ -158,7 +161,12 @@ def make_tp_loss(model: EtaMLP, mesh: Mesh, data_axis: str = "data",
 
     Differentiable end-to-end (XLA differentiates psum/all_gather), so
     ``jax.grad`` of this IS the tensor-parallel training step's core.
+    Point models only: the quantile objective is pinball, not MSE — TP
+    *serving* of quantile models goes through :func:`make_tp_apply`.
     """
+    if getattr(model, "quantiles", ()):
+        raise ValueError("TP training implements the point-model MSE "
+                         "objective; train quantile models data-parallel")
     tp_apply_inner = make_tp_apply(model, mesh, data_axis, model_axis)
 
     def loss(params, x, y):

@@ -143,18 +143,33 @@ def test_serving_exposes_uncertainty_band(trained, tmp_path):
                .get("eta_minutes_ml_p10")) < 1e-3
 
 
-def test_tp_and_fused_helpers_refuse_quantile_models(mesh_runtime):
-    # The TP shard_map epilogue and the Pallas pack hard-code heads 0/1
-    # as (pace, overhead); for a quantile model those are q0/q1 pace
-    # increments — the shared helpers must refuse for EVERY caller, not
-    # rely on EtaService remembering to check.
+def test_tp_serves_quantiles_and_fused_refuses(mesh_runtime):
+    # The TP epilogue generalizes to the quantile heads (full-width head
+    # activation on every device), so tensor-parallel SERVING of
+    # quantile models is real — asserted against the dense oracle. The
+    # Pallas pack and TP TRAINING (MSE objective) still refuse.
+    import numpy as np
+    from jax.sharding import Mesh
+
     from routest_tpu.ops.fused_mlp import pack_eta_params
-    from routest_tpu.parallel.tensor import make_tp_apply
+    from routest_tpu.parallel.tensor import (make_tp_apply, make_tp_loss,
+                                             shard_tp_params)
 
     model = EtaMLP(hidden=(16, 8), policy=F32_POLICY, quantiles=Q)
     params = model.init(jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="quantile"):
-        make_tp_apply(model, mesh_runtime.mesh)
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2),
+                ("data", "model"))
+    with mesh:
+        tp_apply = make_tp_apply(model, mesh)
+        tp_params = shard_tp_params(params, model, mesh)
+        x = batch_from_mapping(generate_dataset(64, seed=5))
+        got = np.asarray(tp_apply(tp_params, jax.numpy.asarray(x)))
+    want = np.asarray(model.apply_quantiles(params, x))
+    assert got.shape == (64, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert (np.diff(got, axis=1) >= 0).all()  # non-crossing survives TP
+    with pytest.raises(ValueError, match="point-model"):
+        make_tp_loss(model, mesh)
     with pytest.raises(ValueError, match="quantile"):
         pack_eta_params(model, params)
 
@@ -222,6 +237,31 @@ def test_nonfinite_band_values_drop_to_null(trained, tmp_path):
     assert out["eta_minutes_ml"][0] is not None
     assert out["eta_minutes_ml_p90"] == [None]
     assert out["eta_minutes_ml_p10"][0] is not None
+
+
+def test_tp_serving_of_quantile_artifact(trained, tmp_path):
+    # End-to-end: a quantile artifact behind a model>1 mesh serves the
+    # band through the xla_tp kernel, matching replicated serving.
+    from routest_tpu.core.config import MeshConfig, ServeConfig
+    from routest_tpu.core.mesh import MeshRuntime
+    from routest_tpu.serve.ml_service import EtaService
+    from routest_tpu.train.checkpoint import save_model
+
+    model, result, _ = trained
+    path = str(tmp_path / "tp_q.msgpack")
+    save_model(path, model, result.state.params)
+    rt = MeshRuntime.create(MeshConfig(data=4, model=2))
+    tp = EtaService(ServeConfig(), model_path=path, runtime=rt)
+    assert tp.kernel == "xla_tp" and tp.quantiles == Q
+    plain = EtaService(ServeConfig(), model_path=path)
+    kw = dict(weather="Stormy", traffic="Jam", distance_m=9000.0,
+              pickup_time=None, driver_age=40)
+    eta_tp, _, bands_tp = tp.predict_eta_quantiles(**kw)
+    eta_pl, _, bands_pl = plain.predict_eta_quantiles(**kw)
+    assert abs(eta_tp - eta_pl) < 1e-3
+    assert set(bands_tp) == {"p10", "p90"}
+    for k in bands_tp:
+        assert abs(bands_tp[k] - bands_pl[k]) < 1e-3
 
 
 def test_point_model_serving_adds_no_band_fields():
