@@ -234,6 +234,52 @@ class _RelocateOut(NamedTuple):
     trip_ids: jax.Array
 
 
+class _TourViews(NamedTuple):
+    """Fixed-shape per-position views over a (order, trip_ids) tour —
+    the shared analysis prologue of every cross-trip refiner (relocate,
+    swap, Or-opt-2). Padded positions are zeroed via the masks."""
+
+    active: jax.Array     # (N,) bool — position holds a stop
+    nodes: jax.Array      # (N,) all_points index of the stop (0 if pad)
+    dem: jax.Array        # (N,) demand at the position
+    same_prev: jax.Array  # (N,) previous position is same trip
+    prev: jax.Array       # (N,) previous node along the trip (0 = origin)
+    same_next: jax.Array  # (N,) next position is same trip
+    nxt: jax.Array        # (N,) next node along the trip (0 = origin)
+    loads: jax.Array      # (T=N,) per-trip load
+    tripdist: jax.Array   # (T=N,) per-trip closed-tour distance
+
+
+def _tour_views(dist: jax.Array, demands: jax.Array, order: jax.Array,
+                trip_ids: jax.Array) -> _TourViews:
+    n = order.shape[0]
+    pos = jnp.arange(n)
+    active = order >= 0
+    nodes = jnp.where(active, order + 1, 0)
+    dem = jnp.where(active, demands[jnp.clip(order, 0)], 0.0)
+    same_prev = jnp.concatenate(
+        [jnp.zeros((1,), jnp.bool_),
+         (trip_ids[1:] == trip_ids[:-1]) & (trip_ids[1:] >= 0)])
+    prev = jnp.where(
+        same_prev,
+        jnp.concatenate([jnp.zeros((1,), nodes.dtype), nodes[:-1]]), 0)
+    same_next = jnp.concatenate(
+        [(trip_ids[:-1] == trip_ids[1:]) & (trip_ids[:-1] >= 0),
+         jnp.zeros((1,), jnp.bool_)])
+    nxt = jnp.where(
+        same_next,
+        jnp.concatenate([nodes[1:], jnp.zeros((1,), nodes.dtype)]), 0)
+    # Per-trip load and closed-tour distance (one-hot segment sums;
+    # T = N upper-bounds the trip count).
+    tid_oh = ((trip_ids[None, :] == pos[:, None]) & active[None, :])
+    loads = (tid_oh * dem[None, :]).sum(axis=1)
+    leg_in = jnp.where(active, dist[prev, nodes], 0.0)
+    ret = jnp.where(active & ~same_next, dist[nodes, 0], 0.0)
+    tripdist = (tid_oh * (leg_in + ret)[None, :]).sum(axis=1)
+    return _TourViews(active, nodes, dem, same_prev, prev, same_next, nxt,
+                      loads, tripdist)
+
+
 @jax.jit
 def refine_relocate(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
                     max_distance: jax.Array, order: jax.Array,
@@ -264,29 +310,10 @@ def refine_relocate(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
 
     def analyze(order, trip_ids):
         """Best move: (delta, i, target_pos, tgt_trip)."""
-        active = order >= 0
-        nodes = jnp.where(active, order + 1, 0)
-        dem = jnp.where(active, demands[jnp.clip(order, 0)], 0.0)
-        same_prev = jnp.concatenate(
-            [jnp.zeros((1,), jnp.bool_),
-             (trip_ids[1:] == trip_ids[:-1]) & (trip_ids[1:] >= 0)])
-        prev = jnp.where(
-            same_prev,
-            jnp.concatenate([jnp.zeros((1,), nodes.dtype), nodes[:-1]]), 0)
-        same_next = jnp.concatenate(
-            [(trip_ids[:-1] == trip_ids[1:]) & (trip_ids[:-1] >= 0),
-             jnp.zeros((1,), jnp.bool_)])
-        nxt = jnp.where(
-            same_next,
-            jnp.concatenate([nodes[1:], jnp.zeros((1,), nodes.dtype)]), 0)
-
-        # Per-trip load and closed-tour distance (one-hot segment sums;
-        # T = N upper-bounds the trip count).
-        tid_oh = ((trip_ids[None, :] == pos[:, None]) & active[None, :])
-        loads = (tid_oh * dem[None, :]).sum(axis=1)                   # (T,)
-        leg_in = jnp.where(active, dist[prev, nodes], 0.0)
-        ret = jnp.where(active & ~same_next, dist[nodes, 0], 0.0)
-        tripdist = (tid_oh * (leg_in + ret)[None, :]).sum(axis=1)     # (T,)
+        v = _tour_views(dist, demands, order, trip_ids)
+        active, nodes, dem = v.active, v.nodes, v.dem
+        same_prev, prev, nxt = v.same_prev, v.prev, v.nxt
+        loads, tripdist = v.loads, v.tripdist
 
         # Removal gain of stop at position i.
         gain = dist[prev, nodes] + dist[nodes, nxt] - dist[prev, nxt]  # (N,)
@@ -391,27 +418,10 @@ def refine_swap(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
     big = jnp.asarray(jnp.inf, dist.dtype)
 
     def analyze(order):
-        active = order >= 0
-        nodes = jnp.where(active, order + 1, 0)
-        dem = jnp.where(active, demands[jnp.clip(order, 0)], 0.0)
-        same_prev = jnp.concatenate(
-            [jnp.zeros((1,), jnp.bool_),
-             (trip_ids[1:] == trip_ids[:-1]) & (trip_ids[1:] >= 0)])
-        prev = jnp.where(
-            same_prev,
-            jnp.concatenate([jnp.zeros((1,), nodes.dtype), nodes[:-1]]), 0)
-        same_next = jnp.concatenate(
-            [(trip_ids[:-1] == trip_ids[1:]) & (trip_ids[:-1] >= 0),
-             jnp.zeros((1,), jnp.bool_)])
-        nxt = jnp.where(
-            same_next,
-            jnp.concatenate([nodes[1:], jnp.zeros((1,), nodes.dtype)]), 0)
-
-        tid_oh = ((trip_ids[None, :] == pos[:, None]) & active[None, :])
-        loads = (tid_oh * dem[None, :]).sum(axis=1)
-        leg_in = jnp.where(active, dist[prev, nodes], 0.0)
-        ret = jnp.where(active & ~same_next, dist[nodes, 0], 0.0)
-        tripdist = (tid_oh * (leg_in + ret)[None, :]).sum(axis=1)
+        v = _tour_views(dist, demands, order, trip_ids)
+        active, nodes, dem = v.active, v.nodes, v.dem
+        prev, nxt = v.prev, v.nxt
+        loads, tripdist = v.loads, v.tripdist
 
         # replace_cost[i, j] = new edge cost at position i if node_j sat
         # there; replace_cost[i, i]-diagonal is the current cost
@@ -454,6 +464,126 @@ def refine_swap(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
 
 refine_swap_batch = jax.jit(
     jax.vmap(refine_swap, in_axes=(0, 0, 0, 0, 0, 0)))
+
+
+@jax.jit
+def refine_oropt2(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
+                  max_distance: jax.Array, order: jax.Array,
+                  trip_ids: jax.Array) -> _RelocateOut:
+    """Or-opt-2: relocate an ADJACENT PAIR of stops as one unit — within
+    a trip or across trips — when it shortens the tour and stays
+    feasible.
+
+    The move the other passes cannot make: relocate (Or-opt-1) moves one
+    stop at a time, so a misplaced pair whose first stop only pays off
+    once its partner follows sits at a local optimum; swap exchanges
+    1-for-1; 2-opt reverses within a trip. Moving the pair keeps its
+    internal leg (orientation preserved — reversals are 2-opt's job) and
+    re-prices only the three boundary legs.
+
+    Same fixed-shape recipe as :func:`refine_relocate`: O(N²) pair/slot
+    deltas as gathers, best improving move applied as an index
+    permutation, ``lax.while_loop`` to fixpoint. Symmetric matrix
+    assumed, like the other refiners.
+    """
+    n = order.shape[0]
+    pos = jnp.arange(n)
+    demands = demands.astype(dist.dtype)
+    big = jnp.asarray(jnp.inf, dist.dtype)
+
+    def analyze(order, trip_ids):
+        v = _tour_views(dist, demands, order, trip_ids)
+        active, nodes, dem = v.active, v.nodes, v.dem
+        same_prev, prev, same_next, nxt = (v.same_prev, v.prev,
+                                           v.same_next, v.nxt)
+        loads, tripdist = v.loads, v.tripdist
+
+        # Pair at (i, i+1): second element's node / next-link, rolled so
+        # lane i carries the whole segment.
+        s2 = jnp.concatenate([nodes[1:], jnp.zeros((1,), nodes.dtype)])
+        nxt2 = jnp.concatenate([nxt[1:], jnp.zeros((1,), nxt.dtype)])
+        dem2 = jnp.concatenate([dem[1:], jnp.zeros((1,), dem.dtype)])
+        pair_ok = active & same_next          # i+1 exists, same trip
+        pair_dem = dem + dem2
+
+        # Removal gain of the pair (internal leg travels with it).
+        gain = dist[prev, nodes] + dist[s2, nxt2] - dist[prev, nxt2]
+
+        # Insertion: after stop j, or before the head of j's trip.
+        ins_after = (dist[nodes[None, :], nodes[:, None]]
+                     + dist[s2[:, None], nxt[None, :]]
+                     - dist[nodes, nxt][None, :])
+        ins_head = (dist[0, nodes][:, None]
+                    + dist[s2[:, None], nodes[None, :]]
+                    - dist[0, nodes][None, :])
+        costs = jnp.stack([ins_after, ins_head])               # (2, N, N)
+
+        src = trip_ids[:, None]
+        tgt = trip_ids[None, :]
+        same_trip = src == tgt
+        delta = costs - gain[:, None][None, :, :]
+
+        cap_ok = jnp.where(
+            same_trip, True,
+            loads[jnp.clip(tgt, 0)] + pair_dem[:, None] <= capacity)
+        # Cross-trip, the pair's INTERNAL leg moves into the target trip
+        # too (boundary-only `costs` doesn't count it; same-trip it
+        # cancels inside gain).
+        internal = jnp.where(pair_ok, dist[nodes, s2], 0.0)
+        newdist = jnp.where(
+            same_trip,
+            tripdist[jnp.clip(src, 0)] + costs - gain[:, None],
+            tripdist[jnp.clip(tgt, 0)] + costs
+            + internal[:, None][None, :, :])
+        dist_ok = newdist <= max_distance + 1e-3
+
+        valid_base = (pair_ok[:, None] & active[None, :]
+                      & (pos[None, :] != pos[:, None])
+                      & (pos[None, :] != pos[:, None] + 1))
+        # after-mode no-op: back after the pair's own predecessor
+        after_noop = same_trip & (pos[None, :] == pos[:, None] - 1)
+        head_j = active & ~same_prev
+        valid = jnp.stack([valid_base & ~after_noop,
+                           valid_base & head_j[None, :]]) & cap_ok & dist_ok
+
+        scored = jnp.where(valid, delta, big)
+        flat = jnp.argmin(scored.reshape(-1))
+        best_delta = scored.reshape(-1)[flat]
+        mode = flat // (n * n)
+        ij = flat % (n * n)
+        i, j = ij // n, ij % n
+        # Final START position of the pair (block of 2): forward moves
+        # shift the block two slots less than j; see index check in
+        # tests (worked examples in both directions).
+        t_after = jnp.where(i < j, j - 1, j + 1)
+        t_head = jnp.where(i < j, j - 2, j)
+        target = jnp.where(mode == 0, t_after, t_head)
+        return best_delta, i, target, trip_ids[j]
+
+    def improving(state):
+        order, trip_ids, delta, i, t, tgt_trip, it = state
+        return (delta < -1e-3) & (it < n * n)
+
+    def apply_move(state):
+        order, trip_ids, delta, i, t, tgt_trip, it = state
+        fwd = (pos >= i) & (pos < t)           # block moved forward
+        bwd = (pos > t + 1) & (pos <= i + 1)   # block moved backward
+        perm = jnp.where(fwd, pos + 2, jnp.where(bwd, pos - 2, pos))
+        perm = jnp.where(pos == t, i, jnp.where(pos == t + 1, i + 1, perm))
+        order = order[perm]
+        trip_ids = trip_ids[perm].at[t].set(tgt_trip).at[t + 1].set(tgt_trip)
+        delta2, i2, t2, tgt2 = analyze(order, trip_ids)
+        return order, trip_ids, delta2, i2, t2, tgt2, it + 1
+
+    d0, i0, t0, g0 = analyze(order, trip_ids)
+    out = jax.lax.while_loop(
+        improving, apply_move,
+        (order, trip_ids, d0, i0, t0, g0, jnp.zeros((), jnp.int32)))
+    return _RelocateOut(order=out[0], trip_ids=out[1])
+
+
+refine_oropt2_batch = jax.jit(
+    jax.vmap(refine_oropt2, in_axes=(0, 0, 0, 0, 0, 0)))
 
 
 def trips_cost(dist: np.ndarray, trips) -> float:
@@ -522,8 +652,8 @@ def solve_host_batch(dists, demands, capacities, max_distances,
     which the solver's feasibility mask treats as pre-visited — they can
     never be routed, cost nothing, and are sliced out of the report.
 
-    ``refine=True`` runs the same 2-opt → relocate → swap rounds as
-    ``solve_host``, vmapped across the batch; rounds are fixed at
+    ``refine=True`` runs the same 2-opt → relocate → swap → Or-opt-2
+    rounds as ``solve_host``, vmapped across the batch; rounds are fixed at
     ``max_refine_rounds`` for the whole batch (every move is
     strictly-no-worse, so extra rounds are no-ops for converged
     problems — per-problem early exit would force host sync per round).
@@ -575,6 +705,8 @@ def solve_host_batch(dists, demands, capacities, max_distances,
                 dist_j, dem_j, cap_b, maxd_b, order_j, trips_j)
             order_j = refine_swap_batch(
                 dist_j, dem_j, cap_b, maxd_b, order_j, trips_j)
+            order_j, trips_j = refine_oropt2_batch(
+                dist_j, dem_j, cap_b, maxd_b, order_j, trips_j)
 
     order = np.asarray(order_j)
     trip_ids = np.asarray(trips_j)
@@ -592,12 +724,13 @@ def solve_host(dist: np.ndarray, demands: np.ndarray, capacity: float,
                max_refine_rounds: int = 4) -> dict:
     """Host-friendly wrapper: numpy in, plain python out (trips as lists).
 
-    ``refine=True`` alternates intra-trip 2-opt with cross-trip relocate
-    and cross-trip swap until none improves (opt-in so the default keeps
-    exact reference-greedy observable semantics). The moves compose:
-    relocate fixes greedy's trip assignment, swap untangles pairs that
-    capacity blocks relocate from moving, 2-opt re-sequences the changed
-    trips."""
+    ``refine=True`` alternates intra-trip 2-opt with cross-trip
+    relocate, cross-trip swap, and adjacent-pair Or-opt-2 until none
+    improves (opt-in so the default keeps exact reference-greedy
+    observable semantics). The moves compose: relocate fixes greedy's
+    trip assignment, swap untangles pairs that capacity blocks relocate
+    from moving, Or-opt-2 moves pairs whose first stop only pays off
+    once its partner follows, 2-opt re-sequences the changed trips."""
     dist_j = jnp.asarray(dist, jnp.float32)
     dem_j = jnp.asarray(demands, jnp.float32)
     cap_j = jnp.asarray(capacity, jnp.float32)
@@ -611,6 +744,8 @@ def solve_host(dist: np.ndarray, demands: np.ndarray, capacity: float,
             order_j, trips_j = refine_relocate(
                 dist_j, dem_j, cap_j, maxd_j, order_j, trips_j)
             order_j = refine_swap(
+                dist_j, dem_j, cap_j, maxd_j, order_j, trips_j)
+            order_j, trips_j = refine_oropt2(
                 dist_j, dem_j, cap_j, maxd_j, order_j, trips_j)
             new_cost = tour_cost(dist, np.asarray(order_j), np.asarray(trips_j))
             if new_cost >= cost - 1e-3:
