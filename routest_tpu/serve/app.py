@@ -521,6 +521,7 @@ def create_app(config: Optional[Config] = None,
                 "model": model_res,
                 "tpu": {
                     "devices": [str(d) for d in jax.devices()],
+                    "memory": _device_memory(jax),
                     "batcher": state.eta.stats,
                     "uptime_s": int(time.time() - state.started),
                 },
@@ -536,6 +537,29 @@ def create_app(config: Optional[Config] = None,
 
     _warm_optimizer()
     return app
+
+
+def _device_memory(jax) -> dict:
+    """Per-device HBM residency gauge (SURVEY.md §5.5 — "HBM residency"
+    is one of the TPU gauges the health contract promises). CPU backends
+    and tunnel transports may not implement memory_stats(); report what
+    exists, never fail health over a gauge."""
+    out = {}
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            used = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            if used is None:
+                continue
+            entry = {"bytes_in_use": int(used)}
+            if limit:
+                entry["bytes_limit"] = int(limit)
+                entry["utilization"] = round(used / limit, 4)
+            out[str(d)] = entry
+    except Exception:
+        pass
+    return out
 
 
 def _warm_optimizer() -> None:

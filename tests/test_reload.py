@@ -146,3 +146,55 @@ def test_watcher_thread_reloads(tmp_path):
             raise AssertionError("watcher never swapped the model in")
     finally:
         stop.set()
+
+
+def test_reload_under_concurrent_traffic(tmp_path):
+    # Hammer test for the snapshot swap: concurrent predict threads
+    # while models (point <-> quantile) swap repeatedly underneath.
+    # Every response must be internally consistent (finite median,
+    # p10 <= eta <= p90 when bands present) and no request may error.
+    import threading
+
+    from routest_tpu.models.eta_mlp import EtaMLP as _M
+
+    path = str(tmp_path / "hot.msgpack")
+    _write_model(path, seed=0)
+    svc = EtaService(ServeConfig(), model_path=path)
+    stop = threading.Event()
+    failures: list = []
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                eta, iso, bands = svc.predict_eta_quantiles(
+                    weather="Sunny", traffic="Low", distance_m=8_000,
+                    pickup_time=None)
+                if eta is None:
+                    failures.append("eta None mid-reload")
+                elif not np.isfinite(eta):
+                    failures.append(f"non-finite eta {eta}")
+                elif bands and not (bands.get("p10", -np.inf) <= eta
+                                    <= bands.get("p90", np.inf)):
+                    failures.append(f"torn band {bands} eta {eta}")
+            except Exception as e:  # pragma: no cover - the failure mode
+                failures.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for round_ in range(6):
+            if round_ % 2 == 0:
+                qm = _M(hidden=(8,), policy=F32_POLICY,
+                        quantiles=(0.1, 0.5, 0.9))
+                save_model(path, qm, qm.init(jax.random.PRNGKey(round_)))
+            else:
+                _write_model(path, seed=round_)
+            st = os.stat(path)
+            os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+            assert svc.reload_if_changed() is True
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not failures, failures[:5]
