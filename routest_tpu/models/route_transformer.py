@@ -238,14 +238,17 @@ def make_sp_train_step(model: RouteTransformer, optimizer, mesh: Mesh,
 
 def sample_route_sequences(graph: Dict[str, np.ndarray], n_routes: int,
                            seq_len: int, seed: int = 0,
-                           noise_sigma: float = 0.06) -> Tuple[np.ndarray, ...]:
+                           noise_sigma: float = 0.06,
+                           return_hours: bool = False) -> Tuple[np.ndarray, ...]:
     """Random-walk routes over a road graph → padded training tensors.
 
     Returns (feats (R, L, F), freeflow_s (R, L), targets (R, L),
-    mask (R, L)). One observation hour per ROUTE (a vehicle drives its
-    whole tour in one congestion regime); targets from the same
-    congestion overlay the GNN trains on (``data/road_graph.py``), so
-    the two learned leg-cost models are directly comparable.
+    mask (R, L)) — plus hours (R,) when ``return_hours`` (the trainer
+    uses it for the held-out-hours split). One observation hour per
+    ROUTE (a vehicle drives its whole tour in one congestion regime);
+    targets from the same congestion overlay the GNN trains on
+    (``data/road_graph.py``), so the two learned leg-cost models are
+    directly comparable.
     """
     from routest_tpu.data.road_graph import true_edge_time_s
     from routest_tpu.models.gnn import edge_feature_array
@@ -269,8 +272,10 @@ def sample_route_sequences(graph: Dict[str, np.ndarray], n_routes: int,
     speed = np.asarray(graph["speed_limit"], np.float32)
     rclass = np.asarray(graph["road_class"], np.int32)
 
+    hours = np.zeros((n_routes,), np.int32)
     for r in range(n_routes):
         hour = int(rng.integers(0, 24))
+        hours[r] = hour
         node = int(rng.integers(0, n_nodes))
         n_legs = int(rng.integers(seq_len // 2, seq_len + 1))
         edge_ids = []
@@ -295,4 +300,6 @@ def sample_route_sequences(graph: Dict[str, np.ndarray], n_routes: int,
                                   np.full(k, hour))
         targets[r, :k] = t_true * rng.lognormal(0.0, noise_sigma, k)
         mask[r, :k] = 1.0
+    if return_hours:
+        return feats, freeflow, targets, mask, hours
     return feats, freeflow, targets, mask

@@ -277,6 +277,21 @@ def _assemble_multi(p: dict, sol: dict, dist, leg_cost, leg_geom,
         which = ", ".join(str(i) for i in sol["unroutable"])
         return {"error": f"stops not routable under constraints (indices: {which})"}
 
+    # Route-context pricing: once the order is SOLVED, the transformer
+    # (when an artifact serves this graph) re-prices each trip's whole
+    # edge sequence in one forward — leg durations gain tour context no
+    # per-edge pricer can express. Distances and geometry stay from the
+    # base provider; empty dict ⇒ base pricing throughout.
+    repriced: Dict = {}
+    if legs is not None:
+        repriced = legs.reprice_trips(sol["trips"])
+        if repriced:
+            base_cost = leg_cost
+
+            def leg_cost(a: int, b: int, _base=base_cost, _r=repriced):
+                meters, seconds = _base(a, b)
+                return meters, _r.get((a, b), seconds)
+
     coords: List[List[float]] = []
     segments: List[Dict] = []
     total_dist = 0.0
@@ -369,14 +384,27 @@ def _assemble_multi(p: dict, sol: dict, dist, leg_cost, leg_geom,
                 "distance": round(alt_m, 1),
                 "duration": round(alt_s, 1),
             })
+        if repriced and alternatives:
+            # The main summary is transformer-priced; alternatives must
+            # be priced by the SAME model or their durations are not
+            # comparable (a base-priced "alternative" could look faster
+            # purely from pricer mismatch). One batched forward covers
+            # every candidate.
+            rep_durs = legs.reprice_orders(
+                [a["optimized_order"] for a in alternatives])
+            for alt, dur in zip(alternatives, rep_durs):
+                if dur is not None and math.isfinite(dur):
+                    alt["duration"] = round(dur, 1)
         feature["properties"]["alternatives"] = alternatives
 
     if use_road:
         feature["properties"]["road_graph"] = True
-        # Which pricer produced the durations: "gnn" (learned per-edge
-        # congestion) or "freeflow" physics — additive ABI for clients
-        # and tests to confirm learned costs are live.
-        feature["properties"]["leg_cost_model"] = legs.cost_model
+        # Which pricer produced the durations: "transformer" (route-
+        # context leg pricing), "gnn" (learned per-edge congestion), or
+        # "freeflow" physics — additive ABI for clients and tests to
+        # confirm learned costs are live.
+        feature["properties"]["leg_cost_model"] = (
+            "transformer" if repriced else legs.cost_model)
     _annotate(feature, driver_details, vehicle_type)
     return feature
 

@@ -121,7 +121,9 @@ class RoadRouter:
     def __init__(self, graph: Optional[Dict[str, np.ndarray]] = None,
                  n_nodes: int = 2048, seed: int = 0,
                  use_gnn: bool = True,
-                 gnn_path: Optional[str] = None) -> None:
+                 gnn_path: Optional[str] = None,
+                 use_transformer: bool = True,
+                 transformer_path: Optional[str] = None) -> None:
         g = graph if graph is not None else generate_road_graph(
             n_nodes=n_nodes, seed=seed)
         self.coords = np.asarray(g["node_coords"], np.float32)   # (N, 2)
@@ -182,6 +184,11 @@ class RoadRouter:
         self._gnn = self._load_gnn(gnn_path) if use_gnn else None
         self._hour_times: Dict[int, np.ndarray] = {}
         self._gnn_lock = threading.Lock()
+        # Route-context pricing: the route transformer re-prices a solved
+        # route's edge sequence as a whole (models/route_transformer.py);
+        # same fingerprint gate and graceful-absence contract as the GNN.
+        self._transformer = (self._load_transformer(transformer_path)
+                            if use_transformer else None)
 
     @property
     def leg_cost_model(self) -> str:
@@ -203,32 +210,59 @@ class RoadRouter:
             "speed_limit": self.speed_limit,
         }
 
-    def _load_gnn(self, path: Optional[str]):
-        """(model, params) when a compatible artifact exists, else None.
-
-        The artifact is optional by design (same contract as the ETA
-        model's ``(None, None)`` fallback, ``Flaskr/ml.py:25-26``):
-        any failure here degrades to free-flow pricing, never an error.
-        """
-        from routest_tpu.train.checkpoint import default_gnn_path, load_gnn
-
-        resolved = path or default_gnn_path()
+    def _load_leg_model(self, loader, resolved: str, tag: str):
+        """Shared load-and-fingerprint-gate for learned leg-cost
+        artifacts (road GNN, route transformer). The artifact is
+        optional by design (same contract as the ETA model's
+        ``(None, None)`` fallback, ``Flaskr/ml.py:25-26``): any failure
+        degrades to the next pricer down, never an error. Returns
+        (model, params, meta) or None; ``meta`` may be the fingerprint
+        itself or a dict carrying it under "graph"."""
         try:
-            model, params, meta = load_gnn(resolved)
+            model, params, meta = loader(resolved)
         except FileNotFoundError:
             return None
         except Exception as e:  # corrupt/foreign artifact: degrade, log
             get_logger("routest.road").warning(
-                "road_gnn_artifact_unusable", path=resolved,
+                f"{tag}_artifact_unusable", path=resolved,
                 error=f"{type(e).__name__}: {e}")
             return None
-        if meta != self._fingerprint:
+        fp = meta.get("graph", meta) if isinstance(meta, dict) else meta
+        if fp != self._fingerprint:
             # Expected whenever a custom/test graph is routed; debug only.
             get_logger("routest.road").debug(
-                "road_gnn_graph_mismatch", path=resolved,
-                artifact=meta, router=self._fingerprint)
+                f"{tag}_graph_mismatch", path=resolved,
+                artifact=fp, router=self._fingerprint)
             return None
+        return model, params, meta
+
+    def _load_gnn(self, path: Optional[str]):
+        from routest_tpu.train.checkpoint import default_gnn_path, load_gnn
+
+        loaded = self._load_leg_model(
+            load_gnn, path or default_gnn_path(), "road_gnn")
+        if loaded is None:
+            return None
+        model, params, _meta = loaded
         return model, params
+
+    @property
+    def has_transformer(self) -> bool:
+        return self._transformer is not None
+
+    def _load_transformer(self, path: Optional[str]):
+        """(model, params, trained_seq_len) when a fingerprint-compatible
+        route-transformer artifact exists, else None."""
+        from routest_tpu.train.checkpoint import (default_transformer_path,
+                                                  load_transformer)
+
+        loaded = self._load_leg_model(
+            load_transformer, path or default_transformer_path(),
+            "route_transformer")
+        if loaded is None:
+            return None
+        model, params, meta = loaded
+        return model, params, int(meta.get("seq_len", 24))
 
     def edge_time_s(self, hour: int) -> np.ndarray:
         """(E,) per-edge car travel seconds at the given hour-of-day.
@@ -413,9 +447,11 @@ class RoadRouter:
         snap_m = haversine_np(
             points_latlon[:, 0], points_latlon[:, 1],
             self.coords[nodes, 0], self.coords[nodes, 1]).astype(np.float32)
-        time_s = self.edge_time_s(12 if hour is None else hour)
+        eff_hour = 12 if hour is None else int(hour) % 24
+        time_s = self.edge_time_s(eff_hour)
         return RoadLegs(self, points_latlon, nodes, dist, pred, snap_m,
-                        time_scale, time_s, self.leg_cost_model)
+                        time_scale, time_s, self.leg_cost_model,
+                        hour=eff_hour)
 
 
 _SNAP_SPEED_MPS = 8.3  # first/last-mile charged at collector free-flow
@@ -428,8 +464,10 @@ class RoadLegs:
                  nodes: np.ndarray, dist: np.ndarray, pred: np.ndarray,
                  snap_m: np.ndarray, time_scale: float,
                  time_s: Optional[np.ndarray] = None,
-                 cost_model: str = "freeflow") -> None:
+                 cost_model: str = "freeflow",
+                 hour: int = 12) -> None:
         self._r = router
+        self._hour = hour
         self._points = points
         self._nodes = nodes
         self._pred = pred
@@ -465,6 +503,126 @@ class RoadLegs:
                 + (self._snap_m[i] + self._snap_m[j]) / _SNAP_SPEED_MPS)
             out = (node_seq, float(self.dist_m[i, j]), float(dur))
         self._cost_memo[(i, j)] = out
+        return out
+
+    def reprice_trips(self, trips) -> Dict[Tuple[int, int], float]:
+        """Route-context leg durations from the route transformer.
+
+        ``trips`` is the solved assignment (lists of destination indices,
+        ``solve_host`` form). Each trip's legs concatenate into ONE edge
+        sequence (origin → stops → origin) and the transformer re-prices
+        every edge with route context — per-leg times then depend on
+        where in the tour the leg sits, which per-edge pricers (GNN,
+        free-flow) cannot express. Returns ``{(i, j): duration_s}`` per
+        leg, or ``{}`` when no transformer artifact serves this graph /
+        any leg is unwalkable (callers keep base pricing — the same
+        graceful-degradation contract as every model here).
+
+        Trips in the solved assignment are stop-disjoint, so (i, j) leg
+        keys cannot collide across trips. For ALTERNATIVE orders over
+        the same stops use :meth:`reprice_orders` (list-shaped, no keys).
+        """
+        per_trip = self._reprice([[int(s) for s in t] for t in trips])
+        if per_trip is None:
+            return {}
+        out: Dict[Tuple[int, int], float] = {}
+        for legs in per_trip:
+            out.update(legs)
+        return out
+
+    def reprice_orders(self, orders):
+        """Transformer durations for CANDIDATE single-trip orders:
+        list of stop-index orders → list of total route seconds (None
+        per order when unavailable). One batched forward prices every
+        candidate, so alternatives stay comparable with the
+        transformer-priced main summary."""
+        per_trip = self._reprice([[int(s) for s in o] for o in orders])
+        if per_trip is None:
+            return [None] * len(orders)
+        return [sum(d for _, d in legs.items()) for legs in per_trip]
+
+    def _reprice(self, trips):
+        """Shared core: list of trips (stop-index lists) → list of
+        ``{(i, j): duration_s}`` per trip, or None when the transformer
+        is unavailable / any leg is unwalkable.
+
+        Tours longer than the artifact's trained ``seq_len`` are CHUNKED
+        into seq_len windows with window-local positions — exactly the
+        training distribution (each training route starts at position 0
+        and is ≤ seq_len legs) — so long metro tours never push the
+        model out of its validated envelope, and attention cost stays
+        O(seq_len²) per window instead of O(tour²).
+        """
+        t = self._r._transformer
+        if t is None or not trips:
+            return None
+        from routest_tpu.models.gnn import edge_feature_array
+
+        model, params, seq_len = t
+        r = self._r
+        # (trip index, leg key, edge ids) per leg, in tour order.
+        trip_legs: list = []
+        for trip in trips:
+            seq = [0] + [s + 1 for s in trip] + [0]
+            legs = []
+            for a, b in zip(seq[:-1], seq[1:]):
+                if a == b:
+                    continue
+                node_seq, _m, _s = self._walk_cost(a, b)
+                if not node_seq:
+                    return None  # unwalkable leg: keep base pricing
+                legs.append(((a, b),
+                             [int(self._pred[a][n]) for n in node_seq[1:]]))
+            trip_legs.append(legs)
+
+        # Flatten every trip's edge sequence into seq_len windows.
+        windows: list = []   # (trip_idx, [edge ids])
+        for ti, legs in enumerate(trip_legs):
+            edges = [e for _, leg_edges in legs for e in leg_edges]
+            for start in range(0, len(edges), seq_len):
+                windows.append((ti, edges[start: start + seq_len]))
+        if not windows:
+            return [dict() for _ in trip_legs]
+        s_max = max(len(w) for _, w in windows)
+        feats = np.zeros((len(windows), s_max, model.n_features), np.float32)
+        freeflow = np.zeros((len(windows), s_max), np.float32)
+        mask = np.zeros((len(windows), s_max), np.float32)
+        for wi, (_, edges) in enumerate(windows):
+            e_ids = np.asarray(edges, np.int64)
+            k = len(e_ids)
+            feats[wi, :k] = edge_feature_array(
+                r.length_m[e_ids], r.speed_limit[e_ids],
+                r.road_class[e_ids], self._hour)
+            freeflow[wi, :k] = r.freeflow_time_s[e_ids]
+            mask[wi, :k] = 1.0
+        import jax.numpy as jnp
+
+        pred = np.asarray(model.apply(
+            params, jnp.asarray(feats), jnp.asarray(freeflow),
+            jnp.arange(s_max), key_mask=jnp.asarray(mask)), np.float32)
+
+        # Stitch window predictions back into per-trip edge streams.
+        stream: Dict[int, list] = {ti: [] for ti in range(len(trip_legs))}
+        for wi, (ti, edges) in enumerate(windows):
+            stream[ti].extend(pred[wi, : len(edges)].tolist())
+        out: list = []
+        for ti, legs in enumerate(trip_legs):
+            flat = stream[ti]
+            offset = 0
+            priced: Dict[Tuple[int, int], float] = {}
+            for (a, b), edges in legs:
+                k = len(edges)
+                e_ids = np.asarray(edges, np.int64)
+                # Same physical floor as the GNN pricer: no edge beats
+                # free-flow at an arterial ceiling.
+                leg_pred = np.maximum(
+                    np.asarray(flat[offset: offset + k], np.float32),
+                    r.length_m[e_ids] / 16.7)
+                offset += k
+                priced[(a, b)] = float(self._time_scale * (
+                    float(leg_pred.sum())
+                    + (self._snap_m[a] + self._snap_m[b]) / _SNAP_SPEED_MPS))
+            out.append(priced)
         return out
 
     def cost(self, i: int, j: int) -> Tuple[float, float]:

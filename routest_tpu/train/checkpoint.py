@@ -298,6 +298,70 @@ def default_gnn_path() -> str:
     )
 
 
+# ── Route-transformer serving artifact ────────────────────────────────────
+
+TRANSFORMER_ARTIFACT_VERSION = 1
+
+
+def save_transformer(path: str, model, params, graph: dict,
+                     seq_len: int) -> None:
+    """Route-transformer leg-cost artifact — same fingerprinting contract
+    as the road GNN: the router serves it only when its training graph
+    matches the routable (post-bridge) graph. ``seq_len`` (the trained
+    route length) is recorded so serving can chunk longer tours into
+    in-distribution windows."""
+    header = json.dumps(
+        {
+            "format": "routest_tpu.route_transformer",
+            "version": TRANSFORMER_ARTIFACT_VERSION,
+            "d_model": int(model.d_model),
+            "n_heads": int(model.n_heads),
+            "n_layers": int(model.n_layers),
+            "d_mlp": int(model.d_mlp),
+            "seq_len": int(seq_len),
+            "graph": graph_fingerprint(
+                graph["node_coords"], graph["senders"], graph["receivers"],
+                graph["length_m"]),
+        }
+    ).encode() + b"\n"
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    blob = serialization.msgpack_serialize(host_params)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(header)
+        f.write(blob)
+
+
+def load_transformer(path: str):
+    """→ (RouteTransformer, params, meta) where meta carries the graph
+    fingerprint and the trained ``seq_len``."""
+    from routest_tpu.models.route_transformer import RouteTransformer
+
+    header, blob = _read_artifact(
+        path, MAGIC, "routest_tpu.route_transformer",
+        (TRANSFORMER_ARTIFACT_VERSION,),
+        kind="routest_tpu model artifact",
+        retrain_hint="retrain via scripts/train_transformer.py")
+    model = RouteTransformer(d_model=header["d_model"],
+                             n_heads=header["n_heads"],
+                             n_layers=header["n_layers"],
+                             d_mlp=header["d_mlp"])
+    params = serialization.msgpack_restore(blob)
+    params = jax.tree_util.tree_map(np.asarray, params)
+    return model, params, {"graph": header.get("graph") or {},
+                           "seq_len": int(header.get("seq_len", 24))}
+
+
+def default_transformer_path() -> str:
+    """``ROUTE_TRANSFORMER_PATH`` env override, then the in-repo artifact."""
+    return os.getenv("ROUTE_TRANSFORMER_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "artifacts",
+        "route_transformer.msgpack",
+    )
+
+
 # ── Orbax training checkpoints ────────────────────────────────────────────
 
 def save_checkpoint(ckpt_dir: str, step: int, state) -> None:

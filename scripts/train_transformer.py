@@ -1,0 +1,170 @@
+"""Train the route-sequence transformer as a serving leg-cost model.
+
+The transformer (models/route_transformer.py) predicts per-leg travel
+seconds with ROUTE context — where in the tour a leg sits, what
+surrounds it — which the per-edge pricers (road GNN, free-flow physics)
+cannot express. This script trains it on random-walk routes over the
+EXACT routable graph a server aggregates (RoadRouter's post-bridge edge
+set, same contract as scripts/train_gnn.py), evaluates against naive
+physics on held-out routes AND held-out hours, and saves a
+fingerprinted artifact the router serves automatically
+(``optimize/road_router.py:_load_transformer`` →
+``properties.leg_cost_model == "transformer"``).
+
+Usage: python scripts/train_transformer.py [--nodes 2048] [--steps 300]
+       [--routes 768] [--seq-len 24] [--osm PATH] [--quick] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HELD_OUT_HOURS = (7, 12, 17)  # same non-circular protocol as train_gnn
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=2048)
+    parser.add_argument("--steps", type=int, default=300)
+    parser.add_argument("--routes", type=int, default=768)
+    parser.add_argument("--seq-len", type=int, default=24)
+    parser.add_argument("--batch", type=int, default=128)
+    parser.add_argument("--osm", default=None, metavar="PATH")
+    parser.add_argument("--save", default=None)
+    parser.add_argument("--no-save", action="store_true")
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    args = parser.parse_args()
+    if args.quick:
+        args.nodes, args.steps, args.routes = 512, 80, 256
+    if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from routest_tpu.core.cache import enable_compile_cache
+    from routest_tpu.data.road_graph import generate_road_graph
+    from routest_tpu.models.route_transformer import (RouteTransformer,
+                                                      sample_route_sequences)
+    from routest_tpu.optimize.road_router import RoadRouter
+    from routest_tpu.train.checkpoint import (default_transformer_path,
+                                              save_transformer)
+
+    enable_compile_cache()
+    if args.osm:
+        from routest_tpu.data.osm import load_osm
+
+        router = RoadRouter(graph=load_osm(args.osm), use_gnn=False,
+                            use_transformer=False)
+        print(f"[1/3] OSM graph {args.osm}: {router.n_nodes} nodes")
+    else:
+        router = RoadRouter(
+            graph=generate_road_graph(n_nodes=args.nodes, k=4, seed=0),
+            use_gnn=False, use_transformer=False)
+        print(f"[1/3] graph: {router.n_nodes} nodes")
+    graph = router.graph_dict()  # post-bridge: the serving fingerprint
+
+    feats, freeflow, targets, mask, hours = sample_route_sequences(
+        graph, args.routes, args.seq_len, seed=0, return_hours=True)
+    ev_feats, ev_ff, ev_targets, ev_mask, ev_hours = sample_route_sequences(
+        graph, max(128, args.routes // 4), args.seq_len, seed=1,
+        return_hours=True)
+    # Non-circular split: training never sees HELD_OUT_HOURS labels.
+    keep = ~np.isin(hours, HELD_OUT_HOURS)
+    feats, freeflow, targets, mask = (feats[keep], freeflow[keep],
+                                      targets[keep], mask[keep])
+    print(f"      {len(targets)} train routes "
+          f"(hours {sorted(set(HELD_OUT_HOURS))} held out), "
+          f"{len(ev_targets)} eval routes")
+
+    model = RouteTransformer()
+    params = model.init(jax.random.PRNGKey(0))
+    optimizer = optax.adamw(optax.cosine_decay_schedule(3e-4, args.steps),
+                            weight_decay=1e-4)
+    opt_state = optimizer.init(params)
+    positions = jnp.arange(args.seq_len)
+
+    @jax.jit
+    def step(params, opt_state, f, ff, y, m):
+        loss, grads = jax.value_and_grad(model.loss)(
+            params, f, ff, positions, y, m)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    print(f"[2/3] training {args.steps} steps (batch {args.batch})")
+    rng = np.random.default_rng(2)
+    t0 = time.time()
+    for i in range(args.steps):
+        idx = rng.integers(0, len(targets), args.batch)
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(feats[idx]),
+            jnp.asarray(freeflow[idx]), jnp.asarray(targets[idx]),
+            jnp.asarray(mask[idx]))
+        if (i + 1) % max(1, args.steps // 5) == 0:
+            print(f"      step {i + 1}/{args.steps} "
+                  f"loss={float(loss):.4f}")
+    train_s = time.time() - t0
+
+    pred = np.asarray(model.apply(params, jnp.asarray(ev_feats),
+                                  jnp.asarray(ev_ff), positions,
+                                  key_mask=jnp.asarray(ev_mask)))
+
+    def rmse(p, y, m):
+        m = m.astype(bool)
+        return float(np.sqrt(np.mean((p[m] - y[m]) ** 2)))
+
+    held_hours = np.isin(ev_hours, HELD_OUT_HOURS)
+    tf_rmse = rmse(pred, ev_targets, ev_mask)
+    nv_rmse = rmse(ev_ff, ev_targets, ev_mask)
+    tf_h = rmse(pred[held_hours], ev_targets[held_hours],
+                ev_mask[held_hours])
+    nv_h = rmse(ev_ff[held_hours], ev_targets[held_hours],
+                ev_mask[held_hours])
+    print(f"[3/3] eval: transformer {tf_rmse:.2f}s vs naive {nv_rmse:.2f}s "
+          f"| held-out hours: {tf_h:.2f}s vs {nv_h:.2f}s | {train_s:.1f}s")
+
+    report = {
+        "nodes": int(router.n_nodes),
+        "routes": int(len(targets)),
+        "seq_len": args.seq_len,
+        "steps": args.steps,
+        "transformer_rmse_s": tf_rmse,
+        "naive_rmse_s": nv_rmse,
+        "held_out_hours": list(HELD_OUT_HOURS),
+        "transformer_rmse_held_hours_s": tf_h,
+        "naive_rmse_held_hours_s": nv_h,
+        "train_seconds": round(train_s, 1),
+        "beats_naive": bool(tf_rmse < nv_rmse and tf_h < nv_h),
+    }
+    if args.osm:
+        report["osm"] = args.osm
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = os.path.join(repo, "artifacts", "transformer_report.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"      report → {out}")
+
+    if not args.no_save:
+        path = args.save or default_transformer_path()
+        save_transformer(path, model, params, graph, seq_len=args.seq_len)
+        print(f"      artifact → {path}")
+    sys.exit(0 if report["beats_naive"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -46,12 +46,17 @@ def test_default_router_serves_gnn_costs():
     assert r.edge_time_s(8) is rush
 
 
-def test_engine_reports_gnn_and_prices_by_hour():
+def test_engine_reports_learned_model_and_prices_by_hour():
     rush = optimize_route(_payload(pickup_time="2026-07-29T08:15:00"))
     night = optimize_route(_payload(pickup_time="2026-07-29T03:00:00"))
     assert "error" not in rush and "error" not in night
-    assert rush["properties"]["leg_cost_model"] == "gnn"
-    # Same geometry, different congestion regime.
+    # Multi-stop routes: the route transformer (when its artifact serves
+    # this graph) supersedes per-edge pricing; the GNN remains the
+    # per-edge base and still owns point-to-point (next test). Without
+    # the transformer artifact the same response reports "gnn".
+    assert rush["properties"]["leg_cost_model"] in ("transformer", "gnn")
+    # Same geometry, different congestion regime — whichever learned
+    # model prices, rush hour must cost more than 3am.
     assert (rush["properties"]["summary"]["distance"]
             == night["properties"]["summary"]["distance"])
     assert (rush["properties"]["summary"]["duration"]

@@ -1,0 +1,151 @@
+"""Route-transformer leg pricing on the serving path: train a tiny
+artifact, let the router load it (fingerprint-gated), and assert the
+optimize response reports route-context durations."""
+
+import jax
+import numpy as np
+import pytest
+
+from routest_tpu.data.road_graph import generate_road_graph
+from routest_tpu.models.route_transformer import (RouteTransformer,
+                                                  sample_route_sequences)
+from routest_tpu.optimize import road_router as rr
+from routest_tpu.optimize.engine import optimize_route
+from routest_tpu.optimize.road_router import RoadRouter
+from routest_tpu.train.checkpoint import load_transformer, save_transformer
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    # A tiny trained artifact on the 256-node test graph (quality is
+    # irrelevant here; the serving contract is what's under test).
+    import optax
+
+    graph_raw = generate_road_graph(n_nodes=256, seed=1)
+    router = RoadRouter(graph=graph_raw, use_gnn=False,
+                        use_transformer=False)
+    graph = router.graph_dict()
+    model = RouteTransformer(d_model=16, n_heads=2, n_layers=1, d_mlp=32)
+    params = model.init(jax.random.PRNGKey(0))
+    feats, ff, y, m = sample_route_sequences(graph, 64, 12, seed=0)
+    optimizer = optax.adam(3e-4)
+    opt_state = optimizer.init(params)
+    pos = jax.numpy.arange(12)
+
+    @jax.jit
+    def step(p, s, f, ffx, yx, mx):
+        loss, g = jax.value_and_grad(model.loss)(p, f, ffx, pos, yx, mx)
+        up, s = optimizer.update(g, s)
+        return optax.apply_updates(p, up), s, loss
+
+    for _ in range(10):
+        params, opt_state, _ = step(params, opt_state,
+                                    jax.numpy.asarray(feats),
+                                    jax.numpy.asarray(ff),
+                                    jax.numpy.asarray(y),
+                                    jax.numpy.asarray(m))
+    path = str(tmp_path_factory.mktemp("tf") / "route_transformer.msgpack")
+    save_transformer(path, model, params, graph, seq_len=12)
+    return path, graph_raw
+
+
+def test_artifact_roundtrip(artifact):
+    path, _ = artifact
+    model, params, meta = load_transformer(path)
+    assert model.d_model == 16 and meta  # fingerprint present
+
+
+def _payload(**extra):
+    pts = [[14.5836, 121.0409], [14.5355, 121.0621],
+           [14.5866, 121.0566], [14.5507, 121.0262]]
+    body = {
+        "source_point": {"lat": pts[0][0], "lon": pts[0][1]},
+        "destination_points": [
+            {"lat": p[0], "lon": p[1], "payload": 1} for p in pts[1:]],
+        "driver_details": {"driver_name": "t", "vehicle_type": "car",
+                           "vehicle_capacity": 9999,
+                           "maximum_distance": 1_000_000},
+        "road_graph": True,
+    }
+    body.update(extra)
+    return body
+
+
+def test_transformer_prices_served_route(artifact, monkeypatch):
+    path, graph_raw = artifact
+    router = RoadRouter(graph=graph_raw, use_gnn=False,
+                        transformer_path=path)
+    assert router.has_transformer
+    monkeypatch.setattr(rr, "_default_router", router)
+    out = optimize_route(_payload())
+    assert "error" not in out
+    p = out["properties"]
+    assert p["leg_cost_model"] == "transformer"
+    assert p["summary"]["duration"] > 0 and np.isfinite(p["summary"]["duration"])
+    # segments re-priced consistently: summary equals the segment sum
+    seg_sum = sum(s["duration"] for s in p["segments"])
+    assert abs(seg_sum - p["summary"]["duration"]) < 1.5  # rounding only
+    # distances/geometry come from the base provider, untouched
+    base_router = RoadRouter(graph=graph_raw, use_gnn=False,
+                             use_transformer=False)
+    monkeypatch.setattr(rr, "_default_router", base_router)
+    base = optimize_route(_payload())
+    assert base["properties"]["leg_cost_model"] == "freeflow"
+    assert base["properties"]["summary"]["distance"] == \
+        p["summary"]["distance"]
+    assert base["geometry"]["coordinates"] == out["geometry"]["coordinates"]
+    # durations actually differ (the model is not the physics formula)
+    assert base["properties"]["summary"]["duration"] != \
+        p["summary"]["duration"]
+
+
+def test_fingerprint_mismatch_keeps_base_pricing(artifact, monkeypatch):
+    path, _ = artifact
+    other = RoadRouter(graph=generate_road_graph(n_nodes=128, seed=9),
+                       use_gnn=False, transformer_path=path)
+    assert not other.has_transformer  # trained on a different graph
+    monkeypatch.setattr(rr, "_default_router", other)
+    out = optimize_route(_payload())
+    assert out["properties"]["leg_cost_model"] == "freeflow"
+
+
+def test_vehicle_scaling_applies_to_transformer_times(artifact, monkeypatch):
+    path, graph_raw = artifact
+    router = RoadRouter(graph=graph_raw, use_gnn=False,
+                        transformer_path=path)
+    monkeypatch.setattr(rr, "_default_router", router)
+    car = optimize_route(_payload())
+    truck = optimize_route(_payload(
+        driver_details={"driver_name": "t", "vehicle_type": "truck",
+                        "vehicle_capacity": 9999,
+                        "maximum_distance": 1_000_000}))
+    assert "error" not in truck
+    # trucks are slower: same legs, scaled durations
+    assert truck["properties"]["summary"]["duration"] > \
+        car["properties"]["summary"]["duration"]
+
+
+def test_long_tours_chunk_to_trained_windows(artifact, monkeypatch):
+    # Tours longer than the artifact's trained seq_len are chunked into
+    # window-local sequences (the training distribution), not fed as one
+    # out-of-distribution monster — verified by pricing a 10-stop tour
+    # whose edge stream far exceeds seq_len=12.
+    path, graph_raw = artifact
+    router = RoadRouter(graph=graph_raw, use_gnn=False,
+                        transformer_path=path)
+    rng = np.random.default_rng(0)
+    pts = np.concatenate([
+        np.asarray([[14.5836, 121.0409]], np.float32),
+        np.stack([rng.uniform(14.45, 14.65, 10),
+                  rng.uniform(120.95, 121.1, 10)], 1).astype(np.float32)])
+    legs = router.route_legs(pts)
+    trip = list(range(10))
+    priced = legs.reprice_trips([trip])
+    assert priced and all(np.isfinite(v) and v > 0 for v in priced.values())
+    n_edges = sum(
+        len(legs._walk_cost(a, b)[0]) - 1
+        for (a, b) in priced)
+    assert n_edges > 12  # genuinely longer than the trained window
+    # alternatives API prices candidate orders comparably
+    durs = legs.reprice_orders([trip, trip[::-1]])
+    assert all(d is not None and d > 0 for d in durs)
