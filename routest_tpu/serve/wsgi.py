@@ -12,13 +12,19 @@ from __future__ import annotations
 import json
 import re
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from werkzeug.wrappers import Request, Response
 
+from routest_tpu.utils.logging import reset_request_id, set_request_id
 from routest_tpu.utils.profiling import RequestStats
 
 _PARAM_RE = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
+# A caller-supplied correlation id is echoed only if it is shaped like
+# one (bounded, log-safe charset); anything else gets a fresh id rather
+# than injecting arbitrary bytes into every structured log line.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 # Origins the reference allows (Flaskr/__init__.py CORS config).
 _ALLOWED_ORIGIN_RE = re.compile(
@@ -65,10 +71,21 @@ class App:
 
     def __call__(self, environ, start_response):
         request = Request(environ)
+        # Correlation id: honor a well-formed X-Request-ID, else mint
+        # one; bound to the logging context for the handler's duration
+        # and echoed on the response (SURVEY.md §5.5 — the reference has
+        # no request tracing at all, bare prints only).
+        rid = request.headers.get("X-Request-ID", "")
+        if not _REQUEST_ID_RE.match(rid):
+            rid = uuid.uuid4().hex[:16]
+        token = set_request_id(rid)
         try:
             response = self._dispatch(request)
         except Exception as e:  # pragma: no cover - last-resort handler
             response = json_response({"error": f"internal error: {e}"}, 500)
+        finally:
+            reset_request_id(token)
+        response.headers["X-Request-ID"] = rid
         self._apply_cors(request, response)
         return response(environ, start_response)
 

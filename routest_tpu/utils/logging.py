@@ -8,6 +8,7 @@ monotonic-ordered wall time, and free-form fields.
 
 from __future__ import annotations
 
+import contextvars
 import datetime as dt
 import json
 import sys
@@ -15,6 +16,26 @@ import threading
 from typing import Any, Optional, TextIO
 
 _LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
+
+# Per-request correlation id (set by the WSGI layer): every log line
+# emitted while handling a request carries it, so one request's events
+# can be grepped out of interleaved multi-threaded logs. Contextvars are
+# per-thread-context, so concurrent handlers never see each other's id.
+_request_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "rtpu_request_id", default=None)
+
+
+def set_request_id(rid: Optional[str]):
+    """Bind the current context's request id; returns the reset token."""
+    return _request_id.set(rid)
+
+
+def reset_request_id(token) -> None:
+    _request_id.reset(token)
+
+
+def current_request_id() -> Optional[str]:
+    return _request_id.get()
 
 
 class JsonLogger:
@@ -35,6 +56,9 @@ class JsonLogger:
             "event": event,
             **fields,
         }
+        rid = _request_id.get()
+        if rid is not None and "request_id" not in record:
+            record["request_id"] = rid
         line = json.dumps(record, default=str)
         with self._lock:
             print(line, file=self._stream, flush=True)

@@ -1,0 +1,75 @@
+"""Request-ID tracing (serve/wsgi.py + utils/logging.py): every response
+carries a correlation id, well-formed caller ids are honored, and log
+lines emitted during a request are stamped with it."""
+
+import io
+import json
+
+from werkzeug.test import Client
+
+from routest_tpu.core.config import Config
+from routest_tpu.serve.app import create_app
+from routest_tpu.utils.logging import (JsonLogger, current_request_id,
+                                       reset_request_id, set_request_id)
+
+
+def test_logger_stamps_request_id():
+    buf = io.StringIO()
+    log = JsonLogger("t", stream=buf)
+    token = set_request_id("req-abc")
+    try:
+        log.info("hello", x=1)
+    finally:
+        reset_request_id(token)
+    log.info("outside")
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert lines[0]["request_id"] == "req-abc" and lines[0]["x"] == 1
+    assert "request_id" not in lines[1]
+    assert current_request_id() is None
+
+
+def test_context_isolation_between_threads():
+    import threading
+
+    seen = {}
+
+    def worker(name):
+        token = set_request_id(name)
+        try:
+            seen[name] = current_request_id()
+        finally:
+            reset_request_id(token)
+
+    threads = [threading.Thread(target=worker, args=(f"t{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert seen == {f"t{i}": f"t{i}" for i in range(4)}
+
+
+def test_http_responses_carry_and_honor_ids():
+    client = Client(create_app(Config()))
+    r = client.get("/api/ping")
+    minted = r.headers.get("X-Request-ID")
+    assert minted and len(minted) == 16
+
+    r2 = client.get("/api/ping", headers={"X-Request-ID": "trace-123.a_b"})
+    assert r2.headers["X-Request-ID"] == "trace-123.a_b"
+
+    # Malformed/log-unsafe ids are replaced, not echoed (newlines can't
+    # even be SENT through werkzeug's client — the regex below covers
+    # them for rawer transports).
+    for bad in ("x" * 65, "sp ace", ""):
+        rb = client.get("/api/ping", headers={"X-Request-ID": bad})
+        got = rb.headers["X-Request-ID"]
+        assert got != bad and len(got) == 16
+    from routest_tpu.serve.wsgi import _REQUEST_ID_RE
+
+    assert not _REQUEST_ID_RE.match("evil\nid")
+    assert not _REQUEST_ID_RE.match("bad;id")
+
+    # Errors carry one too (404 path).
+    r404 = client.get("/api/nope")
+    assert r404.status_code == 404 and r404.headers["X-Request-ID"]
