@@ -683,20 +683,11 @@ class EtaService:
             pickup_time = [pickup_time] * n
 
         def parse(p):
-            if isinstance(p, str):
-                try:
-                    p = dt.datetime.fromisoformat(p)
-                except ValueError:
-                    p = None
-            if not isinstance(p, dt.datetime):
-                p = dt.datetime.now()
-            if p.tzinfo is not None:
-                # Keep offset-local WALL time (drop tzinfo for datetime64):
-                # the single-row path encodes hour/weekday from the wall
-                # clock as sent, and the two endpoints must featurize the
-                # identical row identically.
-                p = p.replace(tzinfo=None)
-            return p
+            # Shared single-row semantics, then keep offset-local WALL
+            # time (drop tzinfo for datetime64): the single-row path
+            # encodes hour/weekday from the wall clock as sent, and the
+            # two endpoints must featurize the identical row identically.
+            return _parse_pickup_single(p).replace(tzinfo=None)
 
         pickups = [parse(p) for p in pickup_time]
         rows = encode_requests(

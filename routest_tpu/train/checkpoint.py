@@ -29,6 +29,24 @@ ARTIFACT_VERSION = 2
 QUANTILE_ARTIFACT_VERSION = 3
 
 
+def _write_artifact(path: str, magic: bytes, header: dict,
+                    blob: bytes) -> None:
+    """Shared artifact writer: magic prefix + one-line JSON header +
+    binary blob — the layout every artifact family speaks (see
+    :func:`_read_artifact`)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(magic)
+        f.write(json.dumps(header).encode() + b"\n")
+        f.write(blob)
+
+
+def _params_blob(params) -> bytes:
+    """Params pytree → msgpack bytes (host copies, no device refs)."""
+    return serialization.msgpack_serialize(
+        jax.tree_util.tree_map(np.asarray, params))
+
+
 def _read_artifact(path: str, magic: bytes, fmt: str, versions,
                    kind: str, retrain_hint: str):
     """Shared artifact reader: magic prefix + one-line JSON header +
@@ -69,14 +87,7 @@ def save_model(path: str, model: EtaMLP, params: Params) -> None:
     if model.quantiles:
         header_dict["version"] = QUANTILE_ARTIFACT_VERSION
         header_dict["quantiles"] = list(model.quantiles)
-    header = json.dumps(header_dict).encode() + b"\n"
-    host_params = jax.tree_util.tree_map(np.asarray, params)
-    blob = serialization.msgpack_serialize(host_params)
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(MAGIC)
-        f.write(header)
-        f.write(blob)
+    _write_artifact(path, MAGIC, header_dict, _params_blob(params))
 
 
 def load_model(path: str) -> Tuple[EtaMLP, Params]:
@@ -139,19 +150,14 @@ def export_serving_fn(path: str, model: EtaMLP, params: Params,
     (batch,) = jax_export.symbolic_shape("b")
     spec = jax.ShapeDtypeStruct((batch, model.n_features), np.float32)
     exported = jax_export.export(jax.jit(fn), platforms=tuple(platforms))(spec)
-    header = json.dumps({
+    _write_artifact(path, EXPORT_MAGIC, {
         "format": "routest_tpu.eta_stablehlo",
         "version": EXPORT_VERSION,
         "n_features": model.n_features,
         "quantiles": list(quantiles),
         "platforms": list(platforms),
         "hidden": list(model.hidden),  # informational; not needed to run
-    }).encode() + b"\n"
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(EXPORT_MAGIC)
-        f.write(header)
-        f.write(exported.serialize())
+    }, exported.serialize())
 
 
 class ExportedServingModel:
@@ -245,26 +251,17 @@ def graph_fingerprint(node_coords: np.ndarray, senders: np.ndarray,
 
 
 def save_gnn(path: str, model, params, graph: dict) -> None:
-    header = json.dumps(
-        {
-            "format": "routest_tpu.road_gnn",
-            "version": GNN_ARTIFACT_VERSION,
-            "hidden": int(model.hidden),
-            "n_rounds": int(model.n_rounds),
-            "n_nodes": int(model.n_nodes),
-            "compute_dtype": np.dtype(model.policy.compute_dtype).name,
-            "graph": graph_fingerprint(
-                graph["node_coords"], graph["senders"], graph["receivers"],
-                graph["length_m"]),
-        }
-    ).encode() + b"\n"
-    host_params = jax.tree_util.tree_map(np.asarray, params)
-    blob = serialization.msgpack_serialize(host_params)
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(MAGIC)
-        f.write(header)
-        f.write(blob)
+    _write_artifact(path, MAGIC, {
+        "format": "routest_tpu.road_gnn",
+        "version": GNN_ARTIFACT_VERSION,
+        "hidden": int(model.hidden),
+        "n_rounds": int(model.n_rounds),
+        "n_nodes": int(model.n_nodes),
+        "compute_dtype": np.dtype(model.policy.compute_dtype).name,
+        "graph": graph_fingerprint(
+            graph["node_coords"], graph["senders"], graph["receivers"],
+            graph["length_m"]),
+    }, _params_blob(params))
 
 
 def load_gnn(path: str):
@@ -310,27 +307,18 @@ def save_transformer(path: str, model, params, graph: dict,
     matches the routable (post-bridge) graph. ``seq_len`` (the trained
     route length) is recorded so serving can chunk longer tours into
     in-distribution windows."""
-    header = json.dumps(
-        {
-            "format": "routest_tpu.route_transformer",
-            "version": TRANSFORMER_ARTIFACT_VERSION,
-            "d_model": int(model.d_model),
-            "n_heads": int(model.n_heads),
-            "n_layers": int(model.n_layers),
-            "d_mlp": int(model.d_mlp),
-            "seq_len": int(seq_len),
-            "graph": graph_fingerprint(
-                graph["node_coords"], graph["senders"], graph["receivers"],
-                graph["length_m"]),
-        }
-    ).encode() + b"\n"
-    host_params = jax.tree_util.tree_map(np.asarray, params)
-    blob = serialization.msgpack_serialize(host_params)
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(MAGIC)
-        f.write(header)
-        f.write(blob)
+    _write_artifact(path, MAGIC, {
+        "format": "routest_tpu.route_transformer",
+        "version": TRANSFORMER_ARTIFACT_VERSION,
+        "d_model": int(model.d_model),
+        "n_heads": int(model.n_heads),
+        "n_layers": int(model.n_layers),
+        "d_mlp": int(model.d_mlp),
+        "seq_len": int(seq_len),
+        "graph": graph_fingerprint(
+            graph["node_coords"], graph["senders"], graph["receivers"],
+            graph["length_m"]),
+    }, _params_blob(params))
 
 
 def load_transformer(path: str):
