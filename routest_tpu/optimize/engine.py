@@ -141,11 +141,17 @@ def _parse_problem(input_data: dict) -> dict:
         return {"error": "no source point specified."}
 
     driver_details = input_data.get("driver_details") or {}
-    vehicle_type = (driver_details.get("vehicle_type") or "car").lower().strip()
+    if not isinstance(driver_details, dict):
+        return {"error": "invalid driver_details: must be an object"}
+    vehicle_type = driver_details.get("vehicle_type")
+    vehicle_type = ((vehicle_type if isinstance(vehicle_type, str) else "car")
+                    or "car").lower().strip()
     profile = geo.profile_for_vehicle(vehicle_type)
 
     source = input_data["source_point"]
     destinations = input_data["destination_points"]
+    if not isinstance(destinations, (list, tuple)):
+        return {"error": "invalid coordinates: each point needs numeric lat/lon"}
 
     try:
         cap = float(driver_details.get("vehicle_capacity", 9e12))
@@ -171,7 +177,7 @@ def _parse_problem(input_data: dict) -> dict:
     # same way on every path, before any matrix/solve work is spent.
     try:
         top_k = int(input_data.get("top_k", 0) or 0)
-    except (TypeError, ValueError):
+    except (TypeError, ValueError, OverflowError):  # int(inf) overflows
         return {"error": "top_k must be an integer"}
     try:
         demands = np.asarray(

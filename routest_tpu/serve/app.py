@@ -38,6 +38,14 @@ from routest_tpu.utils.logging import get_logger
 _log = get_logger("routest_tpu.serve")
 
 
+def _obj(value) -> dict:
+    """A client-supplied field that SHOULD be an object, defensively:
+    non-dict values (fuzz-reachable on every nested field) degrade to {}
+    so handlers fall into their normal missing-field defaults instead of
+    AttributeError 500s."""
+    return value if isinstance(value, dict) else {}
+
+
 class ServerState:
     """Everything the handlers share."""
 
@@ -99,15 +107,23 @@ def create_app(config: Optional[Config] = None,
         # does (``Flaskr/routes.py:96-116``).
         if payload.get("use_ml_eta"):
             props = result.setdefault("properties", {}) or {}
-            summary = props.get("summary", {}) or {}
-            ctx = payload.get("context") or {}
+            summary = _obj(props.get("summary"))
+            ctx = _obj(payload.get("context"))
+            try:
+                age = float(_obj(payload.get("driver_details"))
+                            .get("driver_age", 30) or 30)
+            except (TypeError, ValueError):
+                age = 30.0
+            try:
+                distance_m = float(summary.get("distance") or 0)
+            except (TypeError, ValueError):
+                distance_m = 0.0
             eta_min, eta_iso, eta_bands = state.eta.predict_eta_quantiles(
                 weather=ctx.get("weather", "Sunny"),
                 traffic=ctx.get("traffic", "Low"),
-                distance_m=float(summary.get("distance") or 0),
+                distance_m=distance_m,
                 pickup_time=dt.datetime.now(),
-                driver_age=float((payload.get("driver_details") or {})
-                                 .get("driver_age", 30) or 30),
+                driver_age=age,
             )
             if eta_min is not None:
                 props["eta_minutes_ml"] = eta_min
@@ -158,7 +174,7 @@ def create_app(config: Optional[Config] = None,
             ok = [(i, r) for i, r in enumerate(results)
                   if isinstance(r, dict) and "error" not in r]
             if ok:
-                ctx = body.get("context") or {}
+                ctx = _obj(body.get("context"))
                 try:
                     minutes, iso = state.eta.predict_eta_batch(
                         weather=[ctx.get("weather", "Sunny")] * len(ok),
@@ -190,13 +206,23 @@ def create_app(config: Optional[Config] = None,
     @app.route("/api/predict_eta", methods=("POST",))
     def predict_eta(request):
         body = get_json(request) or {}
-        summary = body.get("summary") or {}
+        summary = _obj(body.get("summary"))
+        try:
+            distance_m = float(summary.get("distance") or 0)
+            driver_age = float(body.get("driver_age", 30) or 30)
+        except (TypeError, ValueError):
+            return {"error": "distance/driver_age must be numeric"}, 400
+        # Same type rule the batch endpoint enforces: categorical fields
+        # must be strings (an unhashable dict would blow up featurization).
+        for name in ("weather", "traffic"):
+            if not isinstance(body.get(name, ""), str):
+                return {"error": f"{name} must be a string"}, 400
         eta_min, eta_iso, eta_bands = state.eta.predict_eta_quantiles(
             weather=body.get("weather", "Sunny"),
             traffic=body.get("traffic", "Low"),
-            distance_m=float(summary.get("distance") or 0),
+            distance_m=distance_m,
             pickup_time=body.get("pickup_time") or dt.datetime.now().isoformat(),
-            driver_age=float(body.get("driver_age", 30) or 30),
+            driver_age=driver_age,
         )
         if eta_min is None:
             return {"error": "model unavailable"}, 503
@@ -324,15 +350,15 @@ def create_app(config: Optional[Config] = None,
             return {"error": "driver_details and route_details required"}, 400
         # Validate the structure the simulator dereferences up front —
         # a daemon thread dying on KeyError would 200 then go silent.
-        route = data["route_details"]
-        driver = data["driver_details"]
-        coords = ((route.get("geometry") or {}).get("coordinates"))
-        summary = ((route.get("properties") or {}).get("summary"))
+        route = _obj(data["route_details"])
+        driver = _obj(data["driver_details"])
+        coords = _obj(route.get("geometry")).get("coordinates")
+        summary = _obj(route.get("properties")).get("summary")
         if not isinstance(coords, list) or not coords or not isinstance(summary, dict):
             return {"error": "route_details must carry geometry.coordinates and properties.summary"}, 400
         if not driver.get("driver_name") or not driver.get("vehicle_type"):
             return {"error": "driver_details must carry driver_name and vehicle_type"}, 400
-        if "destinations" not in (route.get("properties") or {}):
+        if "destinations" not in _obj(route.get("properties")):
             return {"error": "route_details.properties.destinations required"}, 400
         sim.start_simulation(data, state.bus.publish, state.sim_tick_range)
         return {"status": "route simulation initialized."}, 200
@@ -344,7 +370,10 @@ def create_app(config: Optional[Config] = None,
             return {"error": "no data provided in the publish request."}, 400
         try:
             event = sim.format_sse_data(data)
-        except (KeyError, ValueError) as e:
+        except (KeyError, ValueError, TypeError, OverflowError) as e:
+            # TypeError: right fields, wrong types (a dict where the ISO
+            # pickup_time string belongs); OverflowError: timedelta on an
+            # infinite/huge duration — all the same client error.
             return {"error": f"malformed tracker payload: {e}"}, 400
         state.bus.publish(str(data.get("route_id")), event)
         return {"status": "published"}, 200

@@ -133,7 +133,13 @@ _JSON_MISSING = object()
 
 
 def get_json(request: Request, silent: bool = True) -> Optional[dict]:
-    """Parse the request body as JSON (mirrors flask's get_json(silent=True)).
+    """Parse the request body as a JSON OBJECT (the declared contract:
+    every handler here speaks dict-shaped bodies). A syntactically valid
+    but non-object top level (``[1,2,3]``, ``"str"``, ``42``, bare
+    ``NaN``) coerces to None exactly like malformed JSON — handlers'
+    ``or {}`` then yields their normal "missing field" 400s instead of
+    an AttributeError 500 (fuzz-found: every POST endpoint was one
+    truthy non-dict body away from a 500).
 
     The parsed value is memoized on the request: dispatch aliases (e.g.
     ``/api/predict`` peeking at the body shape before delegating) would
@@ -149,5 +155,7 @@ def get_json(request: Request, silent: bool = True) -> Optional[dict]:
             request._rtpu_json = None
             return None
         raise
+    if not isinstance(parsed, dict):
+        parsed = None
     request._rtpu_json = parsed
     return parsed

@@ -1,0 +1,173 @@
+"""HTTP surface fuzz: structured garbage against every mutating endpoint
+must map to clean 4xx/503 responses — never a 500, never invalid JSON.
+(The reference's Flask service 500s on plenty of malformed input; this
+locks in the hardened contract.)"""
+
+import json
+import math
+import random
+
+import pytest
+from werkzeug.test import Client
+
+from routest_tpu.core.config import Config
+from routest_tpu.serve.app import create_app
+
+ENDPOINTS = [
+    "/api/request_route",
+    "/api/optimize_route",
+    "/api/optimize_route_batch",
+    "/api/predict_eta",
+    "/api/predict_eta_batch",
+    "/api/predict",
+    "/api/confirm_route",
+    "/api/update_tracker",
+]
+
+
+@pytest.fixture(scope="module")
+def client():
+    return Client(create_app(Config()))
+
+
+def _junk(rng: random.Random, depth: int = 0):
+    kinds = ["int", "float", "str", "bool", "none", "list", "dict",
+             "bigint", "nan", "inf", "neg", "unicode"]
+    k = rng.choice(kinds if depth < 3 else kinds[:5])
+    if k == "int":
+        return rng.randint(-10**6, 10**6)
+    if k == "float":
+        return rng.uniform(-1e9, 1e9)
+    if k == "str":
+        return rng.choice(["", "x", "car", "Sunny", "1e999", "null",
+                           "<script>", "2026-13-45T99:99:99"])
+    if k == "bool":
+        return rng.random() < 0.5
+    if k == "none":
+        return None
+    if k == "bigint":
+        return 10 ** rng.randint(20, 60)
+    if k == "nan":
+        return float("nan")
+    if k == "inf":
+        return float("inf") * (1 if rng.random() < 0.5 else -1)
+    if k == "neg":
+        return -rng.uniform(0, 1e12)
+    if k == "unicode":
+        return "драйвер🚚" * rng.randint(1, 3)
+    if k == "list":
+        return [_junk(rng, depth + 1) for _ in range(rng.randint(0, 4))]
+    return {rng.choice(["lat", "lon", "payload", "summary", "distance_m",
+                        "items", "weather", "traffic", "driver_age",
+                        "source_point", "destination_points",
+                        "driver_details", "vehicle_capacity",
+                        "maximum_distance", "pickup_time", "route_details",
+                        "top_k", "refine", "road_graph", "use_ml_eta",
+                        "geometry", "properties", "coordinates",
+                        "duration", "distance", "route_id", "route",
+                        "driver_name", "vehicle_type", "context", "meta",
+                        str(rng.randint(0, 99))]): _junk(rng, depth + 1)
+            for _ in range(rng.randint(0, 5))}
+
+
+def _mutate_valid(rng: random.Random):
+    """Start from a valid optimize body and corrupt one field — hits
+    deeper code paths than pure noise."""
+    body = {
+        "source_point": {"lat": 14.5836, "lon": 121.0409},
+        "destination_points": [
+            {"lat": 14.5355, "lon": 121.0621, "payload": 1},
+            {"lat": 14.5866, "lon": 121.0566, "payload": 1}],
+        "driver_details": {"driver_name": "t", "vehicle_type": "car",
+                           "vehicle_capacity": 9999,
+                           "maximum_distance": 1_000_000},
+    }
+    target = rng.choice(["source_point", "destination_points",
+                         "driver_details", "top_k", "refine",
+                         "use_ml_eta", "context", "meta"])
+    body[target] = _junk(rng)
+    return body
+
+
+def test_fuzz_never_500s(client):
+    rng = random.Random(7)
+    failures = []
+    for endpoint in ENDPOINTS:
+        for trial in range(30):
+            body = _mutate_valid(rng) if trial % 3 == 0 else _junk(rng)
+            # json.dumps with NaN/Inf produces non-standard JSON — which
+            # real clients CAN send; the server must still behave.
+            try:
+                raw = json.dumps(body)
+            except (TypeError, ValueError):
+                continue
+            r = client.post(endpoint, data=raw,
+                            content_type="application/json")
+            if r.status_code >= 500:
+                failures.append((endpoint, r.status_code, str(body)[:120]))
+                continue
+            out = r.get_json()  # must be valid JSON
+            if out is None or not isinstance(out, dict):
+                failures.append((endpoint, "non-json", str(body)[:120]))
+            elif r.status_code == 200:
+                # whatever succeeded must serialize finitely
+                def finite(o):
+                    if isinstance(o, float):
+                        return math.isfinite(o)
+                    if isinstance(o, dict):
+                        return all(finite(v) for v in o.values())
+                    if isinstance(o, list):
+                        return all(finite(v) for v in o)
+                    return True
+
+                if not finite(out):
+                    failures.append((endpoint, "non-finite-200",
+                                     str(body)[:120]))
+    assert not failures, failures[:8]
+
+
+def test_fuzz_raw_bodies_never_500(client):
+    # Non-JSON payloads, truncated JSON, wrong content types.
+    payloads = [b"", b"{", b'{"a":', b"\xff\xfe\x00", b"[1,2,3]",
+                b'"just a string"', b"null", b"true", b"NaN",
+                b'{"items": ' + b"[" * 200 + b"]" * 200 + b"}"]
+    for endpoint in ENDPOINTS:
+        for raw in payloads:
+            r = client.post(endpoint, data=raw,
+                            content_type="application/json")
+            assert r.status_code < 500, (endpoint, raw[:30], r.status_code)
+            assert r.get_json() is not None or r.status_code == 204
+
+
+def test_review_found_500s_stay_fixed(client):
+    # Deterministic regressions for review-found cases the random fuzz
+    # can miss.
+    r = client.post("/api/confirm_route", json={
+        "route_details": {"geometry": "x", "properties": "y"},
+        "driver_details": {"driver_name": "a", "vehicle_type": "car"}})
+    assert r.status_code == 400
+
+    r = client.post("/api/update_tracker", json={
+        "route_id": "r1", "route": [[0, 0]], "destinations": [],
+        "driver_name": "a", "vehicle_type": "car", "distance": 1,
+        "trips": 1, "pickup_time": "2026-07-30T10:00:00",
+        "duration": 1e308 * 10})
+    assert r.status_code == 400
+
+    r = client.post("/api/predict_eta", json={
+        "summary": {"distance": 1000}, "weather": {"x": 1}})
+    assert r.status_code == 400
+    r = client.post("/api/predict_eta", json={
+        "summary": {"distance": 1000}, "traffic": [1, 2]})
+    assert r.status_code == 400
+
+    r = client.post("/api/optimize_route_batch", json={
+        "items": [{"source_point": {"lat": 14.58, "lon": 121.04},
+                   "destination_points": [
+                       {"lat": 14.54, "lon": 121.05, "payload": 1}],
+                   "driver_details": {"vehicle_capacity": 10,
+                                      "maximum_distance": 1e6}}],
+        "use_ml_eta": True, "context": "sunny"})
+    assert r.status_code == 200
+    item = r.get_json()["items"][0]
+    assert "eta_minutes_ml" in item["properties"]  # degraded ctx, ETA kept
