@@ -250,11 +250,27 @@ def optimize_route(input_data: dict) -> dict:
         leg_cost, leg_geom = _gc_legs(all_points, dist, speed)
 
     if len(destinations) == 1:
+        # Same pricer precedence as multi-stop: the transformer (when an
+        # artifact serves this graph) re-prices the out-and-back pair so
+        # point-to-point and multi-stop responses never disagree on
+        # leg_cost_model for the same deployment.
+        p2p_model = None
+        if use_road:
+            rep = legs.reprice_trips([[0]])
+            if rep:
+                base_cost = leg_cost
+
+                def leg_cost(a: int, b: int, _base=base_cost, _r=rep):
+                    meters, seconds = _base(a, b)
+                    return meters, _r.get((a, b), seconds)
+
+                p2p_model = "transformer"
         feature = _point_to_point(source, destinations[0], all_points,
                                   leg_cost, leg_geom, driver_details,
                                   vehicle_type, cap, max_dist, use_road)
         if use_road and "error" not in feature:
-            feature["properties"]["leg_cost_model"] = legs.cost_model
+            feature["properties"]["leg_cost_model"] = (
+                p2p_model or legs.cost_model)
         return feature
 
     # Additive ABI: {"refine": true} runs 2-opt on the greedy order —

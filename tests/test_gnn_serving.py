@@ -68,7 +68,9 @@ def test_engine_point_to_point_reports_model():
     body["destination_points"] = body["destination_points"][:1]
     out = optimize_route(body)
     assert "error" not in out
-    assert out["properties"]["leg_cost_model"] == "gnn"
+    # Same precedence as multi-stop: transformer when its artifact
+    # serves this graph, else the GNN — never silently freeflow.
+    assert out["properties"]["leg_cost_model"] in ("transformer", "gnn")
 
 
 def test_unknown_graph_falls_back_to_freeflow():

@@ -149,3 +149,18 @@ def test_long_tours_chunk_to_trained_windows(artifact, monkeypatch):
     # alternatives API prices candidate orders comparably
     durs = legs.reprice_orders([trip, trip[::-1]])
     assert all(d is not None and d > 0 for d in durs)
+
+
+def test_point_to_point_reports_transformer_too(artifact, monkeypatch):
+    # Pricer precedence must agree between p2p and multi-stop responses
+    # of the same deployment.
+    path, graph_raw = artifact
+    router = RoadRouter(graph=graph_raw, use_gnn=False,
+                        transformer_path=path)
+    monkeypatch.setattr(rr, "_default_router", router)
+    body = _payload()
+    body["destination_points"] = body["destination_points"][:1]
+    out = optimize_route(body)
+    assert "error" not in out
+    assert out["properties"]["leg_cost_model"] == "transformer"
+    assert out["properties"]["summary"]["duration"] > 0
