@@ -171,3 +171,55 @@ def test_review_found_500s_stay_fixed(client):
     assert r.status_code == 200
     item = r.get_json()["items"][0]
     assert "eta_minutes_ml" in item["properties"]  # degraded ctx, ETA kept
+
+
+AUTH_ENDPOINTS = [
+    "/api/auth/register",
+    "/api/auth/login",
+    "/api/auth/logout",
+    "/api/auth/forgot-password",
+    "/api/auth/reset-password",
+    "/api/auth/email/verification-notification",
+]
+
+
+def test_fuzz_auth_endpoints_never_500(client):
+    rng = random.Random(11)
+    for endpoint in AUTH_ENDPOINTS:
+        for trial in range(20):
+            body = _junk(rng)
+            if trial % 4 == 0:  # shaped-but-corrupt credentials
+                body = {"name": _junk(rng), "email": _junk(rng),
+                        "password": _junk(rng), "token": _junk(rng)}
+            try:
+                raw = json.dumps(body)
+            except (TypeError, ValueError):
+                continue
+            r = client.post(endpoint, data=raw,
+                            content_type="application/json")
+            assert r.status_code < 500, (endpoint, r.status_code,
+                                         str(body)[:120])
+            assert r.get_json() is not None
+
+
+def test_fuzz_get_endpoints_never_500(client):
+    rng = random.Random(13)
+    queries = ["", "?limit=abc", "?limit=-5", "?limit=99999999999999999999",
+               "?channel=%00", "?max_events=x", "?channel=" + "x" * 500,
+               "?limit=3&junk[]=1"]
+    ids = ["x", "-1", "%2e%2e%2f", "0" * 300, "null", "драйвер",
+           "a;drop table", "123e4567-e89b-12d3-a456-426614174000"]
+    for q in queries:
+        for path in ("/api/history", "/api/locations", "/api/metrics",
+                     "/api/health", "/api/ping"):
+            r = client.get(path + q)
+            assert r.status_code < 500, (path + q, r.status_code)
+    for rid in ids:
+        r = client.get(f"/api/history/{rid}")
+        assert r.status_code < 500, (rid, r.status_code)
+        d = client.delete(f"/api/history/{rid}")
+        assert d.status_code < 500, (rid, d.status_code)
+    # verify-email with junk path params
+    for uid in ids[:4]:
+        r = client.get(f"/api/auth/verify-email/{uid}/{rng.random()}")
+        assert r.status_code < 500, (uid, r.status_code)

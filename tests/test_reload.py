@@ -198,3 +198,31 @@ def test_reload_under_concurrent_traffic(tmp_path):
         for t in threads:
             t.join()
     assert not failures, failures[:5]
+
+
+def test_reload_across_artifact_formats(tmp_path):
+    # The same ETA_MODEL_PATH can change FORMAT underneath the watcher
+    # (retrain to msgpack, later deploy an AOT export): magic sniffing
+    # in _load must make both directions hot-swap cleanly.
+    from routest_tpu.train.checkpoint import export_serving_fn, load_model
+
+    path = str(tmp_path / "m.artifact")
+    _write_model(path, seed=0)
+    svc = EtaService(ServeConfig(), model_path=path)
+    assert svc.kernel == "xla"
+    before = _eta(svc)
+
+    model, params = load_model(path)
+    export_serving_fn(path + ".tmp", model, params, platforms=("cpu",))
+    os.replace(path + ".tmp", path)  # atomic, like a real deploy
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    assert svc.reload_if_changed() is True
+    assert svc.kernel == "stablehlo_aot"
+    # identical weights serve identical predictions through the export
+    assert abs(_eta(svc) - before) < 1e-4
+
+    # …and back to a (different) msgpack artifact
+    _write_model(path, seed=5)
+    assert svc.reload_if_changed() is True
+    assert svc.kernel == "xla" and _eta(svc) != before
