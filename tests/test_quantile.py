@@ -276,3 +276,20 @@ def test_point_model_serving_adds_no_band_fields():
     r = client.post("/api/predict_eta", json={"summary": {"distance": 5000}})
     assert r.status_code == 200
     assert set(r.get_json()) == {"eta_minutes_ml", "eta_completion_time_ml"}
+
+
+def test_quantile_training_under_mesh_runtime(mesh_runtime):
+    # Pinball loss through the DP train step: batch sharded over the
+    # 8-way data axis, params replicated, gradient psum inserted by XLA
+    # — same path as point training, now with the (B, Q) head.
+    from routest_tpu.train.loop import fit
+
+    train, ev = train_eval_split(generate_dataset(8_000, seed=3))
+    model = EtaMLP(hidden=(16,), policy=F32_POLICY, quantiles=Q)
+    result = fit(model, train, ev, TrainConfig(epochs=4, batch_size=2048),
+                 runtime=mesh_runtime)
+    assert np.isfinite(result.eval_rmse)
+    assert result.train_losses[-1] < result.train_losses[0]
+    preds = model.apply_quantiles(
+        result.state.params, batch_from_mapping(ev)[:128])
+    assert (np.diff(np.asarray(preds), axis=1) >= 0).all()
