@@ -28,6 +28,7 @@ the kind of work that does not belong on the accelerator.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -181,14 +182,31 @@ class RoadRouter:
         self._bf_length = jnp.asarray(self.length_m[self._bf_perm])
         # Learned leg costs: load the trained road-GNN when its training
         # graph fingerprint matches this router's node set.
-        self._gnn = self._load_gnn(gnn_path) if use_gnn else None
         self._hour_times: Dict[int, np.ndarray] = {}
         self._gnn_lock = threading.Lock()
-        # Route-context pricing: the route transformer re-prices a solved
-        # route's edge sequence as a whole (models/route_transformer.py);
-        # same fingerprint gate and graceful-absence contract as the GNN.
-        self._transformer = (self._load_transformer(transformer_path)
-                            if use_transformer else None)
+        # Learned leg models hot-reload like the ETA model: each request
+        # entry point stats the artifact and re-runs the fingerprint-
+        # gated loader when the file changed — a retrained GNN or
+        # transformer goes live without a restart. Mtimes are recorded
+        # even for rejected artifacts so a bad file isn't re-parsed on
+        # every request.
+        from routest_tpu.train.checkpoint import (default_gnn_path,
+                                                  default_transformer_path)
+
+        self._gnn_path = ((gnn_path or default_gnn_path())
+                          if use_gnn else None)
+        self._transformer_path = (
+            (transformer_path or default_transformer_path())
+            if use_transformer else None)
+        self._gnn_mtime_ns: Optional[int] = None
+        self._transformer_mtime_ns: Optional[int] = None
+        self._gnn = None
+        self._transformer = None
+        # Serializes reloads only — model loading happens OUTSIDE the
+        # cache lock so a retrain never stalls concurrent requests.
+        self._reload_lock = threading.Lock()
+        self._model_gen = 0  # bumped per swap: stale cache writes discard
+        self._maybe_reload_models()
 
     @property
     def leg_cost_model(self) -> str:
@@ -236,11 +254,10 @@ class RoadRouter:
             return None
         return model, params, meta
 
-    def _load_gnn(self, path: Optional[str]):
-        from routest_tpu.train.checkpoint import default_gnn_path, load_gnn
+    def _load_gnn(self, path: str):
+        from routest_tpu.train.checkpoint import load_gnn
 
-        loaded = self._load_leg_model(
-            load_gnn, path or default_gnn_path(), "road_gnn")
+        loaded = self._load_leg_model(load_gnn, path, "road_gnn")
         if loaded is None:
             return None
         model, params, _meta = loaded
@@ -250,15 +267,58 @@ class RoadRouter:
     def has_transformer(self) -> bool:
         return self._transformer is not None
 
-    def _load_transformer(self, path: Optional[str]):
+    @staticmethod
+    def _mtime_ns(path: Optional[str]) -> Optional[int]:
+        if not path:
+            return None
+        try:
+            return os.stat(path).st_mtime_ns
+        except OSError:
+            return None
+
+    def _maybe_reload_models(self) -> None:
+        """Reload the GNN / transformer when their artifact files changed
+        (two stats per call — cheap enough to run per request). Same
+        degradation contract as initial load: a rejected replacement
+        simply isn't served; a DELETED artifact stops serving (pricing
+        falls down the stack, matching a fresh process's behavior).
+        Artifacts are written atomically (``_write_artifact``'s
+        temp-then-rename), so a changed mtime always means a complete
+        file. Deserialization runs outside the cache lock — only the
+        final reference swap (and the generation bump that invalidates
+        in-flight cache writes) holds it; a second thread arriving
+        mid-reload just serves the current models."""
+        if not (self._gnn_path or self._transformer_path):
+            return
+        if not self._reload_lock.acquire(blocking=False):
+            return  # another request is already reloading
+        try:
+            m = self._mtime_ns(self._gnn_path)
+            if self._gnn_path and m != self._gnn_mtime_ns:
+                new_gnn = (self._load_gnn(self._gnn_path)
+                           if m is not None else None)
+                with self._gnn_lock:
+                    self._gnn = new_gnn
+                    self._gnn_mtime_ns = m
+                    self._model_gen += 1
+                    self._hour_times.clear()
+            m = self._mtime_ns(self._transformer_path)
+            if self._transformer_path and m != self._transformer_mtime_ns:
+                new_tf = (self._load_transformer(self._transformer_path)
+                          if m is not None else None)
+                with self._gnn_lock:
+                    self._transformer = new_tf
+                    self._transformer_mtime_ns = m
+        finally:
+            self._reload_lock.release()
+
+    def _load_transformer(self, path: str):
         """(model, params, trained_seq_len) when a fingerprint-compatible
         route-transformer artifact exists, else None."""
-        from routest_tpu.train.checkpoint import (default_transformer_path,
-                                                  load_transformer)
+        from routest_tpu.train.checkpoint import load_transformer
 
-        loaded = self._load_leg_model(
-            load_transformer, path or default_transformer_path(),
-            "route_transformer")
+        loaded = self._load_leg_model(load_transformer, path,
+                                      "route_transformer")
         if loaded is None:
             return None
         model, params, meta = loaded
@@ -272,16 +332,22 @@ class RoadRouter:
         otherwise. This is the on-device replacement for the reference's
         "ask ORS how long this leg takes" (``Flaskr/utils.py:97-109``).
         """
-        if self._gnn is None:
-            return self.freeflow_time_s
         h = int(hour) % 24
+        # ONE consistent snapshot of (model, cache, generation): a
+        # concurrent hot-reload can null self._gnn between a bare check
+        # and a later read, and its cache clear must invalidate THIS
+        # call's eventual write (stale-generation writes are discarded).
         with self._gnn_lock:
+            gnn = self._gnn
+            gen = self._model_gen
             cached = self._hour_times.get(h)
-            if cached is not None:
-                return cached
+        if gnn is None:
+            return self.freeflow_time_s
+        if cached is not None:
+            return cached
         from routest_tpu.models.gnn import GraphBatch, edge_feature_array
 
-        model, params = self._gnn
+        model, params = gnn
         e = len(self.length_m)
         batch = GraphBatch(
             senders=self._d_senders,
@@ -300,7 +366,8 @@ class RoadRouter:
         # pricing an edge at ~0 s and distorting every route through it.
         pred = np.maximum(pred, self.length_m / 16.7)  # 60 km/h cap
         with self._gnn_lock:
-            self._hour_times[h] = pred
+            if self._model_gen == gen:  # don't poison a reloaded cache
+                self._hour_times[h] = pred
         return pred
 
     def _bridge_components(self, senders, receivers, length, road_class,
@@ -437,6 +504,7 @@ class RoadRouter:
         learned congestion regime when the GNN is active; None prices at
         noon off-peak.
         """
+        self._maybe_reload_models()  # retrained leg models go live here
         points_latlon = np.asarray(points_latlon, np.float32)
         nodes = self.snap(points_latlon)
         dist, pred = self.shortest(nodes)

@@ -33,12 +33,27 @@ def _write_artifact(path: str, magic: bytes, header: dict,
                     blob: bytes) -> None:
     """Shared artifact writer: magic prefix + one-line JSON header +
     binary blob — the layout every artifact family speaks (see
-    :func:`_read_artifact`)."""
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(magic)
-        f.write(json.dumps(header).encode() + b"\n")
-        f.write(blob)
+    :func:`_read_artifact`).
+
+    Written temp-then-rename: hot-reload watchers (the ETA service's and
+    the road router's) stat these paths on live traffic, so a reader
+    must never observe a half-written file — os.replace makes the swap
+    atomic on POSIX."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(magic)
+            f.write(json.dumps(header).encode() + b"\n")
+            f.write(blob)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _params_blob(params) -> bytes:

@@ -164,3 +164,39 @@ def test_point_to_point_reports_transformer_too(artifact, monkeypatch):
     assert "error" not in out
     assert out["properties"]["leg_cost_model"] == "transformer"
     assert out["properties"]["summary"]["duration"] > 0
+
+
+def test_leg_models_hot_reload(artifact, tmp_path):
+    # A retrained (or newly arrived / deleted) leg-model artifact goes
+    # live on the next request without a router restart.
+    import os
+    import time
+
+    path, graph_raw = artifact
+    live = str(tmp_path / "live_transformer.msgpack")
+    router = RoadRouter(graph=graph_raw, use_gnn=False,
+                        transformer_path=live)
+    pts = np.asarray([[14.5836, 121.0409], [14.5355, 121.0621]], np.float32)
+    router.route_legs(pts)
+    assert not router.has_transformer  # nothing at the path yet
+
+    import shutil
+
+    shutil.copy(path, live)
+    router.route_legs(pts)
+    assert router.has_transformer  # arrived artifact went live
+
+    with open(live, "wb") as f:
+        f.write(b"corrupt")
+    os.utime(live, ns=(time.time_ns(), time.time_ns()))
+    router.route_legs(pts)
+    assert not router.has_transformer  # rejected replacement stops serving
+
+    shutil.copy(path, live)
+    os.utime(live, ns=(time.time_ns() + 1, time.time_ns() + 1_000_000))
+    router.route_legs(pts)
+    assert router.has_transformer
+
+    os.unlink(live)
+    router.route_legs(pts)
+    assert not router.has_transformer  # deletion falls down the stack
