@@ -200,3 +200,54 @@ def test_leg_models_hot_reload(artifact, tmp_path):
     os.unlink(live)
     router.route_legs(pts)
     assert not router.has_transformer  # deletion falls down the stack
+
+
+def test_leg_model_reload_under_concurrent_traffic(artifact, tmp_path):
+    # Hammer the review-found races: concurrent route pricing while the
+    # GNN/transformer artifacts swap, corrupt, and return underneath.
+    # No request may crash; every duration stays finite and positive.
+    import shutil
+    import threading
+    import time as _time
+
+    path, graph_raw = artifact
+    live = str(tmp_path / "hammer_transformer.msgpack")
+    shutil.copy(path, live)
+    router = RoadRouter(graph=graph_raw, use_gnn=False,
+                        transformer_path=live)
+    pts = np.asarray([[14.5836, 121.0409], [14.5355, 121.0621],
+                      [14.5866, 121.0566]], np.float32)
+    stop = threading.Event()
+    failures: list = []
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                legs = router.route_legs(pts, hour=8)
+                d, dur, poly = legs.leg(0, 1)
+                if not (np.isfinite(dur) and dur > 0):
+                    failures.append(f"bad duration {dur}")
+                legs.reprice_trips([[0, 1]])
+            except Exception as e:  # pragma: no cover - the failure mode
+                failures.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(8):
+            if i % 3 == 2:
+                with open(live, "wb") as f:
+                    f.write(b"corrupt mid-deploy")
+            else:
+                shutil.copy(path, live)
+            ns = _time.time_ns() + i
+            import os as _os
+
+            _os.utime(live, ns=(ns, ns))
+            _time.sleep(0.1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not failures, failures[:5]
