@@ -531,6 +531,19 @@ def create_app(config: Optional[Config] = None,
         # self-check instead of probing ORS over the internet.
         engine_res = {"status": "ok" if state.eta is not None else "error",
                       "latency_ms": 0, "engine": "jax-tpu"}
+        # Road-router gauge (only when a router has been built — probing
+        # would otherwise build the 2k graph on a health check): which
+        # leg pricers are live, over what graph.
+        from routest_tpu.optimize import road_router as _rr
+
+        if _rr._default_router is not None:
+            r = _rr._default_router
+            engine_res["road_router"] = {
+                "nodes": int(r.n_nodes),
+                "edges": int(len(r.senders)),
+                "leg_cost_model": r.leg_cost_model,
+                "transformer": bool(r.has_transformer),
+            }
         model_res = {"status": "ok" if state.eta.available else "degraded",
                      **({"error": state.eta.load_error}
                         if state.eta.load_error else {})}
