@@ -178,6 +178,96 @@ def run_load(bases, n_threads: int, n_requests: int):
     return report, errors
 
 
+def run_vrp_batch_load(bases, n_threads: int, n_requests: int,
+                       problems_per_request: int = 32):
+    """Batched route OPTIMIZATION phase: many VRPs per request through
+    ``/api/optimize_route_batch`` (one vmapped device solve per request
+    — the batch-of-problems axis on the serving path). Reports
+    problems/sec and per-request latency."""
+    from routest_tpu.data.locations import SEED_LOCATIONS
+
+    latencies: list = []
+    solved = [0]
+    errors: list = []
+    lock = threading.Lock()
+
+    def payload(rng):
+        items = []
+        for _ in range(problems_per_request):
+            picks = rng.sample(range(1, len(SEED_LOCATIONS)),
+                               rng.randint(2, 6))
+            items.append({
+                "source_point": {"lat": SEED_LOCATIONS[0][1],
+                                 "lon": SEED_LOCATIONS[0][2]},
+                "destination_points": [
+                    {"lat": SEED_LOCATIONS[i][1],
+                     "lon": SEED_LOCATIONS[i][2], "payload": 1}
+                    for i in picks],
+                "driver_details": {"vehicle_capacity": 100,
+                                   "maximum_distance": 200_000},
+                "refine": rng.random() < 0.5,
+            })
+        return {"items": items, "use_ml_eta": True}
+
+    def worker(seed: int):
+        rng = random.Random(seed)
+        poster = PersistentPoster(bases[seed % len(bases)], timeout=120)
+        for _ in range(n_requests):
+            try:
+                dt_s, status, raw = poster.post("/api/optimize_route_batch",
+                                                payload(rng))
+                out = json.loads(raw)
+                with lock:
+                    if status == 200:
+                        ok = sum(1 for it in out.get("items", [])
+                                 if isinstance(it, dict)
+                                 and "error" not in it)
+                        latencies.append(dt_s)
+                        solved[0] += ok
+                    else:
+                        errors.append(status)
+            except Exception as e:
+                poster.reset()
+                with lock:
+                    errors.append(str(e)[:80])
+        poster.close()
+
+    # untimed warmup per worker base (same rationale as the ETA batch)
+    for base in bases:
+        warm = PersistentPoster(base, timeout=120)
+        try:
+            warm.post("/api/optimize_route_batch", payload(random.Random(0)))
+        except Exception:
+            pass
+        warm.close()
+
+    threads = [threading.Thread(target=worker, args=(3000 + s,))
+               for s in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat_ms = sorted(x * 1000 for x in latencies)
+
+    def pct(p):
+        return round(lat_ms[min(len(lat_ms) - 1,
+                                int(p * len(lat_ms)))], 2) if lat_ms else None
+
+    return {
+        "problems_per_request": problems_per_request,
+        "threads": n_threads,
+        "requests": len(latencies),
+        "problems_solved": solved[0],
+        "wall_seconds": round(wall, 2),
+        "problems_per_s": round(solved[0] / wall, 1) if wall else 0.0,
+        "errors": len(errors),
+        "p50_ms": pct(0.50),
+        "p95_ms": pct(0.95),
+    }, errors
+
+
 def run_batch_load(bases, n_threads: int, n_requests: int,
                    batch_size: int):
     """North-star phase: OD *batches* through ``/api/predict_eta_batch``.
@@ -365,6 +455,10 @@ def main() -> None:
                 args.batch_size)
             report["predict_eta_batch"] = batch_report
             errors.extend(batch_errors)
+            vrp_report, vrp_errors = run_vrp_batch_load(
+                bases, args.batch_threads, max(4, args.batch_requests // 2))
+            report["optimize_route_batch"] = vrp_report
+            errors.extend(vrp_errors)
     except BaseException:
         # Don't leak spawned servers on any failure/abort path.
         for p_ in server_procs:
