@@ -466,30 +466,36 @@ refine_swap_batch = jax.jit(
     jax.vmap(refine_swap, in_axes=(0, 0, 0, 0, 0, 0)))
 
 
-@jax.jit
-def refine_oropt2(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
-                  max_distance: jax.Array, order: jax.Array,
-                  trip_ids: jax.Array) -> _RelocateOut:
-    """Or-opt-2: relocate an ADJACENT PAIR of stops as one unit — within
-    a trip or across trips — when it shortens the tour and stays
-    feasible.
+def _refine_oropt_impl(dist: jax.Array, demands: jax.Array,
+                       capacity: jax.Array, max_distance: jax.Array,
+                       order: jax.Array, trip_ids: jax.Array,
+                       seg_len: int) -> _RelocateOut:
+    """Or-opt-L: relocate an ADJACENT SEGMENT of ``seg_len`` stops as one
+    unit — within a trip or across trips — when it shortens the tour and
+    stays feasible.
 
     The move the other passes cannot make: relocate (Or-opt-1) moves one
-    stop at a time, so a misplaced pair whose first stop only pays off
-    once its partner follows sits at a local optimum; swap exchanges
-    1-for-1; 2-opt reverses within a trip. Moving the pair keeps its
-    internal leg (orientation preserved — reversals are 2-opt's job) and
-    re-prices only the three boundary legs.
+    stop at a time, so a misplaced segment whose first stop only pays
+    off once the rest follows sits at a local optimum; swap exchanges
+    1-for-1; 2-opt reverses within a trip. Moving the segment keeps its
+    internal legs (orientation preserved — reversals are 2-opt's job)
+    and re-prices only the three boundary legs.
 
-    Same fixed-shape recipe as :func:`refine_relocate`: O(N²) pair/slot
-    deltas as gathers, best improving move applied as an index
-    permutation, ``lax.while_loop`` to fixpoint. Symmetric matrix
-    assumed, like the other refiners.
+    Same fixed-shape recipe as :func:`refine_relocate`: O(N²)
+    segment/slot deltas as gathers, best improving move applied as an
+    index permutation, ``lax.while_loop`` to fixpoint. Symmetric matrix
+    assumed, like the other refiners. ``seg_len`` is static (one
+    compiled program per length; the standard Or-opt family is 2 and 3).
     """
     n = order.shape[0]
+    k = seg_len - 1  # shift from segment start to segment end
     pos = jnp.arange(n)
     demands = demands.astype(dist.dtype)
     big = jnp.asarray(jnp.inf, dist.dtype)
+
+    def _shift(a, by):
+        return jnp.concatenate([a[by:], jnp.zeros((by,), a.dtype)]) \
+            if by else a
 
     def analyze(order, trip_ids):
         v = _tour_views(dist, demands, order, trip_ids)
@@ -498,23 +504,30 @@ def refine_oropt2(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
                                            v.same_next, v.nxt)
         loads, tripdist = v.loads, v.tripdist
 
-        # Pair at (i, i+1): second element's node / next-link, rolled so
-        # lane i carries the whole segment.
-        s2 = jnp.concatenate([nodes[1:], jnp.zeros((1,), nodes.dtype)])
-        nxt2 = jnp.concatenate([nxt[1:], jnp.zeros((1,), nxt.dtype)])
-        dem2 = jnp.concatenate([dem[1:], jnp.zeros((1,), dem.dtype)])
-        pair_ok = active & same_next          # i+1 exists, same trip
-        pair_dem = dem + dem2
+        # Segment [i, i+k]: end node / end next-link rolled so lane i
+        # carries the whole segment; windowed demand / contiguity /
+        # internal-leg sums via static shifts.
+        s_end = _shift(nodes, k)
+        nxt_end = _shift(nxt, k)
+        seg_ok = active
+        seg_dem = dem
+        edge = jnp.where(same_next, dist[nodes, _shift(nodes, 1)], 0.0)
+        internal = jnp.zeros_like(edge)
+        for step in range(k):
+            seg_ok = seg_ok & _shift(same_next, step)
+            seg_dem = seg_dem + _shift(dem, step + 1)
+            internal = internal + _shift(edge, step)
+        internal = jnp.where(seg_ok, internal, 0.0)
 
-        # Removal gain of the pair (internal leg travels with it).
-        gain = dist[prev, nodes] + dist[s2, nxt2] - dist[prev, nxt2]
+        # Removal gain of the segment (internal legs travel with it).
+        gain = dist[prev, nodes] + dist[s_end, nxt_end] - dist[prev, nxt_end]
 
         # Insertion: after stop j, or before the head of j's trip.
         ins_after = (dist[nodes[None, :], nodes[:, None]]
-                     + dist[s2[:, None], nxt[None, :]]
+                     + dist[s_end[:, None], nxt[None, :]]
                      - dist[nodes, nxt][None, :])
         ins_head = (dist[0, nodes][:, None]
-                    + dist[s2[:, None], nodes[None, :]]
+                    + dist[s_end[:, None], nodes[None, :]]
                     - dist[0, nodes][None, :])
         costs = jnp.stack([ins_after, ins_head])               # (2, N, N)
 
@@ -525,11 +538,10 @@ def refine_oropt2(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
 
         cap_ok = jnp.where(
             same_trip, True,
-            loads[jnp.clip(tgt, 0)] + pair_dem[:, None] <= capacity)
-        # Cross-trip, the pair's INTERNAL leg moves into the target trip
-        # too (boundary-only `costs` doesn't count it; same-trip it
-        # cancels inside gain).
-        internal = jnp.where(pair_ok, dist[nodes, s2], 0.0)
+            loads[jnp.clip(tgt, 0)] + seg_dem[:, None] <= capacity)
+        # Cross-trip, the segment's INTERNAL legs move into the target
+        # trip too (boundary-only `costs` doesn't count them; same-trip
+        # they cancel inside gain).
         newdist = jnp.where(
             same_trip,
             tripdist[jnp.clip(src, 0)] + costs - gain[:, None],
@@ -537,10 +549,11 @@ def refine_oropt2(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
             + internal[:, None][None, :, :])
         dist_ok = newdist <= max_distance + 1e-3
 
-        valid_base = (pair_ok[:, None] & active[None, :]
-                      & (pos[None, :] != pos[:, None])
-                      & (pos[None, :] != pos[:, None] + 1))
-        # after-mode no-op: back after the pair's own predecessor
+        # j must lie outside the segment's own positions [i, i+k].
+        outside = ((pos[None, :] < pos[:, None])
+                   | (pos[None, :] > pos[:, None] + k))
+        valid_base = seg_ok[:, None] & active[None, :] & outside
+        # after-mode no-op: back after the segment's own predecessor
         after_noop = same_trip & (pos[None, :] == pos[:, None] - 1)
         head_j = active & ~same_prev
         valid = jnp.stack([valid_base & ~after_noop,
@@ -552,11 +565,10 @@ def refine_oropt2(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
         mode = flat // (n * n)
         ij = flat % (n * n)
         i, j = ij // n, ij % n
-        # Final START position of the pair (block of 2): forward moves
-        # shift the block two slots less than j; see index check in
-        # tests (worked examples in both directions).
-        t_after = jnp.where(i < j, j - 1, j + 1)
-        t_head = jnp.where(i < j, j - 2, j)
+        # Final START position of the block of seg_len (worked examples
+        # for both directions and both modes in tests).
+        t_after = jnp.where(i < j, j - k, j + 1)
+        t_head = jnp.where(i < j, j - seg_len, j)
         target = jnp.where(mode == 0, t_after, t_head)
         return best_delta, i, target, trip_ids[j]
 
@@ -566,12 +578,14 @@ def refine_oropt2(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
 
     def apply_move(state):
         order, trip_ids, delta, i, t, tgt_trip, it = state
-        fwd = (pos >= i) & (pos < t)           # block moved forward
-        bwd = (pos > t + 1) & (pos <= i + 1)   # block moved backward
-        perm = jnp.where(fwd, pos + 2, jnp.where(bwd, pos - 2, pos))
-        perm = jnp.where(pos == t, i, jnp.where(pos == t + 1, i + 1, perm))
+        fwd = (pos >= i) & (pos < t)                 # block moved forward
+        bwd = (pos > t + k) & (pos <= i + k)         # block moved backward
+        perm = jnp.where(fwd, pos + seg_len,
+                         jnp.where(bwd, pos - seg_len, pos))
+        in_block = (pos >= t) & (pos <= t + k)
+        perm = jnp.where(in_block, i + (pos - t), perm)
         order = order[perm]
-        trip_ids = trip_ids[perm].at[t].set(tgt_trip).at[t + 1].set(tgt_trip)
+        trip_ids = jnp.where(in_block, tgt_trip, trip_ids[perm])
         delta2, i2, t2, tgt2 = analyze(order, trip_ids)
         return order, trip_ids, delta2, i2, t2, tgt2, it + 1
 
@@ -582,8 +596,46 @@ def refine_oropt2(dist: jax.Array, demands: jax.Array, capacity: jax.Array,
     return _RelocateOut(order=out[0], trip_ids=out[1])
 
 
+# seg_len must stay OUT of the traced arguments (it drives array shifts
+# and permutation arithmetic), so each length gets its own jitted
+# partial — closure-captured, never a tracer.
+_OROPT_JIT: dict = {}
+
+
+def refine_oropt(dist, demands, capacity, max_distance, order, trip_ids,
+                 *, seg_len: int = 2) -> _RelocateOut:
+    fn = _OROPT_JIT.get(seg_len)
+    if fn is None:
+        # NOT functools.partial (jax.jit unwraps partials and TRACES
+        # their bound keywords) and NOT a default argument (defaults get
+        # traced too): a true closure variable is the only form that
+        # keeps seg_len a Python int through tracing.
+        def _make(length: int):
+            def _fixed(d, dm, c, m, o, t):
+                return _refine_oropt_impl(d, dm, c, m, o, t, length)
+
+            return jax.jit(_fixed)
+
+        fn = _make(int(seg_len))
+        _OROPT_JIT[seg_len] = fn
+    return fn(dist, demands, capacity, max_distance, order, trip_ids)
+
+
+def refine_oropt2(dist, demands, capacity, max_distance, order, trip_ids):
+    """Or-opt with the classic pair segment (back-compat name)."""
+    return refine_oropt(dist, demands, capacity, max_distance, order,
+                        trip_ids, seg_len=2)
+
+
+def refine_oropt3(dist, demands, capacity, max_distance, order, trip_ids):
+    return refine_oropt(dist, demands, capacity, max_distance, order,
+                        trip_ids, seg_len=3)
+
+
 refine_oropt2_batch = jax.jit(
     jax.vmap(refine_oropt2, in_axes=(0, 0, 0, 0, 0, 0)))
+refine_oropt3_batch = jax.jit(
+    jax.vmap(refine_oropt3, in_axes=(0, 0, 0, 0, 0, 0)))
 
 
 def trips_cost(dist: np.ndarray, trips) -> float:
@@ -707,6 +759,8 @@ def solve_host_batch(dists, demands, capacities, max_distances,
                 dist_j, dem_j, cap_b, maxd_b, order_j, trips_j)
             order_j, trips_j = refine_oropt2_batch(
                 dist_j, dem_j, cap_b, maxd_b, order_j, trips_j)
+            order_j, trips_j = refine_oropt3_batch(
+                dist_j, dem_j, cap_b, maxd_b, order_j, trips_j)
 
     order = np.asarray(order_j)
     trip_ids = np.asarray(trips_j)
@@ -746,6 +800,8 @@ def solve_host(dist: np.ndarray, demands: np.ndarray, capacity: float,
             order_j = refine_swap(
                 dist_j, dem_j, cap_j, maxd_j, order_j, trips_j)
             order_j, trips_j = refine_oropt2(
+                dist_j, dem_j, cap_j, maxd_j, order_j, trips_j)
+            order_j, trips_j = refine_oropt3(
                 dist_j, dem_j, cap_j, maxd_j, order_j, trips_j)
             new_cost = tour_cost(dist, np.asarray(order_j), np.asarray(trips_j))
             if new_cost >= cost - 1e-3:

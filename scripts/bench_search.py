@@ -71,10 +71,10 @@ def bench_tour_cost(n_instances=25, n_stops=20, seed=0):
         "n_stops": n_stops,
         "mean_cost_m": {"greedy": round(float(greedy), 1),
                         "greedy+2opt": round(float(twoopt), 1),
-                        "greedy+2opt+relocate+swap+oropt2": round(float(full), 1)},
+                        "greedy+2opt+relocate+swap+oropt23": round(float(full), 1)},
         "improvement_vs_greedy_pct": {
             "greedy+2opt": round(100 * (1 - twoopt / greedy), 2),
-            "greedy+2opt+relocate+swap+oropt2": round(100 * (1 - full / greedy), 2)},
+            "greedy+2opt+relocate+swap+oropt23": round(100 * (1 - full / greedy), 2)},
     }
 
 
@@ -137,7 +137,7 @@ def main():
     rk = report["ranking_vs_exhaustive"]
     print("\n| solver (20 stops, multi-trip) | mean cost (m) | vs greedy |")
     print("|---|---|---|")
-    for name in ("greedy", "greedy+2opt", "greedy+2opt+relocate+swap+oropt2"):
+    for name in ("greedy", "greedy+2opt", "greedy+2opt+relocate+swap+oropt23"):
         imp = tc["improvement_vs_greedy_pct"].get(name, 0.0)
         print(f"| {name} | {tc['mean_cost_m'][name]:,} | "
               f"{'-' if name == 'greedy' else f'-{imp}%'} |")

@@ -392,3 +392,80 @@ def test_solve_host_refine_includes_oropt2():
     # Or-opt-2 quality on this instance (moves compose to fixpoint)
     out = solve_host(dist, demands, 4.0, 1e9, refine=True)
     assert trips_cost(dist, out["trips"]) < 450  # optimal-ish, not ~640
+
+
+def test_oropt3_moves_stranded_triple():
+    # Three nearly-co-located stops stranded in trip A near trip B:
+    # every single and PAIR move is a strict loss (the remaining
+    # stragglers keep the detour), but the triple moves in one Or-opt-3
+    # step.
+    import jax.numpy as jnp
+
+    from routest_tpu.optimize.vrp import (refine_oropt, refine_relocate,
+                                          tour_cost)
+
+    pts = np.asarray([
+        [0.0, 0.0],      # origin
+        [0.0, 10.0],     # A1
+        [105.0, 0.8],    # x (triple)
+        [105.0, 0.0],    # y
+        [105.0, -0.8],   # z
+        [0.0, 20.0],     # A2
+        [100.0, 10.0],   # B1
+        [100.0, -10.0],  # B2
+    ], np.float64)
+    dist = np.linalg.norm(pts[:, None] - pts[None, :],
+                          axis=-1).astype(np.float32)
+    demands = np.ones(7, np.float32)
+    order = np.asarray([0, 1, 2, 3, 4, 5, 6], np.int32)
+    trips = np.asarray([0, 0, 0, 0, 0, 1, 1], np.int32)
+    cap, maxd = jnp.asarray(5.0), jnp.asarray(1e9)
+    d, dm = jnp.asarray(dist), jnp.asarray(demands)
+    base = tour_cost(dist, order, trips)
+
+    o1, t1 = refine_relocate(d, dm, cap, maxd,
+                             jnp.asarray(order), jnp.asarray(trips))
+    assert tour_cost(dist, np.asarray(o1), np.asarray(t1)) > 440
+    o2, t2 = refine_oropt(d, dm, cap, maxd, jnp.asarray(order),
+                          jnp.asarray(trips), seg_len=2)
+    assert tour_cost(dist, np.asarray(o2), np.asarray(t2)) > 440
+
+    o3, t3 = refine_oropt(d, dm, cap, maxd, jnp.asarray(order),
+                          jnp.asarray(trips), seg_len=3)
+    improved = tour_cost(dist, np.asarray(o3), np.asarray(t3))
+    assert improved < base - 190
+    o3np, t3np = np.asarray(o3), np.asarray(t3)
+    px = int(np.flatnonzero(o3np == 1)[0])
+    assert (o3np[px:px + 3].tolist() == [1, 2, 3]
+            and len(set(t3np[px:px + 3].tolist())) == 1)
+
+
+def test_oropt3_feasibility_and_validity_random():
+    import jax.numpy as jnp
+
+    from routest_tpu.optimize.vrp import greedy_vrp, refine_oropt, tour_cost
+
+    rng = np.random.default_rng(9)
+    for trial in range(5):
+        n = int(rng.integers(6, 14))
+        pts = rng.uniform(0, 10_000, (n + 1, 2))
+        dist = np.linalg.norm(pts[:, None] - pts[None, :],
+                              axis=-1).astype(np.float32)
+        demands = rng.uniform(0.5, 2.0, n).astype(np.float32)
+        cap = jnp.asarray(5.0)
+        maxd = jnp.asarray(60_000.0)
+        sol = greedy_vrp(jnp.asarray(dist), jnp.asarray(demands), cap, maxd)
+        out = refine_oropt(jnp.asarray(dist), jnp.asarray(demands), cap,
+                           maxd, sol.order, sol.trip_ids, seg_len=3)
+        o, t = np.asarray(out.order), np.asarray(out.trip_ids)
+        routed = o[o >= 0]
+        assert sorted(routed.tolist()) == sorted(
+            np.asarray(sol.order)[np.asarray(sol.order) >= 0].tolist())
+        assert tour_cost(dist, o, t) <= tour_cost(
+            dist, np.asarray(sol.order), np.asarray(sol.trip_ids)) + 1e-2
+        for tid in np.unique(t[t >= 0]):
+            stops = o[(t == tid) & (o >= 0)]
+            assert demands[stops].sum() <= 5.0 + 1e-5
+            seq = [0] + [s + 1 for s in stops] + [0]
+            td = sum(dist[a, b] for a, b in zip(seq[:-1], seq[1:]))
+            assert td <= 60_000.0 + 1.0
