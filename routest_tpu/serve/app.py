@@ -510,10 +510,18 @@ def create_app(config: Optional[Config] = None,
     def metrics(request):
         # TPU-era observability (SURVEY.md §5.5): per-route latency
         # percentiles + batcher gauges, additive to the reference ABI.
-        return {
+        # ?format=prometheus renders the same data in the exposition
+        # format every scraper speaks.
+        snapshot = {
             "http": app.request_stats.snapshot(),
             "batcher": state.eta.stats,
-        }, 200
+        }
+        if request.args.get("format") == "prometheus":
+            from routest_tpu.serve.wsgi import Response
+
+            return Response(_prometheus_text(snapshot), 200,
+                            mimetype="text/plain; version=0.0.4")
+        return snapshot, 200
 
     @app.route("/api/health", methods=("GET",))
     def health(request):
@@ -579,6 +587,37 @@ def create_app(config: Optional[Config] = None,
 
     _warm_optimizer()
     return app
+
+
+def _prometheus_text(snapshot: dict) -> str:
+    """metrics snapshot → Prometheus exposition format (text/plain
+    0.0.4). Route labels are sanitized; numeric leaves only."""
+
+    def esc(v: str) -> str:
+        return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+
+    lines = [
+        "# HELP routest_http_uptime_seconds Server uptime.",
+        "# TYPE routest_http_uptime_seconds gauge",
+        f"routest_http_uptime_seconds "
+        f"{snapshot['http'].get('uptime_s', 0)}",
+    ]
+    route_keys = ("count", "errors", "mean_ms", "p50_ms", "p95_ms", "p99_ms")
+    for key in route_keys:
+        metric = f"routest_http_route_{key}"
+        kind = "counter" if key in ("count", "errors") else "gauge"
+        lines.append(f"# TYPE {metric} {kind}")
+        for route, s in sorted(snapshot["http"].get("routes", {}).items()):
+            if key in s:
+                lines.append(
+                    f'{metric}{{route="{esc(route)}"}} {s[key]}')
+    lines.append("# TYPE routest_batcher gauge")
+    for key, val in sorted(snapshot.get("batcher", {}).items()):
+        if isinstance(val, bool):
+            val = int(val)
+        if isinstance(val, (int, float)):
+            lines.append(f'routest_batcher{{stat="{esc(key)}"}} {val}')
+    return "\n".join(lines) + "\n"
 
 
 def _device_memory(jax) -> dict:

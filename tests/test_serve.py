@@ -478,3 +478,21 @@ def test_pages_served(client):
         assert "routest-tpu" in body and marker in body
     # Dashboard keeps the history CSV export (history/page.jsx:73-107).
     assert "route_history.csv" in client.get("/ui").get_data(as_text=True)
+
+
+def test_metrics_prometheus_format(client):
+    client.get("/api/ping")  # ensure at least one route has stats
+    r = client.get("/api/metrics?format=prometheus")
+    assert r.status_code == 200
+    assert r.headers["Content-Type"].startswith("text/plain")
+    text = r.get_data(as_text=True)
+    assert "routest_http_uptime_seconds" in text
+    assert 'routest_http_route_count{route="GET /api/ping"}' in text
+    assert 'routest_batcher{stat="available"}' in text
+    # every non-comment line is "name{labels} value" with a numeric value
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        float(line.rsplit(" ", 1)[1])
+    # default stays JSON
+    assert client.get("/api/metrics").get_json()["http"]["uptime_s"] >= 0
