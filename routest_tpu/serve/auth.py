@@ -37,6 +37,7 @@ import hashlib
 import hmac
 import secrets
 import threading
+import time
 import uuid
 from typing import Dict, Optional, Tuple
 
@@ -70,6 +71,7 @@ class AuthService:
         self._users: Dict[str, dict] = {}          # email -> user row
         self._tokens: Dict[str, str] = {}          # bearer token -> email
         self._resets: Dict[str, Tuple[str, float]] = {}  # token -> (email, expiry)
+        self._attempts: Dict[str, Tuple[int, float]] = {}  # throttle key -> (count, window expiry)
 
     # ── registration / login ───────────────────────────────────────────
 
@@ -100,22 +102,62 @@ class AuthService:
             token = self._issue_token_locked(email)
         return self._public(user), token
 
-    def login(self, email: str, password: str) -> Tuple[dict, str]:
-        """Raises ValueError on bad credentials (Breeze: 422 auth.failed)."""
+    # Breeze login throttling (reference
+    # ``app/Http/Requests/Auth/LoginRequest.php:45-70``): 5 attempts per
+    # email+source key, 60 s decay window, lockout surfaces the seconds
+    # remaining; a successful login clears the key.
+    THROTTLE_ATTEMPTS = 5
+    THROTTLE_DECAY_S = 60.0
+
+    def _throttle_check(self, key: str, now: float) -> None:
+        count, expires = self._attempts.get(key, (0, 0.0))
+        if expires <= now:
+            return
+        if count >= self.THROTTLE_ATTEMPTS:
+            seconds = max(1, int(expires - now))
+            raise ValueError(
+                f"too many login attempts. please try again in "
+                f"{seconds} seconds")
+
+    def _throttle_hit(self, key: str, now: float) -> None:
+        if len(self._attempts) > 10_000:
+            # Unauthenticated attackers control the key space (junk
+            # emails); purge lapsed windows so the table stays bounded.
+            self._attempts = {k: v for k, v in self._attempts.items()
+                              if v[1] > now}
+        count, expires = self._attempts.get(key, (0, 0.0))
+        if expires <= now:  # window lapsed: start a fresh one
+            count, expires = 0, now + self.THROTTLE_DECAY_S
+        self._attempts[key] = (count + 1, expires)
+
+    def login(self, email: str, password: str,
+              source: str = "", now: Optional[float] = None) -> Tuple[dict, str]:
+        """Raises ValueError on bad credentials (Breeze: 422 auth.failed)
+        or on lockout (Breeze throttle, ``LoginRequest.php:62-70``).
+        ``source`` is the caller's network identity (Breeze keys the
+        limiter by email|ip so one address can't lock out a victim's
+        account globally)."""
+        now = time.time() if now is None else now
+        key = f"{(email or '').lower()}|{source}"
         with self._lock:
+            self._throttle_check(key, now)
             user = self._users.get(email or "")
             # Snapshot the credentials; hash outside the lock (see register).
             salt = user["salt"] if user else b"\0" * 16
             want = user["password_hash"] if user else b""
         got = _hash_password(password or "", salt)
         if user is None or not hmac.compare_digest(want, got):
+            with self._lock:
+                self._throttle_hit(key, now)
             raise ValueError("these credentials do not match our records")
         with self._lock:
             # Password may have rotated between hash and issue; re-check.
             current = self._users.get(email)
             if current is None or current["password_hash"] != want:
+                self._throttle_hit(key, now)
                 raise ValueError("these credentials do not match our records")
             token = self._issue_token_locked(email)
+            self._attempts.pop(key, None)  # success clears the limiter
         return self._public(user), token
 
     def logout(self, token: str) -> bool:
@@ -243,7 +285,8 @@ def mount_auth(app, auth: AuthService) -> None:
         body = get_json(request) or {}
         try:
             user, token = auth.login(str(body.get("email") or ""),
-                                     str(body.get("password") or ""))
+                                     str(body.get("password") or ""),
+                                     source=request.remote_addr or "")
         except ValueError as e:
             return validation_error(e)
         return {"user": user, "token": token}, 200

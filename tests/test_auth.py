@@ -205,3 +205,43 @@ def test_session_cap_evicts_oldest_token():
 def test_auth_off_by_default_keeps_reference_behavior(client):
     # The reference never gates the data plane; default must match.
     assert client.delete("/api/history/missing").status_code == 404
+
+
+def test_login_throttling_breeze_semantics():
+    # Reference LoginRequest.php:45-70: 5 attempts per email|source, 60 s
+    # decay, lockout message carries seconds remaining, success clears.
+    from routest_tpu.serve.auth import AuthService
+
+    auth = AuthService()
+    auth.register("n", "t@x.com", "right-password")
+    t = 1000.0
+    for _ in range(5):
+        with pytest.raises(ValueError, match="credentials"):
+            auth.login("t@x.com", "wrong", source="1.2.3.4", now=t)
+    # 6th attempt is locked out even with the RIGHT password
+    with pytest.raises(ValueError, match="too many login attempts"):
+        auth.login("t@x.com", "right-password", source="1.2.3.4", now=t + 1)
+    # a different source (or victim's own address) is unaffected
+    user, token = auth.login("t@x.com", "right-password",
+                             source="5.6.7.8", now=t + 1)
+    assert token
+    # window expiry unlocks
+    user, token = auth.login("t@x.com", "right-password",
+                             source="1.2.3.4", now=t + 61)
+    assert token
+    # success cleared the limiter: failures start counting from zero
+    for _ in range(4):
+        with pytest.raises(ValueError, match="credentials"):
+            auth.login("t@x.com", "wrong", source="1.2.3.4", now=t + 62)
+    user, token = auth.login("t@x.com", "right-password",
+                             source="1.2.3.4", now=t + 63)
+    assert token
+
+
+def test_login_throttling_over_http(client):
+    for i in range(6):
+        r = client.post("/api/auth/login", json={
+            "email": "nobody@x.com", "password": "wrong"})
+        assert r.status_code == 422
+    msg = r.get_json()["message"]
+    assert "too many login attempts" in msg and "seconds" in msg
