@@ -386,7 +386,22 @@ def create_app(config: Optional[Config] = None,
                 if "max_events" in request.args else None
         except ValueError:
             max_events = None
-        subscription = state.bus.subscribe(channel)
+        # SSE resume: EventSource sends Last-Event-ID on reconnect;
+        # buses with a replay ring (in-memory) resume from it, others
+        # (Redis pub/sub has no history) just start live.
+        last_id = None
+        raw_lei = (request.headers.get("Last-Event-ID")
+                   or request.args.get("last_event_id"))
+        if raw_lei:
+            try:
+                last_id = int(raw_lei)
+            except ValueError:
+                last_id = None
+        try:
+            subscription = state.bus.subscribe(channel,
+                                               last_event_id=last_id)
+        except TypeError:
+            subscription = state.bus.subscribe(channel)
         return Response(
             sse_stream(subscription, max_events=max_events),
             mimetype="text/event-stream",

@@ -18,34 +18,60 @@ from typing import Dict, Iterator, List, Optional
 
 
 class InMemoryBus:
-    """Per-channel fan-out with bounded subscriber queues."""
+    """Per-channel fan-out with bounded subscriber queues.
 
-    def __init__(self, max_queue: int = 256) -> None:
+    Events carry per-channel monotonically increasing ids and a bounded
+    replay ring, so an SSE client reconnecting with ``Last-Event-ID``
+    resumes without losing ticks (the reference's flask-sse + the
+    dashboard's backoff reconnect silently drop whatever was published
+    while disconnected). Replay and live delivery are serialized under
+    one lock: publish assigns the id, appends history, and snapshots
+    subscribers atomically — a concurrent subscriber either replays an
+    event from history or receives it live, never both, never neither.
+    """
+
+    def __init__(self, max_queue: int = 256, history: int = 64) -> None:
         self._lock = threading.Lock()
         self._subscribers: Dict[str, List[queue.Queue]] = {}
         self._max_queue = max_queue
+        self._history_len = history
+        self._next_id: Dict[str, int] = {}
+        self._history: Dict[str, List] = {}  # channel -> [(id, data), …]
 
     def publish(self, channel: str, data: dict) -> int:
         with self._lock:
+            event_id = self._next_id.get(channel, 0) + 1
+            self._next_id[channel] = event_id
+            ring = self._history.setdefault(channel, [])
+            ring.append((event_id, data))
+            del ring[: max(0, len(ring) - self._history_len)]
             subs = list(self._subscribers.get(channel, ()))
         delivered = 0
         for q in subs:
             try:
-                q.put_nowait(data)
+                q.put_nowait((event_id, data))
                 delivered += 1
             except queue.Full:
                 # Slow consumer: drop oldest, keep the stream live.
                 try:
                     q.get_nowait()
-                    q.put_nowait(data)
+                    q.put_nowait((event_id, data))
                     delivered += 1
                 except (queue.Empty, queue.Full):
                     pass
         return delivered
 
-    def subscribe(self, channel: str) -> "Subscription":
+    def subscribe(self, channel: str,
+                  last_event_id: Optional[int] = None) -> "Subscription":
         q: queue.Queue = queue.Queue(maxsize=self._max_queue)
         with self._lock:
+            if last_event_id is not None:
+                for event_id, data in self._history.get(channel, ()):
+                    if event_id > last_event_id:
+                        try:
+                            q.put_nowait((event_id, data))
+                        except queue.Full:
+                            break
             self._subscribers.setdefault(channel, []).append(q)
         return Subscription(self, channel, q)
 
@@ -70,12 +96,15 @@ class Subscription:
         self._bus = bus
         self.channel = channel
         self._queue = q
+        self.last_id: Optional[int] = None  # id of the last get()'s event
 
     def get(self, timeout: Optional[float] = None) -> Optional[dict]:
         try:
-            return self._queue.get(timeout=timeout)
+            event_id, data = self._queue.get(timeout=timeout)
         except queue.Empty:
             return None
+        self.last_id = event_id
+        return data
 
     def close(self) -> None:
         self._bus._unsubscribe(self.channel, self._queue)
@@ -174,5 +203,11 @@ def sse_stream(subscription, keepalive_s: float = 15.0,
                     return
                 yield b": keepalive\n\n"
                 continue
-            yield f"data: {json.dumps(data)}\n\n".encode()
+            # ``id:`` lines make EventSource reconnects resumable via
+            # Last-Event-ID; backends without event ids (Redis pub/sub
+            # has no history) just omit them.
+            event_id = getattr(subscription, "last_id", None)
+            prefix = f"id: {event_id}\n".encode() if event_id is not None \
+                else b""
+            yield prefix + f"data: {json.dumps(data)}\n\n".encode()
             sent += 1

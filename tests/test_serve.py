@@ -496,3 +496,56 @@ def test_metrics_prometheus_format(client):
         float(line.rsplit(" ", 1)[1])
     # default stays JSON
     assert client.get("/api/metrics").get_json()["http"]["uptime_s"] >= 0
+
+
+def test_sse_resume_with_last_event_id(client):
+    # Publish three tracker ticks, "disconnect" after the first, then
+    # reconnect with Last-Event-ID: the missed ticks replay in order —
+    # the gap the reference's flask-sse + EventSource reconnect drops.
+    from routest_tpu.serve.bus import InMemoryBus
+
+    bus = InMemoryBus()
+    for i in range(3):
+        bus.publish("r1", {"tick": i})
+    with bus.subscribe("r1", last_event_id=1) as sub:
+        assert sub.get(0.1) == {"tick": 1} and sub.last_id == 2
+        assert sub.get(0.1) == {"tick": 2} and sub.last_id == 3
+        bus.publish("r1", {"tick": 3})  # live continues after replay
+        assert sub.get(0.1) == {"tick": 3} and sub.last_id == 4
+        assert sub.get(0.05) is None
+    # ring bound: only the last 64 events replay
+    big = InMemoryBus(history=4)
+    for i in range(10):
+        big.publish("c", {"i": i})
+    with big.subscribe("c", last_event_id=0) as sub:
+        got = [sub.get(0.05) for _ in range(4)]
+        assert [g["i"] for g in got] == [6, 7, 8, 9]
+        assert sub.get(0.05) is None
+
+
+def test_sse_resume_over_http(client):
+    def publish(n):
+        r = client.post("/api/update_tracker", json={
+            "route_id": "trip9", "route": [[121.04, 14.58]],
+            "destinations": [], "driver_name": f"d{n}",
+            "vehicle_type": "car", "duration": 60, "distance": 1000,
+            "trips": 1, "pickup_time": "2026-07-30T10:00:00"})
+        assert r.status_code == 200
+
+    publish(1)
+    publish(2)
+    r = client.get("/api/realtime_feed?channel=trip9&max_events=2",
+                   headers={"Last-Event-ID": "0"})
+    body = r.get_data(as_text=True)
+    assert "id: 1" in body and '"d1"' in body
+    assert "id: 2" in body and '"d2"' in body
+    # resume from 1: only the second event replays
+    r = client.get("/api/realtime_feed?channel=trip9&max_events=1",
+                   headers={"Last-Event-ID": "1"})
+    body = r.get_data(as_text=True)
+    assert '"d2"' in body and '"d1"' not in body
+    # a malformed header degrades to live-only, not an error
+    publish(3)
+    r2 = client.get("/api/realtime_feed?channel=trip9&max_events=1",
+                    headers={"Last-Event-ID": "garbage"})
+    assert r2.status_code == 200
