@@ -73,16 +73,24 @@ class _BrokerHandler(socketserver.StreamRequestHandler):
                         socket.SOL_SOCKET, socket.SO_SNDTIMEO,
                         struct.pack("ll", int(_SEND_TIMEOUT_S),
                                     int((_SEND_TIMEOUT_S % 1) * 1e6)))
+                    lei = msg.get("last_event_id")
+                    lei = int(lei) if isinstance(lei, (int, float)) else None
                     # Register-then-ack, both under the write lock: the
                     # ack must imply "registered" (a caller may publish
                     # immediately after subscribe() returns), while the
                     # lock keeps any concurrent fanout push from landing
-                    # on the wire ahead of the ack. Lock order is safe:
-                    # fanout copies its targets out of _subs_lock before
-                    # taking any handler's write lock.
+                    # on the wire ahead of the ack — and therefore ahead
+                    # of the replay lines, which must precede live
+                    # events. Lock order is safe: fanout copies its
+                    # targets out of _subs_lock before taking any
+                    # handler's write lock; register+ring-copy are atomic
+                    # under _subs_lock, so every event is replayed or
+                    # pushed live, never both or neither.
                     with self._wlock:
-                        server.add_subscriber(subscribed, self)
+                        replay = server.add_subscriber(subscribed, self, lei)
                         self.wfile.write(b'{"ok": true}\n')
+                        for line in replay:
+                            self.wfile.write(line)
                         self.wfile.flush()
                 else:
                     self._send({"ok": False, "error": f"unknown op {op!r}"})
@@ -113,26 +121,44 @@ class Broker(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+    HISTORY = 64  # replay-ring length per channel (matches InMemoryBus)
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         super().__init__((host, port), _BrokerHandler)
         self._subs: Dict[str, Set[_BrokerHandler]] = {}
         self._subs_lock = threading.Lock()
+        self._next_id: Dict[str, int] = {}
+        self._history: Dict[str, list] = {}  # channel -> [(id, line), …]
 
     @property
     def port(self) -> int:
         return self.server_address[1]
 
-    def add_subscriber(self, channel: str, h: _BrokerHandler) -> None:
+    def add_subscriber(self, channel: str, h: _BrokerHandler,
+                       last_event_id: Optional[int] = None) -> list:
+        """Register; returns the replay lines (id > last_event_id), copied
+        atomically with registration so exactly-once holds vs fanout."""
         with self._subs_lock:
             self._subs.setdefault(channel, set()).add(h)
+            if last_event_id is None:
+                return []
+            return [line for event_id, line
+                    in self._history.get(channel, ())
+                    if event_id > last_event_id]
 
     def drop_subscriber(self, channel: str, h: _BrokerHandler) -> None:
         with self._subs_lock:
             self._subs.get(channel, set()).discard(h)
 
     def fanout(self, channel: str, data) -> int:
-        line = json.dumps({"channel": channel, "data": data}).encode() + b"\n"
         with self._subs_lock:
+            event_id = self._next_id.get(channel, 0) + 1
+            self._next_id[channel] = event_id
+            line = json.dumps({"channel": channel, "id": event_id,
+                               "data": data}).encode() + b"\n"
+            ring = self._history.setdefault(channel, [])
+            ring.append((event_id, line))
+            del ring[: max(0, len(ring) - self.HISTORY)]
             targets = list(self._subs.get(channel, ()))
         delivered = 0
         for h in targets:
@@ -242,10 +268,13 @@ class NetBus:
                               "data": data}, retry_after_ack_loss=False)
         return int(resp.get("receivers", 0))
 
-    def subscribe(self, channel: str) -> "_NetSubscription":
+    def subscribe(self, channel: str,
+                  last_event_id: Optional[int] = None) -> "_NetSubscription":
         conn = socket.create_connection(self._addr, timeout=self._timeout)
-        conn.sendall(json.dumps({"op": "subscribe",
-                                 "channel": channel}).encode() + b"\n")
+        req = {"op": "subscribe", "channel": channel}
+        if last_event_id is not None:
+            req["last_event_id"] = int(last_event_id)
+        conn.sendall(json.dumps(req).encode() + b"\n")
         sub = _NetSubscription(conn)
         ack = sub._read_line(timeout=self._timeout)
         if ack is None or not json.loads(ack).get("ok"):
@@ -280,6 +309,7 @@ class _NetSubscription:
         self._conn.setblocking(False)
         self._buf = bytearray()
         self.closed = False  # broker gone / dropped us — stream should end
+        self.last_id: Optional[int] = None  # last delivered event id
 
     def _read_line(self, timeout: float) -> Optional[bytes]:
         deadline = time.monotonic() + max(timeout, 0.001)
@@ -321,9 +351,12 @@ class _NetSubscription:
         if not line:
             return None
         try:
-            return json.loads(line).get("data")
+            msg = json.loads(line)
         except ValueError:
             return None
+        if "id" in msg:  # enables SSE Last-Event-ID resume downstream
+            self.last_id = msg["id"]
+        return msg.get("data")
 
     def close(self) -> None:
         try:

@@ -262,3 +262,29 @@ def test_netbus_unit_roundtrip():
             pytest.fail("dead subscriber never dropped")
     finally:
         broker.shutdown()
+
+
+def test_netbus_resume_with_last_event_id():
+    # Cross-process SSE resume: the broker keeps a per-channel replay
+    # ring, so a subscriber reconnecting with last_event_id receives the
+    # missed events in order, exactly once, then continues live.
+    from routest_tpu.serve.netbus import NetBus, start_broker
+
+    broker, thread = start_broker()
+    try:
+        bus = NetBus(f"tcp://127.0.0.1:{broker.port}")
+        for i in range(5):
+            bus.publish("r", {"i": i})
+        with bus.subscribe("r", last_event_id=2) as sub:
+            got = [sub.get(1.0) for _ in range(3)]
+            assert [g["i"] for g in got] == [2, 3, 4]
+            assert sub.last_id == 5
+            bus.publish("r", {"i": 5})
+            live = sub.get(2.0)
+            assert live == {"i": 5} and sub.last_id == 6
+            assert sub.get(0.1) is None  # nothing duplicated
+        # plain subscribe (no resume) starts live-only as before
+        with bus.subscribe("r") as sub2:
+            assert sub2.get(0.2) is None
+    finally:
+        broker.shutdown()
