@@ -532,8 +532,6 @@ def create_app(config: Optional[Config] = None,
             "batcher": state.eta.stats,
         }
         if request.args.get("format") == "prometheus":
-            from routest_tpu.serve.wsgi import Response
-
             return Response(_prometheus_text(snapshot), 200,
                             mimetype="text/plain; version=0.0.4")
         return snapshot, 200

@@ -122,9 +122,17 @@ class AuthService:
     def _throttle_hit(self, key: str, now: float) -> None:
         if len(self._attempts) > 10_000:
             # Unauthenticated attackers control the key space (junk
-            # emails); purge lapsed windows so the table stays bounded.
+            # emails): purge lapsed windows, and if a live flood keeps
+            # the table over the cap anyway, HARD-evict the soonest-to-
+            # expire half. The cost is forgetting some attackers'
+            # counters early — bounded memory wins; the O(n log n)
+            # amortizes to O(log n) per hit (one sort per ~5k inserts).
             self._attempts = {k: v for k, v in self._attempts.items()
                               if v[1] > now}
+            if len(self._attempts) > 10_000:
+                keep = sorted(self._attempts.items(),
+                              key=lambda kv: kv[1][1], reverse=True)[:5_000]
+                self._attempts = dict(keep)
         count, expires = self._attempts.get(key, (0, 0.0))
         if expires <= now:  # window lapsed: start a fresh one
             count, expires = 0, now + self.THROTTLE_DECAY_S

@@ -30,6 +30,8 @@ class InMemoryBus:
     event from history or receives it live, never both, never neither.
     """
 
+    MAX_CHANNELS = 1024  # replay-state cap (channel names are client data)
+
     def __init__(self, max_queue: int = 256, history: int = 64) -> None:
         self._lock = threading.Lock()
         self._subscribers: Dict[str, List[queue.Queue]] = {}
@@ -37,11 +39,32 @@ class InMemoryBus:
         self._history_len = history
         self._next_id: Dict[str, int] = {}
         self._history: Dict[str, List] = {}  # channel -> [(id, data), …]
+        self._last_pub: Dict[str, float] = {}
+
+    def _evict_stale_locked(self, now: float) -> None:
+        """Channel names come from clients (route_id), so replay state
+        must be bounded: past MAX_CHANNELS, drop the least-recently
+        published channels WITHOUT live subscribers (their resume
+        window is long gone anyway)."""
+        if len(self._history) <= self.MAX_CHANNELS:
+            return
+        idle = sorted(
+            (ch for ch in self._history if not self._subscribers.get(ch)),
+            key=lambda ch: self._last_pub.get(ch, 0.0))
+        for ch in idle[: max(0, len(self._history) - self.MAX_CHANNELS)]:
+            self._history.pop(ch, None)
+            self._next_id.pop(ch, None)
+            self._last_pub.pop(ch, None)
 
     def publish(self, channel: str, data: dict) -> int:
+        import time as _time
+
         with self._lock:
+            now = _time.monotonic()
+            self._evict_stale_locked(now)
             event_id = self._next_id.get(channel, 0) + 1
             self._next_id[channel] = event_id
+            self._last_pub[channel] = now
             ring = self._history.setdefault(channel, [])
             ring.append((event_id, data))
             del ring[: max(0, len(ring) - self._history_len)]

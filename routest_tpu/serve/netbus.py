@@ -74,7 +74,11 @@ class _BrokerHandler(socketserver.StreamRequestHandler):
                         struct.pack("ll", int(_SEND_TIMEOUT_S),
                                     int((_SEND_TIMEOUT_S % 1) * 1e6)))
                     lei = msg.get("last_event_id")
-                    lei = int(lei) if isinstance(lei, (int, float)) else None
+                    try:  # json floats include Infinity/NaN: int() raises
+                        lei = int(lei) if isinstance(lei, (int, float)) \
+                            else None
+                    except (ValueError, OverflowError):
+                        lei = None
                     # Register-then-ack, both under the write lock: the
                     # ack must imply "registered" (a caller may publish
                     # immediately after subscribe() returns), while the
@@ -122,6 +126,7 @@ class Broker(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
     HISTORY = 64  # replay-ring length per channel (matches InMemoryBus)
+    MAX_CHANNELS = 1024  # replay-state cap (channel names are client data)
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         super().__init__((host, port), _BrokerHandler)
@@ -129,6 +134,19 @@ class Broker(socketserver.ThreadingTCPServer):
         self._subs_lock = threading.Lock()
         self._next_id: Dict[str, int] = {}
         self._history: Dict[str, list] = {}  # channel -> [(id, line), …]
+        self._last_pub: Dict[str, float] = {}
+
+    def _evict_stale_locked(self, now: float) -> None:
+        """Bound replay state (same policy as InMemoryBus): past the cap,
+        drop the least-recently published subscriber-less channels."""
+        if len(self._history) <= self.MAX_CHANNELS:
+            return
+        idle = sorted((ch for ch in self._history if not self._subs.get(ch)),
+                      key=lambda ch: self._last_pub.get(ch, 0.0))
+        for ch in idle[: max(0, len(self._history) - self.MAX_CHANNELS)]:
+            self._history.pop(ch, None)
+            self._next_id.pop(ch, None)
+            self._last_pub.pop(ch, None)
 
     @property
     def port(self) -> int:
@@ -152,8 +170,11 @@ class Broker(socketserver.ThreadingTCPServer):
 
     def fanout(self, channel: str, data) -> int:
         with self._subs_lock:
+            now = time.monotonic()
+            self._evict_stale_locked(now)
             event_id = self._next_id.get(channel, 0) + 1
             self._next_id[channel] = event_id
+            self._last_pub[channel] = now
             line = json.dumps({"channel": channel, "id": event_id,
                                "data": data}).encode() + b"\n"
             ring = self._history.setdefault(channel, [])

@@ -549,3 +549,21 @@ def test_sse_resume_over_http(client):
     r2 = client.get("/api/realtime_feed?channel=trip9&max_events=1",
                     headers={"Last-Event-ID": "garbage"})
     assert r2.status_code == 200
+
+
+def test_bus_replay_state_bounded():
+    # Channel names are client data (route_id): replay rings must not
+    # grow without bound when clients spray unique channels.
+    from routest_tpu.serve.bus import InMemoryBus
+
+    bus = InMemoryBus()
+    for i in range(bus.MAX_CHANNELS + 500):
+        bus.publish(f"junk-{i}", {"i": i})
+    assert len(bus._history) <= bus.MAX_CHANNELS + 1
+    # a channel with a live subscriber survives eviction
+    sub = bus.subscribe("keeper")
+    bus.publish("keeper", {"k": 1})
+    for i in range(bus.MAX_CHANNELS + 500):
+        bus.publish(f"junk2-{i}", {"i": i})
+    assert "keeper" in bus._history
+    sub.close()
