@@ -288,3 +288,23 @@ def test_netbus_resume_with_last_event_id():
             assert sub2.get(0.2) is None
     finally:
         broker.shutdown()
+
+
+def test_broker_replay_state_bounded():
+    from routest_tpu.serve.netbus import NetBus, start_broker
+
+    broker, _ = start_broker()
+    try:
+        bus = NetBus(f"tcp://127.0.0.1:{broker.port}")
+        for i in range(broker.MAX_CHANNELS + 300):
+            bus.publish(f"junk-{i}", {"i": i})
+        assert len(broker._history) <= broker.MAX_CHANNELS + 1
+        # live subscriber keeps its channel resumable through the flood
+        with bus.subscribe("keeper") as sub:
+            bus.publish("keeper", {"k": 1})
+            assert sub.get(2.0) == {"k": 1}
+            for i in range(broker.MAX_CHANNELS + 300):
+                bus.publish(f"junk2-{i}", {"i": i})
+            assert "keeper" in broker._history
+    finally:
+        broker.shutdown()
