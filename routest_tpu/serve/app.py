@@ -417,8 +417,13 @@ def create_app(config: Optional[Config] = None,
         except ValueError:
             limit = 20
         limit = max(1, min(limit, 100))
+        # Additive filter: ?engine=ml|default narrows server-side (the
+        # dashboard's ML badge filter otherwise pages through everything).
+        engine = request.args.get("engine")
+        if engine is not None and engine not in ("ml", "default"):
+            return {"error": "engine must be 'ml' or 'default'"}, 400
         try:
-            rows = state.store.list_history(limit)
+            rows = state.store.list_history(limit, engine=engine)
         except Exception as e:
             return {"error": f"history fetch failed: {e}"}, 500
 

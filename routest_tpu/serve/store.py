@@ -27,7 +27,8 @@ from typing import Dict, List, Optional, Protocol
 class Store(Protocol):
     def insert_request(self, row: Dict) -> str: ...
     def insert_result(self, row: Dict) -> None: ...
-    def list_history(self, limit: int) -> List[Dict]: ...
+    def list_history(self, limit: int,
+                     engine: Optional[str] = None) -> List[Dict]: ...
     def get_request(self, req_id: str) -> Optional[Dict]: ...
     def delete_request(self, req_id: str) -> bool: ...
     def ping(self) -> bool: ...
@@ -63,10 +64,14 @@ class InMemoryStore:
                 raise KeyError(f"route_requests.{req_id} does not exist")
             self._results.setdefault(req_id, []).append(result)
 
-    def list_history(self, limit: int) -> List[Dict]:
+    def list_history(self, limit: int,
+                     engine: Optional[str] = None) -> List[Dict]:
         with self._lock:
             rows = sorted(self._requests.values(),
-                          key=lambda r: r["request_time"], reverse=True)[:limit]
+                          key=lambda r: r["request_time"], reverse=True)
+            if engine is not None:
+                rows = [r for r in rows if r.get("engine") == engine]
+            rows = rows[:limit]
             return [
                 {**r, "route_results": list(self._results.get(r["id"], ()))}
                 for r in rows
@@ -135,11 +140,15 @@ class PostgRESTStore:
         "created_at,eta_minutes_ml,eta_completion_time_ml,geometry)"
     )
 
-    def list_history(self, limit: int) -> List[Dict]:
+    def list_history(self, limit: int,
+                     engine: Optional[str] = None) -> List[Dict]:
+        params = {"select": self._HISTORY_SELECT,
+                  "order": "request_time.desc", "limit": str(limit)}
+        if engine is not None:
+            params["engine"] = f"eq.{engine}"  # PostgREST filter syntax
         r = self._requests_lib.get(
             f"{self._rest}/route_requests", headers=self._headers,
-            params={"select": self._HISTORY_SELECT,
-                    "order": "request_time.desc", "limit": str(limit)},
+            params=params,
             timeout=self._timeout,
         )
         r.raise_for_status()

@@ -567,3 +567,17 @@ def test_bus_replay_state_bounded():
         bus.publish(f"junk2-{i}", {"i": i})
     assert "keeper" in bus._history
     sub.close()
+
+
+def test_history_engine_filter(client):
+    # Persist one ML and one default route, then filter server-side.
+    client.post("/api/optimize_route", json=_route_payload(2, use_ml=True))
+    client.post("/api/optimize_route", json=_route_payload(2, use_ml=False))
+    all_rows = client.get("/api/history?limit=50").get_json()["items"]
+    ml_rows = client.get("/api/history?limit=50&engine=ml").get_json()["items"]
+    dft_rows = client.get(
+        "/api/history?limit=50&engine=default").get_json()["items"]
+    assert ml_rows and all(r["engine"] == "ml" for r in ml_rows)
+    assert dft_rows and all(r["engine"] == "default" for r in dft_rows)
+    assert len(ml_rows) + len(dft_rows) == len(all_rows)
+    assert client.get("/api/history?engine=bogus").status_code == 400
