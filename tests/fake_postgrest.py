@@ -83,6 +83,9 @@ class _Handler(BaseHTTPRequestHandler):
             if "id" in q:  # id=eq.<uuid>
                 want = q["id"][0].removeprefix("eq.")
                 rows = [r for r in rows if r["id"] == want]
+            if "engine" in q:  # engine=eq.ml|default (history filter)
+                want = q["engine"][0].removeprefix("eq.")
+                rows = [r for r in rows if r.get("engine") == want]
             if q.get("order", [""])[0].startswith("request_time.desc"):
                 rows = sorted(rows, key=lambda r: r["request_time"],
                               reverse=True)

@@ -126,6 +126,12 @@ def test_route_persisted_on_a_reads_from_b(cluster):
     ids = [item["request_id"] for item in hist["items"]]
     assert req_id in ids
 
+    # server-side engine filter goes through the PostgREST eq. param
+    _, ml_hist = _get(b, "/api/history?limit=10&engine=ml")
+    assert req_id in [i["request_id"] for i in ml_hist["items"]]
+    _, dft_hist = _get(b, "/api/history?limit=10&engine=default")
+    assert req_id not in [i["request_id"] for i in dft_hist["items"]]
+
     _, detail = _get(b, f"/api/history/{req_id}")
     assert detail["request"]["id"] == req_id
     assert detail["result"]["total_distance"] > 0
