@@ -173,6 +173,72 @@ def add_congestion_observations(graph: Dict[str, np.ndarray], seed: int = 0,
     return out
 
 
+def subdivide_graph(graph: Dict[str, np.ndarray], bends_per_edge: int = 2,
+                    jitter: float = 0.08, oneway_frac: float = 0.0,
+                    seed: int = 0) -> Dict[str, np.ndarray]:
+    """Intersection graph → OSM-extract *topology*: every street gains
+    ``bends_per_edge`` degree-2 geometry nodes (the defining shape of a
+    real extract, where ``load_osm`` keeps every ``<nd>`` bend as a
+    vertex — 70-85% of a real city's nodes are degree-2 chain
+    vertices), with perpendicular jitter so chains curve like streets,
+    and ``oneway_frac`` of streets keeping only their forward
+    direction. Chain vertices multiply the hop diameter by
+    ``bends_per_edge + 1``, which is exactly the regime that breaks
+    diameter-bound relaxation and that the partition overlay
+    (``optimize/hierarchy.py``) is built for.
+
+    Returns a topology-only graph dict (no congestion columns — pipe
+    through :func:`add_congestion_observations` for training data).
+    """
+    rng = np.random.default_rng(seed)
+    coords = np.asarray(graph["node_coords"], np.float64)
+    senders = np.asarray(graph["senders"], np.int64)
+    receivers = np.asarray(graph["receivers"], np.int64)
+    road_class = np.asarray(graph["road_class"], np.int32)
+    speed_limit = np.asarray(
+        graph.get("speed_limit", _CLASS_SPEED_MPS[road_class]), np.float32)
+    n = len(coords)
+    k = int(bends_per_edge)
+
+    # Unique undirected streets; attrs from each street's first edge.
+    key = np.minimum(senders, receivers) * n + np.maximum(senders, receivers)
+    _, first = np.unique(key, return_index=True)
+    a, b = senders[first], receivers[first]
+    u = len(a)
+    cls_u, spd_u = road_class[first], speed_limit[first]
+
+    # Bend coordinates: linear interpolation + perpendicular jitter.
+    t = ((np.arange(k) + 1) / (k + 1))[None, :, None]         # (1, k, 1)
+    bends = coords[a][:, None, :] * (1 - t) + coords[b][:, None, :] * t
+    d = coords[b] - coords[a]
+    norm = np.sqrt((d ** 2).sum(axis=1, keepdims=True)) + 1e-12
+    perp = np.stack([-d[:, 1], d[:, 0]], axis=1) / norm
+    amp = norm[:, :1] * jitter
+    bends += perp[:, None, :] * (rng.standard_normal((u, k, 1)) * amp[:, None])
+    new_coords = np.concatenate(
+        [coords, bends.reshape(-1, 2)]).astype(np.float32)
+
+    # Chains: a → bend_0 → … → bend_{k-1} → b (and back, unless oneway).
+    bend_ids = n + (np.arange(u)[:, None] * k + np.arange(k)[None, :])
+    seq = np.concatenate([a[:, None], bend_ids, b[:, None]], axis=1)
+    fwd_s, fwd_r = seq[:, :-1], seq[:, 1:]                    # (U, k+1)
+    keep_rev = rng.random(u) >= oneway_frac
+    new_s = np.concatenate([fwd_s.reshape(-1), fwd_r[keep_rev].reshape(-1)])
+    new_r = np.concatenate([fwd_r.reshape(-1), fwd_s[keep_rev].reshape(-1)])
+    reps = np.concatenate([np.repeat(np.arange(u), k + 1),
+                           np.repeat(np.arange(u)[keep_rev], k + 1)])
+    length = haversine_np(new_coords[new_s, 0], new_coords[new_s, 1],
+                          new_coords[new_r, 0], new_coords[new_r, 1])
+    return {
+        "node_coords": new_coords,
+        "senders": new_s.astype(np.int32),
+        "receivers": new_r.astype(np.int32),
+        "length_m": length.astype(np.float32),
+        "road_class": cls_u[reps],
+        "speed_limit": spd_u[reps],
+    }
+
+
 def generate_road_graph(n_nodes: int = 4096, k: int = 4, seed: int = 0,
                         noise_sigma: float = 0.06) -> Dict[str, np.ndarray]:
     """Graph dict: node_coords (N,2), senders/receivers (E,), edge feature

@@ -41,9 +41,22 @@ from routest_tpu.data.road_graph import (
     generate_road_graph,
     haversine_np,
 )
+from routest_tpu.optimize.hierarchy import (
+    HierarchicalIndex,
+    hier_min_nodes,
+    relax_from,
+    tight_pred,
+)
 from routest_tpu.utils.logging import get_logger
 
 _INF = jnp.float32(3e38)
+
+# Flat-relaxation sweeps run over hierarchy distances before
+# predecessor recovery: the overlay's re-associated sums round a few
+# ulps away from the sweep's own ``dist[s] + w`` assignments; a handful
+# of sweeps re-anchors ties near-bitwise (values are already exact, so
+# these are O(1), not O(diameter)).
+_POLISH_SWEEPS = 8
 
 
 @functools.partial(jax.jit, static_argnames=("n_nodes", "max_iters"))
@@ -61,58 +74,17 @@ def _bellman_ford(senders: jax.Array, receivers: jax.Array, w: jax.Array,
     nodes / 243k edges: 1.13 s vs 1.81 s for a 16-source batch).
     Returned predecessor ids index the SORTED edge order — the caller
     maps them back through its sort permutation.
+
+    The sweep and recovery primitives live in ``optimize/hierarchy.py``
+    (``relax_from`` / ``tight_pred``) — the partition overlay composes
+    the same kernels with a different initial table.
     """
     n_src = sources.shape[0]
     dist0 = jnp.full((n_src, n_nodes), _INF).at[
         jnp.arange(n_src), sources].set(0.0)
-
-    def seg_min(p):
-        return jax.ops.segment_min(p, receivers, num_segments=n_nodes,
-                                   indices_are_sorted=True)
-
-    def one_sweep(dist):
-        proposals = dist[:, senders] + w[None, :]          # (S, E)
-        return jnp.minimum(dist, jax.vmap(seg_min)(proposals))
-
-    # Several sweeps per while iteration: the loop's convergence check
-    # costs a device sync point, which DOMINATES small graphs (2k nodes:
-    # 546 ms → 40 ms measured on the TPU at 4 sweeps/iter; metro scale
-    # is compute-bound and indifferent). Converged early sweeps are
-    # no-ops, so at most k-1 sweeps are wasted.
-    k_sweeps = 4
-
-    def relax(state):
-        dist, _, it = state
-        new = dist
-        for _ in range(k_sweeps):
-            new = one_sweep(new)
-        return new, jnp.any(new < dist), it + k_sweeps
-
-    def keep_going(state):
-        _, changed, it = state
-        return changed & (it < max_iters)
-
-    dist, still_changing, _ = jax.lax.while_loop(
-        keep_going, relax, (dist0, jnp.asarray(True), jnp.zeros((), jnp.int32)))
-    converged = jnp.logical_not(still_changing)
-
-    # Tight-edge predecessor recovery: among edges with
-    # dist[s] + w == dist[r], any one lies on a shortest path; segment-max
-    # of the edge id picks one deterministically.
-    # dist[r] was assigned from the same f32 expression, so tight edges
-    # match near-bitwise; the small slack only admits exact ties.
-    tight = jnp.abs(dist[:, senders] + w[None, :] - dist[:, receivers]) <= 1e-2
-    e_ids = jnp.arange(senders.shape[0], dtype=jnp.int32)
-
-    def seg_max(t):
-        return jax.ops.segment_max(jnp.where(t, e_ids, -1), receivers,
-                                   num_segments=n_nodes,
-                                   indices_are_sorted=True)
-
-    # empty segments yield INT32_MIN; clamp to the -1 "no predecessor"
-    pred = jnp.maximum(jax.vmap(seg_max)(tight), -1)
-    # sources have distance 0; make them roots even if a tight cycle exists
-    pred = pred.at[jnp.arange(n_src), sources].set(-1)
+    dist, converged = relax_from(senders, receivers, w, dist0,
+                                 n_nodes=n_nodes, max_iters=max_iters)
+    pred = tight_pred(senders, receivers, w, dist, sources, n_nodes=n_nodes)
     return dist, pred, converged
 
 
@@ -180,6 +152,17 @@ class RoadRouter:
         self._bf_senders = jnp.asarray(self.senders[self._bf_perm])
         self._bf_receivers = jnp.asarray(self.receivers[self._bf_perm])
         self._bf_length = jnp.asarray(self.length_m[self._bf_perm])
+        # Metro-scale graphs route through the two-level partition
+        # overlay (``optimize/hierarchy.py``): the flat sweep's
+        # iteration count is the graph's hop diameter, which crosses
+        # from "fine" to "seconds per solve" around tens of thousands
+        # of nodes. The overlay answers the same queries exactly in
+        # O(cells-across) sweeps after a one-time batched precompute.
+        self._hier: Optional[HierarchicalIndex] = None
+        hmin = hier_min_nodes()
+        if hmin and self.n_nodes >= hmin:
+            self._hier = HierarchicalIndex.build(
+                self.coords, self.senders, self.receivers, self.length_m)
         # Learned leg costs: load the trained road-GNN when its training
         # graph fingerprint matches this router's node set.
         self._hour_times: Dict[int, np.ndarray] = {}
@@ -444,6 +427,23 @@ class RoadRouter:
         bucket = 1 << max(0, (n_src - 1)).bit_length()
         padded = np.full(bucket, source_nodes[0] if n_src else 0, np.int32)
         padded[:n_src] = source_nodes
+        if self._hier is not None:
+            # Overlay path: exact distances in O(cells-across) sweeps,
+            # then a few polish sweeps so the tight-edge recovery sees
+            # the flat relaxation's own tie structure. Convergence is
+            # guaranteed by construction (the overlay loop's bound is
+            # its exact node count), so no exhaustion re-run exists.
+            dist_d = self._hier.shortest_device(padded)
+            dist_d, _ = relax_from(
+                self._bf_senders, self._bf_receivers, self._bf_length,
+                dist_d, n_nodes=self.n_nodes, max_iters=_POLISH_SWEEPS)
+            pred_d = tight_pred(
+                self._bf_senders, self._bf_receivers, self._bf_length,
+                dist_d, jnp.asarray(padded), n_nodes=self.n_nodes)
+            dist, pred = jax.device_get((dist_d, pred_d))
+            pred = pred[:n_src]
+            pred = np.where(pred >= 0, self._bf_perm[np.maximum(pred, 0)], -1)
+            return dist[:n_src], pred
         # ONE batched device_get for (dist, pred, converged): separate
         # np.asarray fetches each pay a full tunnel round trip (~70 ms),
         # which dominated small-graph request latency (252 → 102 ms

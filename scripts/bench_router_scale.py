@@ -1,14 +1,25 @@
-"""Road-router scale benchmark: metro-scale graphs (VERDICT r2 #5).
+"""Road-router scale benchmark: metro-scale graphs (VERDICT r2 #5, r3 #1).
 
-Measures the on-device batched Bellman-Ford shortest-path solver
-(``optimize/road_router.py``) from the 2k-node serving default up to a
-≥50k-node metro-scale network — ORS-class territory, the engine the
-reference outsources its matrix calls to (``Flaskr/utils.py:97-103``).
+Measures the on-device shortest-path solver (``optimize/road_router.py``)
+from the 2k-node serving default up to a ≥250k-node metro network with
+OSM-extract topology — ORS-class territory, the engine the reference
+outsources its matrix calls to (``Flaskr/utils.py:97-103``).
 
-Per size: graph build time, router init (bridging + device upload),
-cold solve (includes the XLA compile for that padded source bucket),
-and warm solve wall time for a 16-waypoint batch (the quantity that
-gates request latency — one solve prices a whole (M, M) leg matrix).
+Two solver regimes are exercised: the flat batched Bellman-Ford below
+``ROUTEST_HIER_MIN_NODES`` and the two-level partition overlay
+(``optimize/hierarchy.py``) above it. Per size: graph build time,
+router init (bridging + overlay precompute + device upload), cold solve
+(XLA compile for that source bucket), warm solve wall time for a
+16-waypoint batch (the quantity that gates request latency — one solve
+prices a whole (M, M) leg matrix), and with ``--verify`` a scipy
+Dijkstra oracle parity check.
+
+The ``--osm-nodes`` row builds an OSM-*topology* network (degree-2 bend
+chains + one-ways via ``data/road_graph.py:subdivide_graph``), writes it
+as real OSM XML and re-ingests it through ``data/osm.py:load_osm`` (the
+native-scanner path), so the row routes what an actual extract parse
+produces. A licensed real-city extract can't ship in this zero-egress
+sandbox; topology + ingest path are the honest stand-in.
 
 Writes artifacts/router_scale.json and prints a markdown table.
 Runs on whatever jax backend is active (TPU through the tunnel when
@@ -21,16 +32,61 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bench_router(router, args, np, rng):
+    pts = np.stack([
+        rng.uniform(14.40, 14.68, args.waypoints),
+        rng.uniform(120.96, 121.10, args.waypoints),
+    ], axis=1).astype(np.float32)
+    nodes = router.snap(pts)
+
+    t0 = time.perf_counter()
+    dist, _ = router.shortest(nodes)            # cold: pays compile
+    t_cold = time.perf_counter() - t0
+
+    solves = []
+    for _ in range(3):                           # warm: steady state
+        t0 = time.perf_counter()
+        dist, _ = router.shortest(nodes)
+        solves.append(time.perf_counter() - t0)
+    return nodes, dist, t_cold, min(solves)
+
+
+def _verify(router, nodes, dist, np):
+    """Max relative error vs a float64 Dijkstra oracle (scipy)."""
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import dijkstra
+
+    n = router.n_nodes
+    adj = sp.coo_matrix(
+        (router.length_m, (router.senders, router.receivers)),
+        shape=(n, n)).tocsr()
+    want = dijkstra(adj, directed=True, indices=np.asarray(nodes, np.int64))
+    finite = np.isfinite(want)
+    # Disagreement in EITHER direction is a failure: router-unreachable
+    # where the oracle routes, or router-finite where the oracle says
+    # unreachable (one-way pockets on the osm_extract row).
+    if (dist[finite] > 1e37).any() or (dist[~finite] < 1e37).any():
+        return float("inf")
+    err = np.abs(dist[finite] - want[finite]) / np.maximum(want[finite], 1.0)
+    return float(err.max())
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--sizes", type=int, nargs="+",
                         default=[2048, 8192, 50_000])
+    parser.add_argument("--osm-nodes", type=int, default=250_000,
+                        help="target size for the OSM-topology extract row "
+                             "(0 skips it)")
     parser.add_argument("--waypoints", type=int, default=16)
+    parser.add_argument("--verify", action="store_true",
+                        help="scipy Dijkstra oracle parity per row")
     parser.add_argument("--cpu", action="store_true",
                         help="hermetic CPU backend (TPU tunnel down)")
     args = parser.parse_args()
@@ -46,55 +102,64 @@ def main() -> None:
     import jax
     import numpy as np
 
-    from routest_tpu.data.road_graph import generate_road_graph
+    from routest_tpu.data.road_graph import generate_road_graph, subdivide_graph
     from routest_tpu.optimize.road_router import RoadRouter
 
     rows = []
     rng = np.random.default_rng(7)
-    for n in args.sizes:
-        t0 = time.perf_counter()
-        graph = generate_road_graph(n_nodes=n, k=4, seed=0)
-        t_gen = time.perf_counter() - t0
 
+    def run_case(graph, t_gen, topology):
         t0 = time.perf_counter()
-        router = RoadRouter(graph=graph, use_gnn=False)
+        router = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
         t_init = time.perf_counter() - t0
-
-        pts = np.stack([
-            rng.uniform(14.40, 14.68, args.waypoints),
-            rng.uniform(120.96, 121.10, args.waypoints),
-        ], axis=1).astype(np.float32)
-        nodes = router.snap(pts)
-
-        t0 = time.perf_counter()
-        dist, _ = router.shortest(nodes)            # cold: pays compile
-        t_cold = time.perf_counter() - t0
-
-        solves = []
-        for _ in range(3):                           # warm: steady state
-            t0 = time.perf_counter()
-            dist, _ = router.shortest(nodes)
-            solves.append(time.perf_counter() - t0)
-        t_warm = min(solves)
-
-        reach = np.isfinite(
-            np.where(dist < 1e37, dist, np.inf)).mean()
+        nodes, dist, t_cold, t_warm = _bench_router(router, args, np, rng)
+        reach = float((dist < 1e37).mean())
         row = {
             "nodes": router.n_nodes,
             "edges": int(len(router.senders)),
+            "topology": topology,
             "waypoints": args.waypoints,
             "graph_build_s": round(t_gen, 2),
             "router_init_s": round(t_init, 2),
             "solve_cold_ms": round(1000 * t_cold, 1),
             "solve_warm_ms": round(1000 * t_warm, 1),
-            "max_iters_bound": router.max_iters,
-            "reachable_frac": round(float(reach), 4),
+            "solver": "hierarchy" if router._hier is not None else "flat_bf",
+            "reachable_frac": round(reach, 4),
         }
+        if router._hier is not None:
+            row["hierarchy"] = router._hier.stats
+        else:
+            row["max_iters_bound"] = router.max_iters
+        if args.verify:
+            row["oracle_max_rel_err"] = _verify(router, nodes, dist, np)
         rows.append(row)
-        print(f"  {row['nodes']:>7,} nodes {row['edges']:>8,} edges | "
-              f"build {row['graph_build_s']}s init {row['router_init_s']}s | "
-              f"solve cold {row['solve_cold_ms']}ms warm "
-              f"{row['solve_warm_ms']}ms", flush=True)
+        print(f"  {row['nodes']:>7,} nodes {row['edges']:>9,} edges "
+              f"[{topology}/{row['solver']}] | build {row['graph_build_s']}s "
+              f"init {row['router_init_s']}s | solve cold "
+              f"{row['solve_cold_ms']}ms warm {row['solve_warm_ms']}ms"
+              + (f" | oracle err {row.get('oracle_max_rel_err'):.2e}"
+                 if args.verify else ""), flush=True)
+
+    for n in args.sizes:
+        t0 = time.perf_counter()
+        graph = generate_road_graph(n_nodes=n, k=4, seed=0)
+        run_case(graph, time.perf_counter() - t0, "generator")
+
+    if args.osm_nodes:
+        # intersections + 2 bends/street ≈ 1 + 2·2.43 nodes per
+        # intersection for the k=4 kNN street graph
+        n_int = max(1024, int(args.osm_nodes / 5.86))
+        t0 = time.perf_counter()
+        base = generate_road_graph(n_nodes=n_int, k=4, seed=0)
+        streets = subdivide_graph(base, bends_per_edge=2, oneway_frac=0.1,
+                                  seed=0)
+        from routest_tpu.data.osm import load_osm, save_osm
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "metro.osm.gz")
+            save_osm(path, streets)
+            extract = load_osm(path)
+        run_case(extract, time.perf_counter() - t0, "osm_extract")
 
     report = {"backend": jax.default_backend(), "rows": rows}
     out = os.path.join(os.path.dirname(os.path.dirname(
@@ -103,12 +168,13 @@ def main() -> None:
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
 
-    print(f"\n| nodes | edges | warm solve ({args.waypoints} sources) | "
-          f"cold (compile) |")
-    print("|---|---|---|---|")
+    print(f"\n| nodes | edges | topology | solver | warm solve "
+          f"({args.waypoints} sources) | cold (compile) |")
+    print("|---|---|---|---|---|---|")
     for r in rows:
-        print(f"| {r['nodes']:,} | {r['edges']:,} | {r['solve_warm_ms']} ms "
-              f"| {r['solve_cold_ms']} ms |")
+        print(f"| {r['nodes']:,} | {r['edges']:,} | {r['topology']} | "
+              f"{r['solver']} | {r['solve_warm_ms']} ms | "
+              f"{r['solve_cold_ms']} ms |")
     print(f"\nbackend={report['backend']} → {out}")
 
 

@@ -1,0 +1,130 @@
+"""Partition-overlay routing (optimize/hierarchy.py): exactness vs the
+scipy Dijkstra oracle on directed OSM-topology graphs, equivalence with
+the flat solver, partition invariants, and the subdivide generator."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+from scipy.sparse.csgraph import dijkstra
+
+from routest_tpu.data.road_graph import generate_road_graph, subdivide_graph
+from routest_tpu.optimize.hierarchy import HierarchicalIndex, partition_cells
+from routest_tpu.optimize.road_router import RoadRouter
+
+
+def _oracle(router, sources):
+    n = router.n_nodes
+    adj = sp.coo_matrix(
+        (router.length_m, (router.senders, router.receivers)), shape=(n, n)
+    ).tocsr()
+    return dijkstra(adj, directed=True, indices=np.asarray(sources, np.int64))
+
+
+@pytest.fixture()
+def force_hier(monkeypatch):
+    """Route even tiny graphs through the overlay (cell target shrunk so
+    a few hundred nodes still split into many cells)."""
+    monkeypatch.setenv("ROUTEST_HIER_MIN_NODES", "1")
+
+
+def test_partition_cells_bounded_and_total():
+    coords = np.random.default_rng(0).uniform(0, 1, (777, 2)).astype(np.float32)
+    cell, n_cells = partition_cells(coords, 50)
+    assert cell.shape == (777,) and n_cells >= 777 // 50
+    sizes = np.bincount(cell, minlength=n_cells)
+    assert sizes.max() <= 50 and sizes.sum() == 777
+
+
+def test_hierarchy_matches_dijkstra_symmetric(force_hier, rng):
+    router = RoadRouter(graph=generate_road_graph(n_nodes=1500, seed=2),
+                        use_gnn=False, use_transformer=False)
+    assert router._hier is not None, "overlay must engage under the env knob"
+    sources = rng.integers(0, router.n_nodes, 9)
+    dist, pred = router.shortest(sources)
+    want = _oracle(router, sources)
+    finite = np.isfinite(want)
+    assert finite.all()
+    np.testing.assert_allclose(dist[finite], want[finite], rtol=1e-4)
+    # Predecessor walks still reconstruct true-shortest paths.
+    edge_len = {}
+    for e, (s, r) in enumerate(zip(router.senders, router.receivers)):
+        key = (int(s), int(r))
+        edge_len[key] = min(edge_len.get(key, np.inf),
+                            float(router.length_m[e]))
+    for si, src in enumerate(sources):
+        for tgt in rng.integers(0, router.n_nodes, 6):
+            seq = router._walk(pred[si], int(src), int(tgt))
+            if int(tgt) == int(src):
+                continue
+            assert seq and seq[0] == int(src) and seq[-1] == int(tgt)
+            total = sum(edge_len[(a, b)] for a, b in zip(seq[:-1], seq[1:]))
+            np.testing.assert_allclose(total, dist[si, tgt], rtol=1e-3)
+
+
+def test_hierarchy_exact_on_directed_osm_topology(force_hier, rng):
+    # One-way chains: the regime where forward/backward restricted
+    # distances differ, so any direction slip in tables/cliques/stitch
+    # shows up as an oracle mismatch.
+    base = generate_road_graph(n_nodes=400, seed=5)
+    streets = subdivide_graph(base, bends_per_edge=3, oneway_frac=0.25, seed=1)
+    router = RoadRouter(graph=streets, use_gnn=False, use_transformer=False)
+    assert router._hier is not None
+    sources = rng.integers(0, router.n_nodes, 8)
+    dist, _ = router.shortest(sources)
+    want = _oracle(router, sources)
+    finite = np.isfinite(want)
+    assert finite.mean() > 0.5  # one-ways may strand some pockets
+    np.testing.assert_allclose(dist[finite], want[finite], rtol=1e-4)
+    assert (dist[~finite] > 1e37).all()  # unreachable stays unreachable
+
+
+def test_hierarchy_agrees_with_flat_solver(force_hier, monkeypatch, rng):
+    graph = generate_road_graph(n_nodes=900, seed=3)
+    hier = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
+    assert hier._hier is not None
+    sources = rng.integers(0, hier.n_nodes, 5)
+    d_hier, _ = hier.shortest(sources)
+    monkeypatch.setenv("ROUTEST_HIER_MIN_NODES", "0")
+    flat = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
+    assert flat._hier is None
+    d_flat, _ = flat.shortest(sources)
+    np.testing.assert_allclose(d_hier, d_flat, rtol=1e-5)
+
+
+def test_hierarchy_build_declines_tiny_graphs():
+    # A graph that fits one cell has no overlay to build.
+    g = generate_road_graph(n_nodes=64, seed=0)
+    idx = HierarchicalIndex.build(g["node_coords"], g["senders"],
+                                  g["receivers"], g["length_m"],
+                                  cell_target=4096)
+    assert idx is None
+
+
+def test_subdivide_graph_shapes_and_oneway():
+    base = generate_road_graph(n_nodes=300, seed=4)
+    n = len(base["node_coords"])
+    key = set()
+    for s, r in zip(base["senders"], base["receivers"]):
+        key.add((min(int(s), int(r)), max(int(s), int(r))))
+    u = len(key)
+    out = subdivide_graph(base, bends_per_edge=2, oneway_frac=0.3, seed=0)
+    assert len(out["node_coords"]) == n + 2 * u
+    # Bend nodes are degree-2 on the forward direction (one in, one out).
+    fwd_deg = np.bincount(out["senders"], minlength=len(out["node_coords"]))
+    assert (fwd_deg[n:] <= 2).all() and fwd_deg[n:].min() >= 1
+    # One-way streets have no reverse chain.
+    pairs = set(zip(out["senders"].tolist(), out["receivers"].tolist()))
+    missing_rev = sum((r, s) not in pairs for s, r in pairs)
+    assert missing_rev > 0
+    # Roundtrips through real OSM XML unchanged in size.
+    import os
+    import tempfile
+
+    from routest_tpu.data.osm import load_osm, save_osm
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "t.osm.gz")
+        save_osm(path, out)
+        back = load_osm(path)
+    assert len(back["node_coords"]) == len(out["node_coords"])
+    assert len(back["senders"]) == len(out["senders"])
