@@ -7,13 +7,21 @@ target is >=10,000 preds/sec (v5e-8). Prints ONE JSON line on stdout,
 always — even when the accelerator is unreachable.
 
 Architecture (hardened after round 1, where backend init hung >400 s and
-the driver captured rc=1 with no JSON):
+the driver captured rc=1 with no JSON; re-hardened after round 3, where
+a wedged tunnel burned the whole 250 s TPU window and the round record
+fell back to CPU with no accelerator evidence):
 
-* The PARENT process never imports jax. It launches the measurement as a
-  CHILD subprocess under a hard wall-clock deadline, first on the default
-  (TPU/axon) backend, then — if that child dies, hangs, or emits no
-  result — on the CPU backend with a smaller workload. Whatever happens,
-  the parent prints exactly one ``{"metric": ...}`` JSON line.
+* The PARENT process never imports jax. It first launches a PROBE child
+  (backend init + one 1-element dispatch+fetch under a ~25 s deadline)
+  to find out cheaply whether the tunnel is alive, then spends the
+  remaining budget where the probe says it is worth spending: a healthy
+  probe buys the full TPU attempt; a dead probe goes straight to the
+  CPU fallback and then RE-probes (wedges clear) for one short TPU
+  attempt. Probe outcomes (latency or timeout) are recorded in the
+  final JSON either way, so a CPU record carries the evidence that the
+  tunnel was down across the whole window rather than an unexplained
+  fallback. Whatever happens, the parent prints exactly one
+  ``{"metric": ...}`` JSON line within the driver's ~400 s kill window.
 * The CHILD (``ROUTEST_BENCH_CHILD=1``) does the actual timing.
 
 Methodology — the TPU is reached through a tunnel whose dispatch+fetch
@@ -26,6 +34,13 @@ round-trip cost. Two forward paths are measured — the jit-compiled XLA
 model and the fused Pallas kernel (``ops/fused_mlp.py``, TPU only) — and
 the faster wins. A successful accelerator run is recorded to
 ``artifacts/bench_tpu.json`` for audit.
+
+Roofline accounting (VERDICT r3 weak #7): the record carries achieved
+``tflops`` (analytic matmul FLOPs x measured rate), ``mfu`` vs the
+detected chip's dense peak for the model's compute dtype, and
+``hbm_gbps_lower_bound`` (minimum-traffic model: batch in+out plus one
+weight stream per step), so the "bandwidth-bound at ~2 FLOPs/byte"
+explanation is auditable from the artifact alone.
 """
 
 from __future__ import annotations
@@ -38,18 +53,63 @@ import time
 
 TARGET_PREDS_PER_SEC = 10_000.0  # BASELINE.json north star
 
-# Child workload knobs (overridable so the parent can shrink the CPU run).
+# Child workload knobs (overridable so the parent can shrink runs).
 BATCH = 1 << 17                  # 131,072 OD pairs per device call
 N_SHORT, N_LONG = 100, 400       # fori_loop lengths for the slope
 REPEATS = 3
 
-# Parent deadlines (seconds). The driver killed round 1 at ~400 s with no
-# output, so both attempts PLUS the two 10 s post-kill pipe drains must
-# sum below that: 250 + 110 + 2*10 = 390 s worst case.
+# Parent deadlines (seconds). The driver kills at ~400 s; every path
+# through the attempt ladder must finish (incl. two 10 s post-kill pipe
+# drains) below that:
+#   probe ok:    25 + 250 + (95 fallback)        = 370
+#   probe dead:  25 + 95 + 20 + 160              = 300
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "25"))
 TPU_ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_TPU_TIMEOUT", "250"))
-CPU_ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_CPU_TIMEOUT", "110"))
+CPU_ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_CPU_TIMEOUT", "95"))
+RETRY_PROBE_TIMEOUT = 20.0
+RETRY_TPU_TIMEOUT = 160.0
 
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__)) or "."
+
+# Dense peak (TFLOP/s for bf16 matmul, HBM GB/s) by device_kind
+# substring, lowercase. Sources: public TPU spec sheets.
+_CHIP_PEAKS = {
+    "v5 lite": (197.0, 819.0), "v5e": (197.0, 819.0),
+    "v5p": (459.0, 2765.0),
+    "v4": (275.0, 1228.0),
+    "v3": (123.0, 900.0),
+    "v6": (918.0, 1640.0), "trillium": (918.0, 1640.0),
+}
+
+
+def chip_peaks(device_kind: str):
+    """(peak_tflops_bf16, peak_hbm_gbps) or (None, None) if unknown."""
+    kind = (device_kind or "").lower()
+    for key, peaks in _CHIP_PEAKS.items():
+        if key in kind:
+            return peaks
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# Probe child: is the tunnel alive at all? One tiny dispatch, no model.
+# ---------------------------------------------------------------------------
+
+def probe_main() -> None:
+    t0 = time.perf_counter()
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":  # hermetic test path
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    x = jnp.asarray([1.0])
+    y = float((x + 1.0)[0])  # dispatch + host fetch round trip
+    print(json.dumps({
+        "probe": "ok", "backend": backend,
+        "probe_s": round(time.perf_counter() - t0, 2), "check": y == 2.0,
+    }))
 
 
 # ---------------------------------------------------------------------------
@@ -152,6 +212,49 @@ def child_main() -> None:
     path = min(candidates, key=candidates.get)
     per_iter = candidates[path]
     preds_per_sec = batch / per_iter
+
+    # Roofline: analytic FLOPs/bytes from the parameter tree (every 2D
+    # weight is one m x n matmul per row), measured rate from the slope.
+    leaves = jax.tree_util.tree_leaves(params)
+    weight_mats = [l for l in leaves if getattr(l, "ndim", 0) == 2]
+    flops_per_pred = float(sum(2 * l.shape[0] * l.shape[1]
+                               for l in weight_mats))
+    weight_bytes = float(sum(l.size * l.dtype.itemsize for l in leaves))
+    feat_bytes = x.shape[1] * x.dtype.itemsize
+    act_itemsize = jnp.dtype(model.policy.compute_dtype).itemsize
+    # Two traffic models bracket reality: the LOWER bound counts only
+    # the carried batch (read+write), the eta output, and one weight
+    # stream — true if every inter-layer activation stays in VMEM. The
+    # UPPER model adds every matmul output written to and re-read from
+    # HBM (batch x hidden_width x 2 passes), which is where a
+    # 131k-row batch actually lands (67 MB per 256-wide activation).
+    # Measured MFU far below the lower-bound arithmetic intensity's
+    # prediction ⇒ the upper model governs ⇒ bandwidth-bound.
+    io_bytes = batch * (2 * feat_bytes + 4) + weight_bytes
+    act_bytes = float(batch * sum(l.shape[1] for l in weight_mats)
+                      * act_itemsize * 2)
+    tflops = flops_per_pred * preds_per_sec / 1e12
+    kind = str(getattr(jax.devices()[0], "device_kind", backend))
+    peak_tflops, peak_hbm = chip_peaks(kind)
+    compute_dtype = jnp.dtype(model.policy.compute_dtype).name
+    roofline = {
+        "device_kind": kind,
+        "compute_dtype": compute_dtype,
+        "flops_per_pred": flops_per_pred,
+        "tflops": round(tflops, 2),
+        "hbm_gbps_lower_bound": round(io_bytes / per_iter / 1e9, 1),
+        "hbm_gbps_upper_model": round(
+            (io_bytes + act_bytes) / per_iter / 1e9, 1),
+        "arithmetic_intensity_flops_per_byte": round(
+            flops_per_pred * batch / (io_bytes + act_bytes), 2),
+    }
+    if peak_tflops is not None and compute_dtype == "bfloat16":
+        roofline["peak_tflops_bf16"] = peak_tflops
+        roofline["peak_hbm_gbps"] = peak_hbm
+        roofline["mfu"] = round(tflops / peak_tflops, 4)
+        roofline["hbm_frac_upper_model"] = round(
+            (io_bytes + act_bytes) / per_iter / 1e9 / peak_hbm, 4)
+
     print(json.dumps({
         "metric": "od_eta_preds_per_sec",
         "value": round(preds_per_sec, 1),
@@ -163,6 +266,7 @@ def child_main() -> None:
         "init_s": round(init_s, 1),
         "paths_mps": {k: round(batch / v / 1e6, 2)
                       for k, v in candidates.items()},
+        "roofline": roofline,
     }))
 
 
@@ -170,12 +274,12 @@ def child_main() -> None:
 # Parent: watchdog. Never imports jax; always prints one JSON line.
 # ---------------------------------------------------------------------------
 
-def _scan_result(stdout) -> dict | None:
+def _scan_result(stdout, key: str = '"metric"') -> dict | None:
     if isinstance(stdout, bytes):  # TimeoutExpired may carry raw bytes
         stdout = stdout.decode("utf-8", "replace")
     for line in reversed((stdout or "").splitlines()):
         line = line.strip()
-        if line.startswith("{") and '"metric"' in line:
+        if line.startswith("{") and key in line:
             try:
                 return json.loads(line)
             except json.JSONDecodeError:
@@ -183,13 +287,13 @@ def _scan_result(stdout) -> dict | None:
     return None
 
 
-def _run_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
-    """Run the measurement child; return (parsed JSON record, diagnostic)."""
+def _run_child(env_extra: dict, timeout_s: float,
+               scan_key: str = '"metric"') -> tuple[dict | None, str]:
+    """Run a measurement/probe child; return (parsed JSON, diagnostic)."""
     import signal
 
     env = dict(os.environ)
     env.update(env_extra)
-    env["ROUTEST_BENCH_CHILD"] = "1"
     timed_out = False
     try:
         # Own session so the deadline can killpg the whole tree: the JAX
@@ -222,7 +326,7 @@ def _run_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
     sys.stderr.write((stderr or "")[-2000:])
     # A child that printed its result and then hung in interpreter/backend
     # teardown (a known tunnel failure mode) still counts as a success.
-    rec = _scan_result(stdout)
+    rec = _scan_result(stdout, scan_key)
     if rec is not None:
         return rec, ""
     if timed_out:
@@ -231,36 +335,85 @@ def _run_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
     return None, f"rc={proc.returncode} no result line; tail={' | '.join(tail)}"
 
 
+def _probe(timeout_s: float) -> dict:
+    """Cheap tunnel-liveness check; always returns a record for the
+    final JSON (latency on success, the failure diagnostic otherwise)."""
+    t0 = time.perf_counter()
+    rec, diag = _run_child({"ROUTEST_BENCH_PROBE": "1"}, timeout_s,
+                           scan_key='"probe"')
+    wall = round(time.perf_counter() - t0, 1)
+    if rec is not None and rec.get("probe") == "ok":
+        return {"ok": rec.get("backend") == "tpu", "wall_s": wall,
+                "backend": rec.get("backend"),
+                "dispatch_s": rec.get("probe_s")}
+    return {"ok": False, "wall_s": wall, "error": diag}
+
+
+_CPU_ENV = {"BENCH_FORCE_CPU": "1", "BENCH_BATCH": str(1 << 14),
+            "BENCH_N_SHORT": "10", "BENCH_N_LONG": "40",
+            "BENCH_REPEATS": "2"}
+# Short second-chance TPU attempt: half-length loops, two repeats.
+_TPU_RETRY_ENV = {"BENCH_N_SHORT": "50", "BENCH_N_LONG": "200",
+                  "BENCH_REPEATS": "2"}
+
+
 def main() -> None:
+    if os.environ.get("ROUTEST_BENCH_PROBE") == "1":
+        probe_main()
+        return
     if os.environ.get("ROUTEST_BENCH_CHILD") == "1":
         child_main()
         return
 
     diags = []
-    # Attempt 1: default backend (TPU via axon when available).
-    rec, diag = _run_child({}, TPU_ATTEMPT_TIMEOUT)
+    probes = []
+    rec = None
+
+    probe = _probe(PROBE_TIMEOUT)
+    probes.append(probe)
+    if probe["ok"]:
+        # Tunnel alive: the full TPU window is worth spending.
+        rec, diag = _run_child({"ROUTEST_BENCH_CHILD": "1"},
+                               TPU_ATTEMPT_TIMEOUT)
+        if rec is None:
+            diags.append(f"accel: {diag}")
+    else:
+        diags.append(f"probe: {probe.get('error', 'not tpu')}")
+
     if rec is None:
-        diags.append(f"accel: {diag}")
-        # Attempt 2: CPU fallback, smaller workload so it finishes fast.
-        rec, diag = _run_child(
-            {"BENCH_FORCE_CPU": "1", "BENCH_BATCH": str(1 << 14),
-             "BENCH_N_SHORT": "10", "BENCH_N_LONG": "40",
-             "BENCH_REPEATS": "2"},
-            CPU_ATTEMPT_TIMEOUT)
+        # CPU fallback keeps the record non-empty whatever the tunnel does.
+        rec, diag = _run_child(dict(_CPU_ENV, ROUTEST_BENCH_CHILD="1"),
+                               CPU_ATTEMPT_TIMEOUT)
         if rec is None:
             diags.append(f"cpu: {diag}")
+        if probe.get("error"):
+            # The probe DIED (wedge/timeout) rather than answering
+            # "backend is cpu"; wedges clear, so spend leftover budget
+            # on one more try. A definitive cpu answer is final — no
+            # amount of retrying conjures a TPU.
+            probe2 = _probe(RETRY_PROBE_TIMEOUT)
+            probes.append(probe2)
+            if probe2["ok"]:
+                rec2, diag = _run_child(
+                    dict(_TPU_RETRY_ENV, ROUTEST_BENCH_CHILD="1"),
+                    RETRY_TPU_TIMEOUT)
+                if rec2 is not None:
+                    rec = rec2
+                else:
+                    diags.append(f"accel-retry: {diag}")
 
     if rec is None:
         # Total failure: still emit a parseable record with diagnostics.
         print(json.dumps({
             "metric": "od_eta_preds_per_sec", "value": 0.0,
             "unit": "preds/s", "vs_baseline": 0.0,
-            "error": "; ".join(diags),
+            "error": "; ".join(diags), "probes": probes,
         }))
         return
 
     if diags:
         rec["note"] = "; ".join(diags)
+    rec["probes"] = probes
     if rec.get("backend") == "tpu":
         try:
             art_dir = os.path.join(_REPO_DIR, "artifacts")

@@ -103,8 +103,11 @@ def load_osm(path: str) -> Dict[str, np.ndarray]:
         # The scanner needs the (decompressed) bytes in memory; cap the
         # slurp so a country-scale extract streams through the O(1)-
         # memory ElementTree path below instead of ballooning host RSS.
-        cap = int(os.environ.get("ROUTEST_NATIVE_OSM_MAX_BYTES",
-                                 str(256 * 1024 * 1024)))
+        try:
+            cap = int(os.environ.get("ROUTEST_NATIVE_OSM_MAX_BYTES",
+                                     str(256 * 1024 * 1024)))
+        except ValueError:  # malformed knob degrades like every other
+            cap = 256 * 1024 * 1024
         with _open(path) as f:
             buf = f.read(cap + 1)
         parsed = (native.parse_osm(buf, _CLASS_SPEED_MPS)

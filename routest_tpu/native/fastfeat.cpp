@@ -275,6 +275,28 @@ std::string to_lower(const std::string& s) {
 // ("1_0") are rejected — the Python path's _parse_maxspeed applies the
 // same strictness so the two stay observably identical (none of these
 // forms appear in real OSM data; they only matter for parity).
+// Consumed-value guards: the scanner does NOT decode XML entity
+// references (ElementTree does), and strtod/strtoll accept forms
+// (hex floats, "inf") that Python's parse rejects while Python accepts
+// forms ("1_0") strtod rejects. Rather than reimplement either quirk
+// set, any consumed value outside the boring charset makes the whole
+// parse return code 1 so load_osm falls back to the ElementTree path,
+// which owns the exact semantics. Display-only values (names etc.) are
+// never consumed, so real extracts with "Fifth &amp; Main" street
+// names keep the fast path.
+bool plain_numeric(const std::string& s) {
+    if (s.empty()) return false;
+    for (char c : s)
+        if (!((c >= '0' && c <= '9') || c == '+' || c == '-' ||
+              c == '.' || c == 'e' || c == 'E'))
+            return false;
+    return true;
+}
+
+bool entity_free(const std::string& s) {
+    return s.find('&') == std::string::npos;
+}
+
 bool parse_float(const std::string& s, double* out) {
     if (s.empty()) return false;
     for (char c : s) {
@@ -443,13 +465,16 @@ FfOsmResult* ff_osm_parse(const char* buf, int64_t len,
             for (auto& kv : at) {
                 double v;
                 if (kv.first == "id") {
+                    if (!plain_numeric(kv.second)) { res->code = 1; return res; }
                     char* e = nullptr;
                     id = strtoll(kv.second.c_str(), &e, 10);
                     has_id = e && *e == '\0' && !kv.second.empty();
-                } else if (kv.first == "lat" && parse_float(kv.second, &v)) {
-                    la = v; has_la = true;
-                } else if (kv.first == "lon" && parse_float(kv.second, &v)) {
-                    lo = v; has_lo = true;
+                } else if (kv.first == "lat" || kv.first == "lon") {
+                    if (!plain_numeric(kv.second)) { res->code = 1; return res; }
+                    if (parse_float(kv.second, &v)) {
+                        if (kv.first == "lat") { la = v; has_la = true; }
+                        else { lo = v; has_lo = true; }
+                    }
                 }
             }
             if (has_id && has_la && has_lo) coords[id] = {la, lo};
@@ -463,6 +488,7 @@ FfOsmResult* ff_osm_parse(const char* buf, int64_t len,
         } else if (name == "nd" && in_way) {
             for (auto& kv : at)
                 if (kv.first == "ref") {
+                    if (!plain_numeric(kv.second)) { res->code = 1; return res; }
                     char* e = nullptr;
                     int64_t r = strtoll(kv.second.c_str(), &e, 10);
                     if (e && *e == '\0' && !kv.second.empty())
@@ -476,6 +502,13 @@ FfOsmResult* ff_osm_parse(const char* buf, int64_t len,
                 else if (kv.first == "v") { v = kv.second; has_v = true; }
             }
             if (!has_v) continue;  // Python skips tags with no v attribute
+            // An entity reference in a key, or in a value one of the
+            // consumed keys would read, decodes differently under
+            // ElementTree: fall back rather than diverge.
+            if (!entity_free(k)) { res->code = 1; return res; }
+            if (k == "highway" || k == "maxspeed" || k == "oneway") {
+                if (!entity_free(v)) { res->code = 1; return res; }
+            }
             if (k == "highway") way_cls = highway_class(v);
             else if (k == "maxspeed") {
                 way_maxspeed = v;       // last tag wins; parsed at flush

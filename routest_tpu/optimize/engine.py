@@ -457,8 +457,11 @@ def optimize_route_batch(items) -> list:
     if not isinstance(items, list) or not items:
         return [{"error": "items must be a non-empty list"}]
     if len(items) > MAX_BATCH_PROBLEMS:
+        # One error PER item: library callers zip results against their
+        # inputs (the HTTP layer pre-checks, so only direct callers ever
+        # see this), and a single-element list would silently misalign.
         return [{"error": f"batch too large (max {MAX_BATCH_PROBLEMS} "
-                          f"problems)"}]
+                          f"problems)"} for _ in items]
     results: list = [None] * len(items)
     solve: list = []  # (index, parsed, dist, leg_cost, leg_geom)
 

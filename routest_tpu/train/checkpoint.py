@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -41,7 +42,10 @@ def _write_artifact(path: str, magic: bytes, header: dict,
     atomic on POSIX."""
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = f"{path}.tmp{os.getpid()}"
+    # pid alone is not unique enough: two threads in one process (e.g.
+    # concurrent trainers in tests) would interleave writes to the same
+    # temp file before os.replace.
+    tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
     try:
         with open(tmp, "wb") as f:
             f.write(magic)
@@ -217,6 +221,15 @@ def load_exported_serving_fn(path: str) -> ExportedServingModel:
             f"{path}: exported for platforms {list(exported.platforms)}, "
             f"but the running backend is {jax.default_backend()}; "
             f"re-export with --platforms {','.join(runnable)}")
+    # Same contract as EtaMLP.__post_init__: a quantile head must carry
+    # the median, or every per-request ``q.index(0.5)`` in the serving
+    # layer would raise (500s) instead of the graceful (None, None)
+    # degrade. Reject the foreign/hand-edited artifact at load time.
+    quantiles = header.get("quantiles") or []
+    if quantiles and 0.5 not in quantiles:
+        raise ValueError(
+            f"{path}: quantile export lacks the 0.5 median "
+            f"(quantiles={quantiles}); serving requires it")
     return ExportedServingModel(exported.call, header)
 
 

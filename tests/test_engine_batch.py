@@ -140,7 +140,8 @@ def test_varying_batch_sizes_share_programs():
 
 def test_engine_batch_size_guard():
     out = optimize_route_batch([_body(2)] * 257)
-    assert "batch too large" in out[0]["error"]
+    assert len(out) == 257  # one error per item: results stay zippable
+    assert all("batch too large" in r["error"] for r in out)
     assert optimize_route_batch([]) == [{"error":
                                          "items must be a non-empty list"}]
 
