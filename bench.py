@@ -196,15 +196,30 @@ def child_main() -> None:
             slopes.append((t_long - t_short) / (n_long - n_short))
         return max(float(np.median(slopes)), 1e-9)
 
-    candidates = {"xla": measure(lambda xx: model.apply(params, xx))}
+    # Quantile-headed artifacts (the serving default since round 4) score
+    # through apply_quantiles; the chained loop feeds the MEDIAN back so
+    # both model families time the same scalar-per-row dependency chain.
+    n_q = len(getattr(model, "quantiles", ()) or ())
+    if n_q:
+        xla_forward = lambda xx: model.apply_quantiles(  # noqa: E731
+            params, xx)[:, n_q // 2]
+    else:
+        xla_forward = lambda xx: model.apply(params, xx)  # noqa: E731
+    candidates = {"xla": measure(xla_forward)}
 
     if backend == "tpu":
         try:
             from routest_tpu.ops import fused_eta_forward, pack_eta_params
 
             packed = jax.device_put(pack_eta_params(model, params))
-            candidates["pallas_fused"] = measure(
-                lambda xx: fused_eta_forward(packed, xx))
+            fused = lambda xx: fused_eta_forward(packed, xx, n_q=n_q)  # noqa: E731
+            if n_q:
+                # quantile path returns (B, Q); time the same scalar
+                # chain as XLA by feeding the median back
+                candidates["pallas_fused"] = measure(
+                    lambda xx: fused(xx)[:, n_q // 2])
+            else:
+                candidates["pallas_fused"] = measure(fused)
         except Exception as e:  # kernel is an optimization, never a dependency
             print(f"bench: fused kernel unavailable: {type(e).__name__}: {e}",
                   file=sys.stderr)

@@ -239,12 +239,16 @@ def make_sp_train_step(model: RouteTransformer, optimizer, mesh: Mesh,
 def sample_route_sequences(graph: Dict[str, np.ndarray], n_routes: int,
                            seq_len: int, seed: int = 0,
                            noise_sigma: float = 0.06,
-                           return_hours: bool = False) -> Tuple[np.ndarray, ...]:
+                           return_hours: bool = False,
+                           return_true: bool = False) -> Tuple[np.ndarray, ...]:
     """Random-walk routes over a road graph → padded training tensors.
 
     Returns (feats (R, L, F), freeflow_s (R, L), targets (R, L),
     mask (R, L)) — plus hours (R,) when ``return_hours`` (the trainer
-    uses it for the held-out-hours split). One observation hour per
+    uses it for the held-out-hours split), plus noise-free
+    ground-truth times (R, L) when ``return_true`` (the trainer's
+    noise-floor computation: RMSE of observed vs true is the best any
+    model can do against observed labels). One observation hour per
     ROUTE (a vehicle drives its whole tour in one congestion regime);
     targets from the same congestion overlay the GNN trains on
     (``data/road_graph.py``), so the two learned leg-cost models are
@@ -266,6 +270,7 @@ def sample_route_sequences(graph: Dict[str, np.ndarray], n_routes: int,
     feats = np.zeros((n_routes, seq_len, N_EDGE_FEATURES), np.float32)
     freeflow = np.zeros((n_routes, seq_len), np.float32)
     targets = np.zeros((n_routes, seq_len), np.float32)
+    targets_true = np.zeros((n_routes, seq_len), np.float32)
     mask = np.zeros((n_routes, seq_len), np.float32)
 
     length = np.asarray(graph["length_m"], np.float32)
@@ -299,7 +304,11 @@ def sample_route_sequences(graph: Dict[str, np.ndarray], n_routes: int,
         t_true = true_edge_time_s(length[e_ids], rclass[e_ids],
                                   np.full(k, hour))
         targets[r, :k] = t_true * rng.lognormal(0.0, noise_sigma, k)
+        targets_true[r, :k] = t_true
         mask[r, :k] = 1.0
+    out = [feats, freeflow, targets, mask]
     if return_hours:
-        return feats, freeflow, targets, mask, hours
-    return feats, freeflow, targets, mask
+        out.append(hours)
+    if return_true:
+        out.append(targets_true)
+    return tuple(out)

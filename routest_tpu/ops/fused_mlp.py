@@ -96,13 +96,6 @@ def pack_eta_params(model, params) -> Packed:
     ``-mean/std · row``. All dims pad up to multiples of 128 (MXU tiles);
     padding rows/cols are zero so they are exact no-ops through gelu.
     """
-    if getattr(model, "quantiles", ()):
-        # The kernel's epilogue is the 2-head point model (pace·d +
-        # overhead from heads 0/1); for quantile models those heads are
-        # the q0/q1 pace increments — refuse rather than mis-serve
-        # (EtaService catches this and keeps the XLA path).
-        raise ValueError("fused kernel supports point models only; "
-                         "quantile models serve the XLA path")
     layers = params["layers"]
     norm = params["norm"]
     mean = np.asarray(norm["mean"], np.float32)
@@ -139,10 +132,15 @@ def pack_eta_params(model, params) -> Packed:
     return {"w": ws, "b": bs}
 
 
-def _kernel(n_layers: int, compute, x_ref, *refs) -> None:
+def _kernel(n_layers: int, compute, n_q: int, x_ref, *refs) -> None:
     """One batch tile: expand → matmul chain → eta, all in VMEM.
 
-    refs = w_0, b_0, …, w_{n-1}, b_{n-1}, out_ref.
+    refs = w_0, b_0, …, w_{n-1}, b_{n-1}, out_ref. ``n_q == 0`` is the
+    2-head point model; ``n_q > 0`` fuses the quantile epilogue too
+    (``EtaMLP.apply_quantiles``: cumulative softplus pace/overhead
+    increments ⇒ non-crossing quantiles), unrolled over the few heads —
+    pure VPU lane arithmetic, so the uncertainty band costs no extra
+    HBM pass.
     """
     out_ref = refs[-1]
     x = x_ref[:]  # (tile, 128) f32; ABI features in lanes 0:12, rest zero
@@ -174,16 +172,28 @@ def _kernel(n_layers: int, compute, x_ref, *refs) -> None:
         out = out + b_ref[:]
         if i < n_layers - 1:
             h = jax.nn.gelu(out).astype(compute)
-    pace = jax.nn.softplus(out[:, 0:1])
-    overhead = jax.nn.softplus(out[:, 1:2])
-    eta = pace * dist + overhead
-    out_ref[:] = jnp.broadcast_to(eta, (tile, LANES))
+    if n_q == 0:
+        pace = jax.nn.softplus(out[:, 0:1])
+        overhead = jax.nn.softplus(out[:, 1:2])
+        eta = pace * dist + overhead
+        out_ref[:] = jnp.broadcast_to(eta, (tile, LANES))
+    else:
+        pace = jnp.zeros((tile, 1), jnp.float32)
+        overhead = jnp.zeros((tile, 1), jnp.float32)
+        etas = []
+        for qi in range(n_q):  # unrolled cumsum: heads are few
+            pace = pace + jax.nn.softplus(out[:, qi:qi + 1])
+            overhead = overhead + jax.nn.softplus(out[:, n_q + qi:n_q + qi + 1])
+            etas.append(pace * dist + overhead)
+        etas.append(jnp.zeros((tile, LANES - n_q), jnp.float32))
+        out_ref[:] = jnp.concatenate(etas, axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
-def fused_eta_forward(packed: Packed, x: jax.Array, *,
+@functools.partial(jax.jit, static_argnames=("n_q", "tile", "interpret"))
+def fused_eta_forward(packed: Packed, x: jax.Array, *, n_q: int = 0,
                       tile: int = 2048, interpret: bool = False) -> jax.Array:
-    """(B, 12) ABI features → (B,) ETA minutes via the fused kernel.
+    """(B, 12) ABI features → (B,) ETA minutes — or (B, n_q) per-quantile
+    minutes for a quantile model — via the fused kernel.
 
     ``interpret=True`` runs the Pallas interpreter (any backend) — used by
     the CPU test suite; compiled mode requires a TPU.
@@ -212,7 +222,7 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *,
     bytes_accessed = (xp.size + b_pad * LANES) * 4 + sum(
         w.size * w.dtype.itemsize for w in ws)
     out = pl.pallas_call(
-        functools.partial(_kernel, n_layers, ws[0].dtype),
+        functools.partial(_kernel, n_layers, ws[0].dtype, n_q),
         grid=(b_pad // tile,),
         in_specs=[pl.BlockSpec((tile, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)] + wb_specs,
@@ -227,4 +237,6 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *,
         ),
         interpret=interpret,
     )(xp, *[a for pair in zip(ws, bs) for a in pair])
+    if n_q:
+        return out[:b_rows, :n_q]
     return out[:b_rows, 0]

@@ -342,8 +342,22 @@ class RoadRouter:
             targets=jnp.zeros((e,), jnp.float32),
             weights=jnp.ones((e,), jnp.float32),
         )
-        pred = np.asarray(model.apply(params, jnp.asarray(self.coords), batch),
-                          np.float32)
+        try:
+            pred = np.asarray(
+                model.apply(params, jnp.asarray(self.coords), batch),
+                np.float32)
+        except Exception as e:
+            # A loaded-but-unusable artifact (foreign shapes, backend
+            # quirk) must degrade to physics, not 500 the request path;
+            # drop it so the cost is paid once, not per request.
+            get_logger("routest.road").error(
+                "road_gnn_apply_failed", error=f"{type(e).__name__}: {e}")
+            with self._gnn_lock:
+                if self._model_gen == gen:
+                    self._gnn = None
+                    self._model_gen += 1
+                    self._hour_times.clear()
+            return self.freeflow_time_s
         # Physical floor: no edge is faster than free-flow at an
         # arterial ceiling — guards against a degenerate prediction
         # pricing an edge at ~0 s and distorting every route through it.
@@ -665,9 +679,17 @@ class RoadLegs:
             mask[wi, :k] = 1.0
         import jax.numpy as jnp
 
-        pred = np.asarray(model.apply(
-            params, jnp.asarray(feats), jnp.asarray(freeflow),
-            jnp.arange(s_max), key_mask=jnp.asarray(mask)), np.float32)
+        try:
+            pred = np.asarray(model.apply(
+                params, jnp.asarray(feats), jnp.asarray(freeflow),
+                jnp.arange(s_max), key_mask=jnp.asarray(mask)), np.float32)
+        except Exception as e:  # degrade to base pricing, drop the model
+            get_logger("routest.road").error(
+                "route_transformer_apply_failed",
+                error=f"{type(e).__name__}: {e}")
+            with r._gnn_lock:
+                r._transformer = None
+            return None
 
         # Stitch window predictions back into per-trip edge streams.
         stream: Dict[int, list] = {ti: [] for ti in range(len(trip_legs))}

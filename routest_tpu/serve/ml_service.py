@@ -390,46 +390,87 @@ class EtaService:
         self.kernel = "xla_tp"
         return score
 
-    def _maybe_fused_score(self, fallback):
-        """Opt-in swap to the fused Pallas kernel (``ops/fused_mlp.py``).
+    @staticmethod
+    def _fused_win_bucket() -> int:
+        """Largest batch size where the measured kernel bench says the
+        Pallas path wins, from ``artifacts/kernel_bench.json``
+        (``scripts/bench_serving_kernel.py`` — per-bucket slope-timed
+        head-to-head on the real chip). 0 = no recorded win."""
+        path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "artifacts", "kernel_bench.json")
+        try:
+            import json
 
-        Off by default: head-to-head benchmarking (see the kernel's
-        docstring) shows XLA faster for the current model size, so XLA
-        serves unless ``ROUTEST_FUSED=1``. Probed eagerly with one row:
-        any pack/compile failure (non-TPU backend, unexpected param
-        shapes, Mosaic regressions) keeps the XLA path — the kernel is
-        an optimization, never a dependency.
+            with open(path) as f:
+                rec = json.load(f)
+            if not isinstance(rec, dict) or rec.get("backend") != "tpu":
+                return 0
+            return int(rec.get("pallas_wins_max_bucket") or 0)
+        except Exception:  # any malformed record means "no recorded win"
+            return 0
+
+    def _maybe_fused_score(self, fallback):
+        """Measured-selection swap to the fused Pallas kernel
+        (``ops/fused_mlp.py``).
+
+        ``ROUTEST_FUSED``: "1" forces the kernel for every batch, "0"
+        forces XLA. Unset is AUTO: serve the kernel exactly for the
+        batch-size regime where the recorded head-to-head bench says it
+        wins (small buckets, where one fused dispatch beats XLA's
+        kernel chain) and XLA everywhere else — the per-size winner
+        table is ``artifacts/kernel_bench.json``, re-measured by
+        ``scripts/bench_serving_kernel.py``. Probed eagerly with one
+        row: any pack/compile failure (non-TPU backend, unexpected
+        param shapes, Mosaic regressions) keeps the XLA path — the
+        kernel is an optimization, never a dependency.
         """
-        if os.environ.get("ROUTEST_FUSED") != "1":
+        mode = os.environ.get("ROUTEST_FUSED", "auto")
+        if mode == "0":
+            return fallback
+        win_bucket = None if mode == "1" else self._fused_win_bucket()
+        if win_bucket == 0:
             return fallback
         if jax.default_backend() != "tpu":
             # Compiled Mosaic needs a TPU; interpreter mode would "work"
             # but orders of magnitude slower — never serve it.
-            from routest_tpu.utils.logging import get_logger
+            if mode == "1":
+                from routest_tpu.utils.logging import get_logger
 
-            get_logger("routest_tpu.serve").warning(
-                "fused_kernel_ignored",
-                reason=f"ROUTEST_FUSED=1 needs the TPU backend, "
-                       f"have {jax.default_backend()}; serving XLA")
+                get_logger("routest_tpu.serve").warning(
+                    "fused_kernel_ignored",
+                    reason=f"ROUTEST_FUSED=1 needs the TPU backend, "
+                           f"have {jax.default_backend()}; serving XLA")
             return fallback
         try:
             from routest_tpu.ops import fused_eta_forward, pack_eta_params
 
             packed = jax.device_put(pack_eta_params(self._model, self._params))
+            n_q = len(self.quantiles)
 
-            def score(x: np.ndarray) -> np.ndarray:
-                return fused_eta_forward(packed, jax.numpy.asarray(x))
+            def fused(x: np.ndarray) -> np.ndarray:
+                return fused_eta_forward(packed, jax.numpy.asarray(x),
+                                         n_q=n_q)
 
+            if win_bucket is None:
+                score = fused                       # forced: all batches
+                self.kernel = "pallas_fused"
+            else:
+                def score(x: np.ndarray) -> np.ndarray:
+                    if len(x) <= win_bucket:
+                        return fused(x)
+                    return fallback(x)
+
+                self.kernel = f"pallas_fused(<= {win_bucket})+xla"
             probe = np.zeros((1, self._model.n_features), np.float32)
-            if not np.isfinite(np.asarray(score(probe))).all():
+            if not np.isfinite(np.asarray(fused(probe))).all():
                 raise ValueError("fused kernel probe produced non-finite output")
-            self.kernel = "pallas_fused"
             return score
         except Exception as e:  # pragma: no cover - depends on backend
             from routest_tpu.utils.logging import get_logger
 
             get_logger("routest_tpu.serve").warning(
                 "fused_kernel_unavailable", error=f"{type(e).__name__}: {e}")
+            self.kernel = "xla"
             return fallback
 
     def _load(self, path: str) -> None:

@@ -311,6 +311,18 @@ def load_gnn(path: str):
                     n_rounds=header["n_rounds"], policy=policy)
     params = serialization.msgpack_restore(blob)
     params = jax.tree_util.tree_map(np.asarray, params)
+    # Feature-ABI gate: an artifact trained against an older
+    # edge_feature_array layout would pass the graph fingerprint and
+    # then shape-crash inside apply ON THE REQUEST PATH. The message
+    # MLP's input width pins the trained feature count; reject here so
+    # the router's loader degrades to the next pricer instead.
+    from routest_tpu.models.gnn import N_EDGE_FEATURES
+
+    f_in = int(params["msg"][0]["w"].shape[0]) - 2 * int(header["hidden"])
+    if f_in != N_EDGE_FEATURES:
+        raise ValueError(
+            f"{path}: trained with {f_in} edge features, this build uses "
+            f"{N_EDGE_FEATURES}; retrain via scripts/train_gnn.py")
     return model, params, header.get("graph") or {}
 
 
@@ -365,6 +377,13 @@ def load_transformer(path: str):
                              d_mlp=header["d_mlp"])
     params = serialization.msgpack_restore(blob)
     params = jax.tree_util.tree_map(np.asarray, params)
+    # Same feature-ABI gate as load_gnn: the embed matrix pins the
+    # trained edge-feature count.
+    f_in = int(params["embed"]["w"].shape[0])
+    if f_in != model.n_features:
+        raise ValueError(
+            f"{path}: trained with {f_in} edge features, this build uses "
+            f"{model.n_features}; retrain via scripts/train_transformer.py")
     return model, params, {"graph": header.get("graph") or {},
                            "seq_len": int(header.get("seq_len", 24))}
 

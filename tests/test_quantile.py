@@ -170,8 +170,11 @@ def test_tp_serves_quantiles_and_fused_refuses(mesh_runtime):
     assert (np.diff(got, axis=1) >= 0).all()  # non-crossing survives TP
     with pytest.raises(ValueError, match="point-model"):
         make_tp_loss(model, mesh)
-    with pytest.raises(ValueError, match="quantile"):
-        pack_eta_params(model, params)
+    # The Pallas pack accepts quantile models since round 4 (the fused
+    # epilogue covers the cumulative heads — parity in test_ops_fused);
+    # the packed head must carry all 2*Q output columns.
+    packed = pack_eta_params(model, params)
+    assert packed["w"][-1].shape[1] >= 2 * len(Q)
 
 
 def test_scoring_failure_degrades_not_raises(trained, tmp_path):
@@ -264,9 +267,31 @@ def test_tp_serving_of_quantile_artifact(trained, tmp_path):
         assert abs(bands_tp[k] - bands_pl[k]) < 1e-3
 
 
-def test_point_model_serving_adds_no_band_fields():
-    # The default in-repo artifact is a point model: responses must stay
-    # byte-compatible with the reference ABI (no surprise keys).
+def test_point_model_serving_adds_no_band_fields(tmp_path):
+    # A POINT artifact keeps responses byte-compatible with the
+    # reference ABI (no surprise keys). The in-repo default artifact
+    # carries quantile heads since round 4, so this pins the point
+    # regime explicitly with its own artifact.
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config, ServeConfig
+    from routest_tpu.serve.app import create_app
+    from routest_tpu.serve.ml_service import EtaService
+    from routest_tpu.train.checkpoint import save_model
+
+    path = str(tmp_path / "point.msgpack")
+    model = EtaMLP(hidden=(16, 8), policy=F32_POLICY)
+    save_model(path, model, model.init(jax.random.PRNGKey(0)))
+    client = Client(create_app(
+        Config(), eta_service=EtaService(ServeConfig(), model_path=path)))
+    r = client.post("/api/predict_eta", json={"summary": {"distance": 5000}})
+    assert r.status_code == 200
+    assert set(r.get_json()) == {"eta_minutes_ml", "eta_completion_time_ml"}
+
+
+def test_default_artifact_serves_band_fields():
+    # …and the default in-repo artifact (quantile heads) serves the
+    # additive uncertainty band on the same endpoint.
     from werkzeug.test import Client
 
     from routest_tpu.core.config import Config
@@ -275,7 +300,9 @@ def test_point_model_serving_adds_no_band_fields():
     client = Client(create_app(Config()))
     r = client.post("/api/predict_eta", json={"summary": {"distance": 5000}})
     assert r.status_code == 200
-    assert set(r.get_json()) == {"eta_minutes_ml", "eta_completion_time_ml"}
+    body = r.get_json()
+    assert body["eta_minutes_ml_p10"] <= body["eta_minutes_ml"] \
+        <= body["eta_minutes_ml_p90"]
 
 
 def test_quantile_training_under_mesh_runtime(mesh_runtime):
