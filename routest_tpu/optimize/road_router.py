@@ -197,6 +197,15 @@ class RoadRouter:
         "freeflow"."""
         return "gnn" if self._gnn is not None else "freeflow"
 
+    @property
+    def solver_info(self) -> Dict:
+        """Which shortest-path regime serves this graph, with the
+        overlay's build stats when the partition hierarchy is active —
+        ONE shape shared by the health gauge and the scale benchmark."""
+        if self._hier is not None:
+            return {"solver": "hierarchy", "overlay": dict(self._hier.stats)}
+        return {"solver": "flat_bf", "max_iters_bound": self.max_iters}
+
     def graph_dict(self) -> Dict[str, np.ndarray]:
         """The (post-bridge) routable graph — the EXACT arrays serving
         aggregates over, and therefore the arrays the GNN must train on

@@ -569,6 +569,7 @@ def create_app(config: Optional[Config] = None,
                 "edges": int(len(r.senders)),
                 "leg_cost_model": r.leg_cost_model,
                 "transformer": bool(r.has_transformer),
+                **r.solver_info,
             }
         model_res = {"status": "ok" if state.eta.available else "degraded",
                      **({"error": state.eta.load_error}

@@ -123,13 +123,9 @@ def main() -> None:
             "router_init_s": round(t_init, 2),
             "solve_cold_ms": round(1000 * t_cold, 1),
             "solve_warm_ms": round(1000 * t_warm, 1),
-            "solver": "hierarchy" if router._hier is not None else "flat_bf",
             "reachable_frac": round(reach, 4),
+            **router.solver_info,
         }
-        if router._hier is not None:
-            row["hierarchy"] = router._hier.stats
-        else:
-            row["max_iters_bound"] = router.max_iters
         if args.verify:
             row["oracle_max_rel_err"] = _verify(router, nodes, dist, np)
         rows.append(row)
