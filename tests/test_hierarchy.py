@@ -128,3 +128,20 @@ def test_subdivide_graph_shapes_and_oneway():
         back = load_osm(path)
     assert len(back["node_coords"]) == len(out["node_coords"])
     assert len(back["senders"]) == len(out["senders"])
+
+
+def test_solver_info_shapes(force_hier, monkeypatch):
+    import json
+
+    hier = RoadRouter(graph=generate_road_graph(n_nodes=900, seed=3),
+                      use_gnn=False, use_transformer=False)
+    info = hier.solver_info
+    assert info["solver"] == "hierarchy"
+    assert info["overlay"]["n_cells"] >= 2
+    assert info["overlay"]["n_overlay_edges"] > 0
+    json.dumps(info)  # health serializes this verbatim
+    monkeypatch.setenv("ROUTEST_HIER_MIN_NODES", "0")
+    flat = RoadRouter(graph=generate_road_graph(n_nodes=300, seed=3),
+                      use_gnn=False, use_transformer=False)
+    assert flat.solver_info == {"solver": "flat_bf",
+                                "max_iters_bound": flat.max_iters}
