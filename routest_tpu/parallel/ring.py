@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from routest_tpu.core.smap import shard_map
 
 _NEG = -1e30  # finite "minus infinity": keeps exp() NaN-free on all-masked tiles
+DEFAULT_CHUNK = 1024  # blockwise K/V streaming granularity (bench imports it)
 
 
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -55,6 +56,83 @@ def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     denom = jnp.maximum(p.sum(-1, keepdims=True), 1e-30)
     p = p / denom * jnp.clip(mask.sum(-1, keepdims=True), 0, 1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        key_mask: Optional[jax.Array] = None,
+                        causal: bool = False,
+                        scale: Optional[float] = None,
+                        chunk: int = DEFAULT_CHUNK) -> jax.Array:
+    """Exact attention that never materializes the (S, S) score matrix:
+    K/V stream through in ``chunk``-sized blocks under the same online
+    softmax the ring uses — a single-device flash-style loop. Peak score
+    memory is (B, H, S, chunk) instead of (B, H, S, S), so one device's
+    sequence ceiling is set by bandwidth, not by the score tensor; the
+    ring/Ulysses collectives then multiply ceiling AND compute across
+    chips. (Blockwise composes with Ulysses: each head-shard can stream
+    its full-row scores chunk-by-chunk.)
+
+    The scan body is ``jax.checkpoint``-ed: without it, backprop would
+    stash every chunk's (B, H, S, chunk) score/prob tensors as
+    residuals — O(S²) total, the very tensor this function exists to
+    avoid. Rematerialization recomputes each tile in the backward pass,
+    keeping TRAINING memory at the same O(S·chunk) bound as inference
+    (grad parity is tested against the full oracle).
+
+    Known trade under ``causal=True``: chunks wholly in a query's
+    future still pay their QK einsum before masking to zero (~2× FLOPs
+    at large S). The consumers here are non-causal route encoders, so
+    simplicity wins over a bounded scan until a causal consumer exists.
+
+    Same layouts and mask/causal semantics as :func:`full_attention`
+    (the parity oracle)."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if s_k <= chunk:
+        return full_attention(q, k, v, key_mask, causal, scale)
+    scale = scale if scale is not None else d ** -0.5
+    n_chunks = (s_k + chunk - 1) // chunk
+    pad = n_chunks * chunk - s_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # Padded keys are masked off; an absent mask gains one that covers
+    # only the padding.
+    km = (jnp.ones((b, s_k), q.dtype) if key_mask is None
+          else key_mask.astype(q.dtype))
+    if pad:
+        km = jnp.pad(km, ((0, 0), (0, pad)))
+    k_blocks = k.reshape(b, n_chunks, chunk, h, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, n_chunks, chunk, h, d).transpose(1, 0, 2, 3, 4)
+    m_blocks = km.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+    q_pos = jnp.arange(s_q)
+
+    def body(carry, blk):
+        acc, m, denom, start = carry
+        k_blk, v_blk, km_blk = blk
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        tile_mask = km_blk[:, None, None, :] > 0
+        if causal:
+            k_pos = start + jnp.arange(chunk)
+            tile_mask = tile_mask & (q_pos[:, None] >= k_pos[None, :])[None, None]
+        s = jnp.where(tile_mask, s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None]) * tile_mask
+        correction = jnp.exp(m - m_new)
+        denom = denom * correction + p.sum(-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        return (acc, m_new, denom, start + chunk), None
+
+    acc0 = jnp.zeros((b, h, s_q, d), jnp.float32)
+    m0 = jnp.full((b, h, s_q), _NEG, jnp.float32)
+    den0 = jnp.zeros((b, h, s_q), jnp.float32)
+    (acc, _, denom, _), _ = jax.lax.scan(
+        jax.checkpoint(body), (acc0, m0, den0, jnp.zeros((), jnp.int32)),
+        (k_blocks, v_blocks, m_blocks))
+    out = acc / jnp.maximum(denom, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -2,17 +2,21 @@
 
 The alternative long-context strategy to the ring (DeepSpeed-Ulysses
 pattern): instead of rotating K/V blocks, one ``lax.all_to_all`` converts
-the sequence sharding into a head sharding — every device then runs
-ordinary full attention over the whole sequence for its slice of heads,
-and a second all-to-all restores the sequence sharding. Collective count
-is constant in mesh size — four all_to_alls (q, k, v, out) plus an
-all_gather of the key mask when one is supplied — vs the ring's
-``n-1`` hops of three ppermutes each; the trade is requiring
-``n_heads % axis_size == 0`` and O(S²) score tiles per device.
+the sequence sharding into a head sharding — every device then attends
+over the whole sequence for its slice of heads (streamed blockwise, so
+the per-device score residency is O(S·chunk) per resident head, not
+O(S²)), and a second all-to-all restores the sequence sharding.
+Collective count is constant in mesh size — four all_to_alls (q, k, v,
+out) plus an all_gather of the key mask when one is supplied — vs the
+ring's ``n-1`` hops of three ppermutes each; the trade is requiring
+``n_heads % axis_size == 0`` and holding full-sequence K/V (not score)
+activations per device.
 
-Ring wins when S is huge (smaller tiles, overlappable hops); Ulysses wins
-at moderate S where collective count dominates. Both are exposed so a
-sequence model can pick per workload.
+Ring keeps even K/V residency at O(S/n) and overlaps its hops; Ulysses
+wins at moderate S where collective count dominates. Both are exposed
+so a sequence model can pick per workload
+(``artifacts/transformer_report.json`` ``seq_scaling`` carries the
+measured curve).
 """
 
 from __future__ import annotations
@@ -22,7 +26,8 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh
 
-from routest_tpu.parallel.ring import full_attention, sharded_attention
+from routest_tpu.parallel.ring import (blockwise_attention, full_attention,
+                                       sharded_attention)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -52,7 +57,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     full_mask = None
     if key_mask is not None:
         full_mask = jax.lax.all_gather(key_mask, axis_name, axis=1, tiled=True)
-    out = full_attention(q_h, k_h, v_h, full_mask, causal)
+    # Blockwise (flash-style) per head shard: long sequences would
+    # otherwise materialize the whole (S, S) score matrix per device —
+    # the ceiling the ring never had. Short sequences take the exact
+    # full_attention early-out inside.
+    out = blockwise_attention(q_h, k_h, v_h, full_mask, causal)
     return heads_to_seq(out)
 
 

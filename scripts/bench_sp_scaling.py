@@ -6,9 +6,10 @@ lengths; this benchmark proves it *necessary*. Each (mode, seq_len)
 cell runs in a child process under a hard address-space limit
 (``RLIMIT_AS``) standing in for one accelerator's memory: full
 attention materializes the (H, S, S) score tensor and dies past the
-limit, while the ring rotates K/V blocks (peak (H, S/n, S/n) per tile)
-and Ulysses all-to-alls heads (peak (H/n, S/n, S) — one full-row score
-slab per head shard) so the SAME budget reaches far longer sequences.
+limit; blockwise streams K/V chunks on one device (peak (H, S, chunk));
+the ring rotates K/V blocks (peak (H, S/n, S/n) per tile) and Ulysses
+all-to-alls heads onto blockwise streaming (peak (H/n, S, chunk)) so
+the SAME budget reaches far longer sequences.
 That is the long-context mandate in memory terms, measured, not
 asserted; the analytic bytes are recorded per cell so the curve maps
 onto any real chip (v5e: 16 GB HBM ⇒ full attention caps around
@@ -69,12 +70,16 @@ def child_main() -> None:
     freeflow = jnp.ones((1, seq), jnp.float32)
     mask = jnp.ones((1, seq), jnp.float32)
 
-    if mode == "full":
+    if mode in ("full", "blockwise"):
+        from routest_tpu.parallel.ring import blockwise_attention
+
         positions = jnp.arange(seq)
+        attn = None if mode == "full" else blockwise_attention
 
         @jax.jit
         def fwd(p, f, ff, m):
-            return model.apply(p, f, ff, positions, key_mask=m)
+            return model.apply(p, f, ff, positions, key_mask=m,
+                               attn_impl=attn)
 
         run = lambda: fwd(params, feats, freeflow, mask)  # noqa: E731
     else:
@@ -98,15 +103,18 @@ def child_main() -> None:
 
 def _analytic_bytes(mode: str, seq: int) -> int:
     """Peak score-tensor bytes per device, f32."""
+    from routest_tpu.parallel.ring import DEFAULT_CHUNK
+
     if mode == "full":
         return N_HEADS * seq * seq * 4
+    if mode == "blockwise":
+        # flash-style streaming on ONE device: (S x chunk) tiles
+        return N_HEADS * seq * min(seq, DEFAULT_CHUNK) * 4
     if mode == "ring":
         # one (S/n x S/n) tile per hop
         return N_HEADS * (seq // N_DEVICES) ** 2 * 4
-    # ulysses: each device runs FULL attention for H/n heads — the whole
-    # (S x S) score matrix per resident head. Scales n x better than
-    # full, n x worse than the ring's tiles; its win is collective count.
-    return (N_HEADS // N_DEVICES or 1) * seq * seq * 4
+    # ulysses: H/n resident heads, streamed blockwise over the full row
+    return (N_HEADS // N_DEVICES or 1) * seq * min(seq, DEFAULT_CHUNK) * 4
 
 
 def main() -> None:
@@ -121,7 +129,7 @@ def main() -> None:
     parser.add_argument("--seqs", type=int, nargs="+",
                         default=[4096, 16384, 32768, 65536])
     parser.add_argument("--modes", nargs="+",
-                        default=["full", "ring", "ulysses"])
+                        default=["full", "blockwise", "ring", "ulysses"])
     parser.add_argument("--timeout", type=float, default=900.0)
     args = parser.parse_args()
 
