@@ -68,6 +68,8 @@ _INF_NP = np.float32(3e38)
 # (measured in road_router._bellman_ford — same constant, same reason).
 _K_SWEEPS = 4
 
+_CACHE_VERSION = 1
+
 
 # ---------------------------------------------------------------------------
 # Shared flat-relaxation primitives (road_router builds on these too).
@@ -284,9 +286,18 @@ class HierarchicalIndex:
     def build(cls, coords: np.ndarray, senders: np.ndarray,
               receivers: np.ndarray, w: np.ndarray, *,
               cell_target: Optional[int] = None,
-              chunk_cells: int = 64) -> Optional["HierarchicalIndex"]:
+              chunk_cells: int = 64,
+              cache_path: Optional[str] = None,
+              fingerprint: Optional[Dict] = None) -> Optional["HierarchicalIndex"]:
         """Returns None when the graph is too small to benefit (a
-        single cell, or no cell-crossing edges)."""
+        single cell, or no cell-crossing edges). With ``cache_path``,
+        the host-side payload is written there (npz) before device
+        upload so later processes skip the whole precompute
+        (:meth:`load` — metro-extract serving spawns N workers, and
+        each would otherwise pay the batched in-cell relaxation);
+        ``fingerprint`` (the router's graph fingerprint) is embedded so
+        a loaded payload is bound to ITS graph by content, not by the
+        predictable cache filename."""
         t0 = time.perf_counter()
         n = len(coords)
         if cell_target is None:
@@ -402,18 +413,83 @@ class HierarchicalIndex:
             "clique_edges_pruned": int(candidates.sum() - keep.sum()),
             "build_s": 0.0,
         }
-        idx = cls(
-            cell=cell, n_cells=P, local_of_node=local_of_node,
+        payload = {
+            "cell": cell, "local_of_node": local_of_node,
+            "ces": ces, "cer": cer, "cew": cew, "bl": bl, "cbo": cbo,
+            "table": table, "perm_of_node": perm_of_node,
+            "ovl_s": ovl_s.astype(np.int32),
+            "ovl_r": ovl_r.astype(np.int32), "ovl_w": ovl_w,
+        }
+        stats["build_s"] = round(time.perf_counter() - t0, 3)
+        if cache_path:
+            import json
+
+            tmp = f"{cache_path}.tmp{os.getpid()}.npz"
+            try:
+                np.savez_compressed(
+                    tmp, _version=np.int64(_CACHE_VERSION),
+                    _stats=np.frombuffer(json.dumps(stats).encode(),
+                                         dtype=np.uint8),
+                    _fp=np.frombuffer(
+                        json.dumps(fingerprint or {},
+                                   sort_keys=True).encode(), dtype=np.uint8),
+                    **payload)
+                os.replace(tmp, cache_path)
+            except OSError:
+                # cache is an optimization, never a dependency — but a
+                # half-written tmp must not accumulate
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        return cls._from_payload(payload, stats)
+
+    @classmethod
+    def _from_payload(cls, p: Dict[str, np.ndarray],
+                      stats: Dict) -> "HierarchicalIndex":
+        P, b_max = p["cbo"].shape
+        c_max = p["table"].shape[2]
+        return cls(
+            cell=np.asarray(p["cell"]), n_cells=P,
+            local_of_node=np.asarray(p["local_of_node"]),
             c_max=c_max, b_max=b_max,
-            d_ces=jnp.asarray(ces), d_cer=jnp.asarray(cer),
-            d_cew=jnp.asarray(cew), d_bl=jnp.asarray(bl),
-            d_cbo=jnp.asarray(cbo), d_table=jnp.asarray(table),
-            d_perm_of_node=jnp.asarray(perm_of_node),
-            d_ovl_s=jnp.asarray(ovl_s.astype(np.int32)),
-            d_ovl_r=jnp.asarray(ovl_r.astype(np.int32)),
-            d_ovl_w=jnp.asarray(ovl_w), n_overlay=B, stats=stats)
-        idx.stats["build_s"] = round(time.perf_counter() - t0, 3)
-        return idx
+            d_ces=jnp.asarray(p["ces"]), d_cer=jnp.asarray(p["cer"]),
+            d_cew=jnp.asarray(p["cew"]), d_bl=jnp.asarray(p["bl"]),
+            d_cbo=jnp.asarray(p["cbo"]), d_table=jnp.asarray(p["table"]),
+            d_perm_of_node=jnp.asarray(p["perm_of_node"]),
+            d_ovl_s=jnp.asarray(p["ovl_s"]), d_ovl_r=jnp.asarray(p["ovl_r"]),
+            d_ovl_w=jnp.asarray(p["ovl_w"]),
+            n_overlay=int(stats["n_overlay_nodes"]), stats=stats)
+
+    @classmethod
+    def load(cls, cache_path: str,
+             fingerprint: Optional[Dict] = None) -> Optional["HierarchicalIndex"]:
+        """Rehydrate a cached overlay; None on any mismatch/corruption
+        (callers rebuild). The embedded fingerprint must match the
+        caller's graph — the filename alone is predictable, so a
+        payload at the right name but for the wrong (or tampered)
+        graph is rejected by content, and the worst a poisoned entry
+        can do is force a rebuild."""
+        try:
+            import json
+
+            with np.load(cache_path, allow_pickle=False) as z:
+                if int(z["_version"]) != _CACHE_VERSION:
+                    return None
+                if fingerprint is not None:
+                    cached_fp = json.loads(bytes(z["_fp"]).decode())
+                    if cached_fp != json.loads(
+                            json.dumps(fingerprint, sort_keys=True)):
+                        return None
+                stats = json.loads(bytes(z["_stats"]).decode())
+                payload = {k: z[k] for k in
+                           ("cell", "local_of_node", "ces", "cer", "cew",
+                            "bl", "cbo", "table", "perm_of_node",
+                            "ovl_s", "ovl_r", "ovl_w")}
+            stats["loaded_from_cache"] = True
+            return cls._from_payload(payload, stats)
+        except Exception:
+            return None
 
     # -- query ------------------------------------------------------------
 
@@ -471,6 +547,33 @@ class HierarchicalIndex:
         sources = np.asarray(sources, np.int64)
         return self._query(jnp.asarray(self.cell[sources]),
                            jnp.asarray(self.local_of_node[sources]))
+
+
+def hier_cache_path(fingerprint: Dict) -> Optional[str]:
+    """Where this graph's overlay payload caches, or None when caching
+    is off (``ROUTEST_HIER_CACHE=0``; a path value overrides the
+    per-user secure default). Keyed by the same graph fingerprint that
+    gates learned leg models, so a changed extract can never be served
+    a stale overlay — and the payload format is npz with pickling
+    disabled, so a poisoned cache can at worst fail to load (callers
+    rebuild)."""
+    knob = os.environ.get("ROUTEST_HIER_CACHE", "")
+    if knob.lower() in ("0", "off", "false", "no"):
+        return None
+    if knob:
+        base = knob
+        try:
+            os.makedirs(base, exist_ok=True)
+        except OSError:
+            return None
+    else:
+        from routest_tpu.utils.paths import secure_user_cache_dir
+
+        base = secure_user_cache_dir("routest-hier")
+        if base is None:
+            return None
+    key = "-".join(str(fingerprint[k]) for k in sorted(fingerprint))
+    return os.path.join(base, f"hier-v{_CACHE_VERSION}-{key}.npz")
 
 
 def hier_min_nodes() -> int:

@@ -43,6 +43,7 @@ from routest_tpu.data.road_graph import (
 )
 from routest_tpu.optimize.hierarchy import (
     HierarchicalIndex,
+    hier_cache_path,
     hier_min_nodes,
     relax_from,
     tight_pred,
@@ -161,8 +162,15 @@ class RoadRouter:
         self._hier: Optional[HierarchicalIndex] = None
         hmin = hier_min_nodes()
         if hmin and self.n_nodes >= hmin:
-            self._hier = HierarchicalIndex.build(
-                self.coords, self.senders, self.receivers, self.length_m)
+            cache = hier_cache_path(self._fingerprint)
+            if cache and os.path.exists(cache):
+                self._hier = HierarchicalIndex.load(
+                    cache, fingerprint=self._fingerprint)
+            if self._hier is None:
+                self._hier = HierarchicalIndex.build(
+                    self.coords, self.senders, self.receivers,
+                    self.length_m, cache_path=cache,
+                    fingerprint=self._fingerprint)
         # Learned leg costs: load the trained road-GNN when its training
         # graph fingerprint matches this router's node set.
         self._hour_times: Dict[int, np.ndarray] = {}

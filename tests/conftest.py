@@ -13,6 +13,13 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Hermetic: tests must not read (or seed) the per-user overlay cache —
+# a stale entry from an earlier run would mask precompute regressions.
+# Plain assignment (not setdefault): a developer's exported cache dir
+# must not leak into the suite. The dedicated cache test opts back in
+# through a tmp dir.
+os.environ["ROUTEST_HIER_CACHE"] = "0"
+
 import jax  # noqa: E402
 
 # The sandbox pins JAX_PLATFORMS=axon (real TPU tunnel); tests must stay

@@ -145,3 +145,39 @@ def test_solver_info_shapes(force_hier, monkeypatch):
                       use_gnn=False, use_transformer=False)
     assert flat.solver_info == {"solver": "flat_bf",
                                 "max_iters_bound": flat.max_iters}
+
+
+def test_overlay_disk_cache_roundtrip(force_hier, monkeypatch, tmp_path, rng):
+    monkeypatch.setenv("ROUTEST_HIER_CACHE", str(tmp_path))
+    graph = generate_road_graph(n_nodes=1200, seed=6)
+    built = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
+    assert built._hier is not None
+    cached_files = list(tmp_path.glob("hier-*.npz"))
+    assert len(cached_files) == 1
+    # Second router rehydrates instead of rebuilding…
+    loaded = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
+    assert loaded._hier.stats.get("loaded_from_cache") is True
+    # …and answers identically.
+    sources = rng.integers(0, built.n_nodes, 5)
+    d_built, _ = built.shortest(sources)
+    d_loaded, _ = loaded.shortest(sources)
+    np.testing.assert_allclose(d_built, d_loaded, rtol=0, atol=0)
+    # A payload parked at the right filename for the WRONG graph is
+    # rejected by the embedded fingerprint, not trusted by name.
+    import shutil
+
+    other = generate_road_graph(n_nodes=1100, seed=9)
+    RoadRouter(graph=other, use_gnn=False, use_transformer=False)
+    other_file = [f for f in tmp_path.glob("hier-*.npz")
+                  if f != cached_files[0]]
+    assert len(other_file) == 1
+    shutil.copy(cached_files[0], other_file[0])  # tamper: wrong payload
+    tampered = RoadRouter(graph=other, use_gnn=False, use_transformer=False)
+    assert not tampered._hier.stats.get("loaded_from_cache")
+    # Corruption degrades to a fresh build, never an error.
+    cached_files[0].write_bytes(b"garbage")
+    rebuilt = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
+    assert rebuilt._hier is not None
+    assert not rebuilt._hier.stats.get("loaded_from_cache")
+    d_rebuilt, _ = rebuilt.shortest(sources)
+    np.testing.assert_allclose(d_built, d_rebuilt, rtol=1e-6)
