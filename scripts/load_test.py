@@ -539,6 +539,9 @@ def main() -> None:
     parser.add_argument("--vrp-budget-ms", type=float, default=4000.0,
                         help="p95 budget for /api/optimize_route_batch "
                              "requests (32 VRPs each; 0 off)")
+    parser.add_argument("--eta-batch-budget-ms", type=float, default=1000.0,
+                        help="p95 budget for /api/predict_eta_batch "
+                             "requests (0 off)")
     parser.add_argument("--road-requests", type=int, default=6,
                         help="road-graph requests per road worker "
                              "(0 skips the phase)")
@@ -679,6 +682,7 @@ def main() -> None:
         "optimize_route": args.opt_budget_ms * scale,
         "optimize_route_road": args.road_budget_ms * scale,
         "optimize_route_batch": args.vrp_budget_ms * scale,
+        "predict_eta_batch": args.eta_batch_budget_ms * scale,
     }
     budget_failures = []
     for section, budget in budgets.items():
