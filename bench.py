@@ -364,9 +364,15 @@ def _probe(timeout_s: float) -> dict:
     return {"ok": False, "wall_s": wall, "error": diag}
 
 
-_CPU_ENV = {"BENCH_FORCE_CPU": "1", "BENCH_BATCH": str(1 << 14),
-            "BENCH_N_SHORT": "10", "BENCH_N_LONG": "40",
-            "BENCH_REPEATS": "2"}
+def _cpu_env() -> dict:
+    """CPU-fallback workload shrink — but never clobber knobs the
+    operator (or a test) set explicitly; forcing the backend is the
+    only non-negotiable part."""
+    shrink = {"BENCH_BATCH": str(1 << 14), "BENCH_N_SHORT": "10",
+              "BENCH_N_LONG": "40", "BENCH_REPEATS": "2"}
+    out = {k: v for k, v in shrink.items() if k not in os.environ}
+    out["BENCH_FORCE_CPU"] = "1"
+    return out
 # Short second-chance TPU attempt: half-length loops, two repeats.
 _TPU_RETRY_ENV = {"BENCH_N_SHORT": "50", "BENCH_N_LONG": "200",
                   "BENCH_REPEATS": "2"}
@@ -397,7 +403,7 @@ def main() -> None:
 
     if rec is None:
         # CPU fallback keeps the record non-empty whatever the tunnel does.
-        rec, diag = _run_child(dict(_CPU_ENV, ROUTEST_BENCH_CHILD="1"),
+        rec, diag = _run_child(dict(_cpu_env(), ROUTEST_BENCH_CHILD="1"),
                                CPU_ATTEMPT_TIMEOUT)
         if rec is None:
             diags.append(f"cpu: {diag}")

@@ -1,0 +1,47 @@
+"""Contract test for bench.py's watchdog ladder — the process that
+produces the driver-captured round record (BENCH_r*.json). Runs the
+real parent/probe/child subprocess chain in forced-CPU mode with a
+shrunken workload; the contract is: exactly one parseable record line,
+probe evidence always present, roofline block attached."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_one_record_with_probe_evidence_and_roofline():
+    env = dict(os.environ)
+    env.update({
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_BATCH": str(1 << 12),
+        "BENCH_N_SHORT": "4",
+        "BENCH_N_LONG": "16",
+        "BENCH_REPEATS": "1",
+        "BENCH_PROBE_TIMEOUT": "60",
+        "BENCH_CPU_TIMEOUT": "120",
+    })
+    # The parent re-execs bench.py for probe/child; keep its CPU attempt
+    # inside the suite's time budget via the env knobs above.
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         capture_output=True, text=True, env=env,
+                         cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    records = [json.loads(line) for line in proc.stdout.splitlines()
+               if line.strip().startswith("{")]
+    assert len(records) == 1, proc.stdout
+    rec = records[0]
+    assert rec["metric"] == "od_eta_preds_per_sec"
+    assert rec["value"] > 0
+    assert rec["backend"] == "cpu"
+    # Probe evidence is the VERDICT r3 #2 contract: a fallback record
+    # must carry the reason the accelerator window was not spent.
+    assert rec["probes"], rec
+    assert all("wall_s" in p for p in rec["probes"])
+    # Roofline block (VERDICT r3 #7): auditable FLOPs accounting.
+    roof = rec["roofline"]
+    assert roof["flops_per_pred"] > 0
+    assert "hbm_gbps_upper_model" in roof
+    assert "arithmetic_intensity_flops_per_byte" in roof
