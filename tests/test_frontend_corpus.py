@@ -215,7 +215,15 @@ def test_locations_shape(client):
 
 
 def test_health_checks_object(client):
-    # app/ui/page.jsx:143-145 — setHealth(json.checks)
+    # The reference dashboard's health panel reads its own Next.js
+    # proxy (app/api/health/route.js), whose ONLY backend dependency is
+    # GET {ROUTE_API_BASE}/ping (route.js:26-33, checks.backend.ok on
+    # res.ok) — pin that first.
+    r = client.get("/api/ping")
+    assert r.status_code == 200 and r.get_json()["ok"] is True
+    # Our /api/health additionally serves the Flask service's own
+    # health ABI (Flaskr/routes.py health shape), which this server's
+    # dashboard consumes as json.checks.
     r = client.get("/api/health")
     assert r.status_code == 200
     checks = r.get_json()["checks"]
