@@ -565,6 +565,14 @@ def main() -> None:
     # NB: --cpu configures the SERVER subprocess (via ROUTEST_FORCE_CPU
     # below); the load generator itself never touches jax.
 
+    # A supervisor timeout (SIGTERM) must still tear down the spawned
+    # server subprocesses — they hold live accelerator clients, and an
+    # orphaned client is exactly the churn that wedges the TPU relay.
+    # SystemExit rides the BaseException cleanup below.
+    import signal as _signal
+
+    _signal.signal(_signal.SIGTERM, lambda *_: sys.exit(143))
+
     server_procs = []
     broker = None
     if args.base_url:

@@ -1,0 +1,58 @@
+#!/bin/bash
+# TPU evidence battery: wait for the axon tunnel, then produce every
+# real-chip artifact in one long-lived session (rapid client churn
+# wedges the relay — see .claude/skills/verify/SKILL.md; that is also
+# why the probe interval below is 20 min: each probe is itself churn
+# and probing faster can PROLONG a wedge).
+#
+# Round-4 context: the tunnel was down for the entire round (backend
+# init hung ~50 min then UNAVAILABLE; 26 probes over ~7 h all timed
+# out), so the repo carries CPU fallback artifacts plus this script to
+# regenerate the TPU records the moment the environment recovers:
+#   artifacts/router_scale.json   (250k-row overlay solve, oracle-verified)
+#   artifacts/kernel_bench.json   (per-batch XLA vs Pallas -> serving auto-select)
+#   artifacts/load_test_tpu.json  (5 endpoint-class budgets + decomposition)
+#   artifacts/bench_tpu.json      (throughput + roofline record)
+#
+# Usage: scripts/run_tpu_battery.sh [max_probes] [probe_interval_s]
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:/root/.axon_site"
+MAX_PROBES="${1:-14}"
+INTERVAL="${2:-1200}"
+for i in $(seq 1 "$MAX_PROBES"); do
+  out=$(ROUTEST_BENCH_PROBE=1 timeout 45 python bench.py 2>/dev/null)
+  if echo "$out" | grep -q '"probe": "ok"' \
+     && echo "$out" | grep -q '"backend": "tpu"'; then
+    echo "tunnel alive after $i probe(s): $out"
+    break
+  fi
+  echo "probe $i/$MAX_PROBES: tunnel down ($(date -u +%H:%M))"
+  [ "$i" = "$MAX_PROBES" ] && { echo "giving up"; exit 3; }
+  sleep "$INTERVAL"
+done
+
+# One step at a time, one TPU client at a time (load_test uses a single
+# worker here for that reason; its SIGTERM handler tears its server
+# down if the timeout fires). Failures don't stop later steps, but the
+# battery reports them and exits nonzero so stale artifacts are never
+# mistaken for fresh real-chip evidence.
+failed=""
+run_step() {
+  local name="$1"; shift
+  echo "=== $name ==="
+  if ! "$@"; then
+    echo "=== $name FAILED (rc=$?) ==="
+    failed="$failed $name"
+  fi
+}
+run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
+  --osm-nodes 250000 --verify
+run_step kernel_bench timeout 2400 python scripts/bench_serving_kernel.py
+run_step load_test timeout 2400 python scripts/load_test.py --workers 1
+run_step bench timeout 600 python bench.py
+if [ -n "$failed" ]; then
+  echo "battery finished with failures:$failed"
+  exit 1
+fi
+echo "battery complete: all real-chip artifacts regenerated"
