@@ -4,29 +4,40 @@ This kernel runs the whole forward — feature expansion, normalization,
 the matmul chain, and the ``pace·dist + overhead`` epilogue — in ONE
 ``pallas_call``, so no activation ever round-trips HBM.
 
-**Measured verdict (v5e-8 single chip, 131k-row batches): XLA wins.**
-SURVEY.md §7.1's rule is "a Pallas kernel is justified only if XLA fails
-to fuse — benchmark first"; the benchmark (``bench.py``, device-side
-``fori_loop`` chaining to defeat tunnel dispatch noise) shows the XLA
-path at ~0.63 ms/batch vs ~1.0 ms for this kernel. Ablation explains it:
-XLA already overlaps the VPU epilogue (gelu) of one MXU tile with the
-next tile's matmul, while within a Mosaic program the per-tile
-expansion→matmul→gelu chain serializes VPU against MXU; the kernel's
-MXU-aligned padding (42→128 input lanes) also adds ~35% matmul FLOPs.
-The model is simply small enough that XLA's fusion is already at the
-HBM roofline.
+**Selection is measured, not asserted.** SURVEY.md §7.1's rule is "a
+Pallas kernel is justified only if XLA fails to fuse — benchmark
+first": ``scripts/bench_serving_kernel.py`` records a per-batch-size
+head-to-head on the real chip (``artifacts/kernel_bench.json``) and
+``serve/ml_service.py`` auto-serves the kernel exactly for the batch
+sizes where that record says it wins (``ROUTEST_FUSED`` unset = auto;
+``1``/``0`` force). ``bench.py`` measures both paths and reports the
+faster.
 
-The kernel therefore ships as the *benchmarked alternative*, not the
-default: ``bench.py`` measures both and reports the faster;
-``serve/ml_service.py`` uses it only under ``ROUTEST_FUSED=1``. It
-stays maintained (full parity suite) as the template for the day the
-flagship model outgrows XLA's fusion — deeper trunks shift the balance
-toward VMEM-resident chaining.
+Bandwidth accounting (physical, not logical): TPU HBM stores f32
+arrays in (8, 128) tiles with the minor dim padded to 128 lanes, so
+the (B, 12) input and (B, 1|n_q) output each stream ~512 B/row
+REGARDLESS of their logical width — narrowing the blocks does not
+change that floor (the XLA path reads the identical padded input).
+What the narrow layout does buy: the old version's two extra
+whole-batch passes are gone (an explicit zeros+set pad to 128 logical
+lanes — one write + one re-read — and a 128-lane output broadcast),
+and when the batch divides the tile the input pad-copy is skipped
+entirely, so the kernel's HBM bill is one input read + one output
+write. The kernel's structural edge over XLA remains keeping every
+inter-layer activation in VMEM (XLA spills ~3 KB/row of bf16
+activations for this trunk at large batches — the measured
+bandwidth-bound regime in bench.py's roofline); its structural
+overheads remain the 42→128 MXU row padding (~35% extra matmul FLOPs,
+irrelevant while bandwidth-bound) and Mosaic serializing the per-tile
+VPU expansion against the MXU chain, which XLA overlaps across tiles.
+The recorded kernel_bench table is the arbiter of where that nets out
+per batch size.
 
 Design notes:
 
-- the batch is tiled over the grid; per tile, every intermediate lives in
-  VMEM and only the (tile, 128) input block and output block touch HBM;
+- the batch is tiled over the grid; per tile, every intermediate lives
+  in VMEM and only the (tile, 12) input block and (tile, 1|n_q) output
+  block touch HBM (one lane-padded stream each way, no extra passes);
 - feature expansion is pure VPU arithmetic — lane-index comparisons build
   the weekday/hour one-hots in place (no gathers, no lane relayouts);
 - the train-time normalizer is an affine map feeding a linear layer, so
@@ -141,9 +152,16 @@ def _kernel(n_layers: int, compute, n_q: int, x_ref, *refs) -> None:
     increments ⇒ non-crossing quantiles), unrolled over the few heads —
     pure VPU lane arithmetic, so the uncertainty band costs no extra
     HBM pass.
+
+    The tile arrives in its natural (tile, 12) ABI width and leaves as
+    (tile, 1) / (tile, n_q); minor-dim lane padding means HBM still
+    moves ~512 B/row each way (see the module docstring's accounting),
+    but the earlier version's extra pad/broadcast passes are gone and
+    every intermediate stays in VMEM. The widen-to-128 below is a
+    VMEM-only lane relayout.
     """
     out_ref = refs[-1]
-    x = x_ref[:]  # (tile, 128) f32; ABI features in lanes 0:12, rest zero
+    x = x_ref[:]  # (tile, 12) f32: the raw ABI features
     tile = x.shape[0]
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (tile, LANES), 1)
@@ -152,10 +170,13 @@ def _kernel(n_layers: int, compute, n_q: int, x_ref, *refs) -> None:
     dist = jnp.maximum(x[:, 10:11], 0.0)
     age = x[:, 11:12]
 
-    # Expanded features via lane masks — pure VPU, no relayouts. Lanes
-    # 12:128 of x are zero, so the lane<8 select keeps only the one-hots.
+    # Widen to the kernel lane layout (VMEM-only), then build the
+    # expanded features via lane masks — pure VPU, no gathers. Lanes
+    # 12:128 of xw are zero, so the lane<8 select keeps the one-hots.
+    xw = jnp.concatenate(
+        [x, jnp.zeros((tile, LANES - x.shape[1]), x.dtype)], axis=1)
     xfull = (
-        jnp.where(lane < _CAT[1], x, 0.0)
+        jnp.where(lane < _CAT[1], xw, 0.0)
         + ((lane >= _WD[0]) & (lane < _WD[1])
            & (lane - _WD[0] == wd)).astype(jnp.float32)
         + ((lane >= _HR[0]) & (lane < _HR[1])
@@ -175,8 +196,7 @@ def _kernel(n_layers: int, compute, n_q: int, x_ref, *refs) -> None:
     if n_q == 0:
         pace = jax.nn.softplus(out[:, 0:1])
         overhead = jax.nn.softplus(out[:, 1:2])
-        eta = pace * dist + overhead
-        out_ref[:] = jnp.broadcast_to(eta, (tile, LANES))
+        out_ref[:] = pace * dist + overhead
     else:
         pace = jnp.zeros((tile, 1), jnp.float32)
         overhead = jnp.zeros((tile, 1), jnp.float32)
@@ -185,7 +205,6 @@ def _kernel(n_layers: int, compute, n_q: int, x_ref, *refs) -> None:
             pace = pace + jax.nn.softplus(out[:, qi:qi + 1])
             overhead = overhead + jax.nn.softplus(out[:, n_q + qi:n_q + qi + 1])
             etas.append(pace * dist + overhead)
-        etas.append(jnp.zeros((tile, LANES - n_q), jnp.float32))
         out_ref[:] = jnp.concatenate(etas, axis=1)
 
 
@@ -203,13 +222,20 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *, n_q: int = 0,
     b_rows = x.shape[0]
     if b_rows == 0:
         # A zero-row batch would make the tile (and grid) degenerate —
-        # _round_up(0, 0) divides by zero. Nothing to score.
-        return jnp.zeros((0,), jnp.float32)
+        # _round_up(0, 0) divides by zero. Nothing to score; match the
+        # XLA path's rank ((B,) point, (B, n_q) quantile).
+        return jnp.zeros((0, n_q) if n_q else (0,), jnp.float32)
     tile = min(tile, _round_up(b_rows, 8))
     b_pad = _round_up(b_rows, tile)
 
-    xp = jnp.zeros((b_pad, LANES), jnp.float32)
-    xp = xp.at[:b_rows, :N_FEATURES].set(x.astype(jnp.float32))
+    # Row padding only, and none at all when the batch divides the tile
+    # (serving buckets and the bench batch do): the kernel then reads
+    # the caller's buffer directly instead of paying a pad-copy pass.
+    if b_pad == b_rows:
+        xp = x.astype(jnp.float32)
+    else:
+        xp = jnp.zeros((b_pad, N_FEATURES), jnp.float32)
+        xp = xp.at[:b_rows].set(x.astype(jnp.float32))
 
     wb_specs = []
     for w, b in zip(ws, bs):
@@ -218,17 +244,20 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *, n_q: int = 0,
         wb_specs.append(pl.BlockSpec(b.shape, lambda i: (0, 0),
                                      memory_space=pltpu.VMEM))
 
+    n_out = n_q if n_q else 1
     flops = 2 * b_pad * sum(w.shape[0] * w.shape[1] for w in ws)
-    bytes_accessed = (xp.size + b_pad * LANES) * 4 + sum(
+    # Physical traffic: minor dims pad to 128 lanes in HBM's (8, 128)
+    # f32 tiling, so input and output each move b_pad*128*4 bytes.
+    bytes_accessed = 2 * b_pad * LANES * 4 + sum(
         w.size * w.dtype.itemsize for w in ws)
     out = pl.pallas_call(
         functools.partial(_kernel, n_layers, ws[0].dtype, n_q),
         grid=(b_pad // tile,),
-        in_specs=[pl.BlockSpec((tile, LANES), lambda i: (i, 0),
+        in_specs=[pl.BlockSpec((tile, N_FEATURES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)] + wb_specs,
-        out_specs=pl.BlockSpec((tile, LANES), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((tile, n_out), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b_pad, LANES), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b_pad, n_out), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
         cost_estimate=pl.CostEstimate(

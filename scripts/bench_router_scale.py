@@ -89,6 +89,11 @@ def main() -> None:
                         help="scipy Dijkstra oracle parity per row")
     parser.add_argument("--cpu", action="store_true",
                         help="hermetic CPU backend (TPU tunnel down)")
+    parser.add_argument("--out", default=None,
+                        help="artifact path (default artifacts/"
+                             "router_scale.json); point one-off runs — "
+                             "e.g. a country-scale probe — elsewhere so "
+                             "the canonical record survives")
     args = parser.parse_args()
     if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
         flags = os.environ.get("XLA_FLAGS", "")
@@ -137,6 +142,8 @@ def main() -> None:
                  if args.verify else ""), flush=True)
 
     for n in args.sizes:
+        if n <= 0:          # `--sizes 0` = osm-extract row only
+            continue
         t0 = time.perf_counter()
         graph = generate_road_graph(n_nodes=n, k=4, seed=0)
         run_case(graph, time.perf_counter() - t0, "generator")
@@ -158,9 +165,10 @@ def main() -> None:
         run_case(extract, time.perf_counter() - t0, "osm_extract")
 
     report = {"backend": jax.default_backend(), "rows": rows}
-    out = os.path.join(os.path.dirname(os.path.dirname(
+    out = args.out or os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "artifacts", "router_scale.json")
-    os.makedirs(os.path.dirname(out), exist_ok=True)
+    if os.path.dirname(out):
+        os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
 

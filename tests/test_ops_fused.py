@@ -57,11 +57,15 @@ def test_fused_odd_batch_sizes():
 
 def test_fused_empty_batch():
     # Zero rows must return zero predictions, not a degenerate grid
-    # (round-1 ADVICE: tile=0 → ZeroDivisionError).
+    # (round-1 ADVICE: tile=0 → ZeroDivisionError) — at the XLA path's
+    # rank for both model families.
     model, params, feats = _model_and_params()
     packed = pack_eta_params(model, params)
     got = np.asarray(fused_eta_forward(packed, feats[:0], interpret=True))
     assert got.shape == (0,) and got.dtype == np.float32
+    got_q = np.asarray(fused_eta_forward(packed, feats[:0], n_q=3,
+                                         interpret=True))
+    assert got_q.shape == (0, 3) and got_q.dtype == np.float32
 
 
 def test_fused_unknown_categories_and_negative_distance():
