@@ -38,23 +38,28 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _time_solves(router, nodes):
+    """One timing protocol for every regime: cold (pays compile) then
+    min-of-3 warm. ``shortest`` host-syncs internally (device_get)."""
+    t0 = time.perf_counter()
+    dist, _ = router.shortest(nodes)
+    t_cold = time.perf_counter() - t0
+    solves = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dist, _ = router.shortest(nodes)
+        solves.append(time.perf_counter() - t0)
+    return dist, t_cold, min(solves)
+
+
 def _bench_router(router, args, np, rng):
     pts = np.stack([
         rng.uniform(14.40, 14.68, args.waypoints),
         rng.uniform(120.96, 121.10, args.waypoints),
     ], axis=1).astype(np.float32)
     nodes = router.snap(pts)
-
-    t0 = time.perf_counter()
-    dist, _ = router.shortest(nodes)            # cold: pays compile
-    t_cold = time.perf_counter() - t0
-
-    solves = []
-    for _ in range(3):                           # warm: steady state
-        t0 = time.perf_counter()
-        dist, _ = router.shortest(nodes)
-        solves.append(time.perf_counter() - t0)
-    return nodes, dist, t_cold, min(solves)
+    dist, t_cold, t_warm = _time_solves(router, nodes)
+    return nodes, dist, t_cold, t_warm
 
 
 def _verify(router, nodes, dist, np):
@@ -94,6 +99,18 @@ def main() -> None:
                              "router_scale.json); point one-off runs — "
                              "e.g. a country-scale probe — elsewhere so "
                              "the canonical record survives")
+    parser.add_argument("--flat-compare", action="store_true",
+                        help="for overlay rows, also time the flat "
+                             "Bellman-Ford regime on the SAME graph, "
+                             "waypoints and backend, recording "
+                             "flat_warm_ms + overlay_speedup — the "
+                             "apples-to-apples claim a cross-backend "
+                             "comparison can't make")
+    parser.add_argument("--flat-compare-max", type=int, default=50_000,
+                        help="skip the flat comparison above this node "
+                             "count (the diameter-bound sweep takes "
+                             "minutes per solve there — the wall being "
+                             "demonstrated)")
     args = parser.parse_args()
     if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
         flags = os.environ.get("XLA_FLAGS", "")
@@ -133,6 +150,24 @@ def main() -> None:
         }
         if args.verify:
             row["oracle_max_rel_err"] = _verify(router, nodes, dist, np)
+        if (args.flat_compare and row.get("solver") == "hierarchy"
+                and router.n_nodes <= args.flat_compare_max):
+            old = os.environ.get("ROUTEST_HIER_MIN_NODES")
+            os.environ["ROUTEST_HIER_MIN_NODES"] = "0"
+            try:
+                flat = RoadRouter(graph=graph, use_gnn=False,
+                                  use_transformer=False)
+            finally:
+                if old is None:
+                    os.environ.pop("ROUTEST_HIER_MIN_NODES", None)
+                else:
+                    os.environ["ROUTEST_HIER_MIN_NODES"] = old
+            _, _, flat_warm = _time_solves(flat, nodes)  # same waypoints
+            row["flat_warm_ms"] = round(1000 * flat_warm, 1)
+            row["overlay_speedup"] = round(flat_warm / max(t_warm, 1e-9), 1)
+            print(f"      flat_bf same graph/backend: warm "
+                  f"{row['flat_warm_ms']}ms → overlay speedup "
+                  f"{row['overlay_speedup']}x", flush=True)
         rows.append(row)
         print(f"  {row['nodes']:>7,} nodes {row['edges']:>9,} edges "
               f"[{topology}/{row['solver']}] | build {row['graph_build_s']}s "

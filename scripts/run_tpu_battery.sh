@@ -41,16 +41,24 @@ failed=""
 run_step() {
   local name="$1"; shift
   echo "=== $name ==="
-  if ! "$@"; then
-    echo "=== $name FAILED (rc=$?) ==="
+  local rc=0
+  "$@" || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "=== $name FAILED (rc=$rc) ==="
     failed="$failed $name"
   fi
 }
 run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
-  --osm-nodes 250000 --verify
+  --osm-nodes 250000 --verify --flat-compare
 run_step kernel_bench timeout 2400 python scripts/bench_serving_kernel.py
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
 run_step bench timeout 600 python bench.py
+# Country-scale probe (PARITY's 1M-node record, as a regenerable
+# artifact): osm-topology row only, oracle-verified, own file so the
+# canonical router_scale.json keeps its standard sizes.
+run_step router_scale_xl timeout 3600 python scripts/bench_router_scale.py \
+  --sizes 0 --osm-nodes 1000000 --verify \
+  --out artifacts/router_scale_xl.json
 if [ -n "$failed" ]; then
   echo "battery finished with failures:$failed"
   exit 1
