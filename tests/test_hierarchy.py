@@ -147,6 +147,65 @@ def test_solver_info_shapes(force_hier, monkeypatch):
                                 "max_iters_bound": flat.max_iters}
 
 
+def test_overlay_serves_metro_extract_over_http(monkeypatch, tmp_path):
+    """Full stack at metro scale: the in-repo 8,192-node OSM extract
+    (above the default ROUTEST_HIER_MIN_NODES=4096) routes a road-graph
+    request through HTTP with the partition overlay as the solver, and
+    health reports the regime (`checks.engine.road_router.solver`).
+    This is the serving configuration a real deployment gets by pointing
+    ROAD_GRAPH_OSM at a city extract."""
+    import os
+
+    import jax
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config, ServeConfig
+    from routest_tpu.core.dtypes import F32_POLICY
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.optimize import road_router as rr
+    from routest_tpu.serve.app import create_app
+    from routest_tpu.serve.ml_service import EtaService
+    from routest_tpu.train.checkpoint import save_model
+
+    extract = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "metro_8192.osm.gz")
+    # monkeypatch teardown restores the pre-test singleton, so the
+    # metro-sized router never leaks into other tests
+    monkeypatch.setattr(rr, "_default_router", None)
+    monkeypatch.setenv("ROAD_GRAPH_OSM", extract)
+    # leave ROUTEST_HIER_MIN_NODES at its default: 8192 > 4096 must
+    # engage the overlay without test-only knobs
+
+    mpath = str(tmp_path / "eta.msgpack")
+    model = EtaMLP(hidden=(16, 16), policy=F32_POLICY)
+    save_model(mpath, model, model.init(jax.random.PRNGKey(0)))
+    eta = EtaService(ServeConfig(), model_path=mpath)
+    client = Client(create_app(Config(), eta_service=eta))
+    res = client.post("/api/optimize_route", json={
+        "source_point": {"lat": 14.5836, "lon": 121.0409},
+        "destination_points": [
+            {"lat": 14.5355, "lon": 121.0621, "payload": 1},
+            {"lat": 14.5866, "lon": 121.0566, "payload": 1},
+        ],
+        "driver_details": {"driver_name": "t", "vehicle_type": "car",
+                           "vehicle_capacity": 9999,
+                           "maximum_distance": 1_000_000},
+        "road_graph": True,
+        "use_ml_eta": True,
+    })
+    assert res.status_code == 200, res.get_data(as_text=True)
+    feat = res.get_json()
+    assert feat["type"] == "Feature"
+    p = feat["properties"]
+    assert p["summary"]["distance"] > 0
+    assert len(feat["geometry"]["coordinates"]) > 4  # street-following
+    assert rr.default_router()._hier is not None
+    health = client.get("/api/health").get_json()
+    road = health["checks"]["engine"]["road_router"]
+    assert road["solver"] == "hierarchy"
+    assert road["overlay"]["n_cells"] >= 2
+    assert road["nodes"] == rr.default_router().n_nodes
+
 def test_overlay_disk_cache_roundtrip(force_hier, monkeypatch, tmp_path, rng):
     monkeypatch.setenv("ROUTEST_HIER_CACHE", str(tmp_path))
     graph = generate_road_graph(n_nodes=1200, seed=6)
