@@ -206,6 +206,7 @@ def test_overlay_serves_metro_extract_over_http(monkeypatch, tmp_path):
     assert road["overlay"]["n_cells"] >= 2
     assert road["nodes"] == rr.default_router().n_nodes
 
+
 def test_overlay_disk_cache_roundtrip(force_hier, monkeypatch, tmp_path, rng):
     monkeypatch.setenv("ROUTEST_HIER_CACHE", str(tmp_path))
     graph = generate_road_graph(n_nodes=1200, seed=6)
