@@ -278,7 +278,12 @@ class HierarchicalIndex:
         self._d_ovl_s, self._d_ovl_r, self._d_ovl_w = d_ovl_s, d_ovl_r, d_ovl_w
         self.n_overlay = n_overlay
         self.stats = stats
-        self._query = self._build_query()
+        # ``query_fn`` is the raw traceable function: callers chain
+        # further device work (the router's polish + predecessor
+        # recovery) by inlining it inside ONE outer jit, so a warm
+        # solve is a single dispatch+fetch — on the axon tunnel every
+        # extra dispatch is a host round trip.
+        self.query_fn = self._build_query()
 
     # -- construction -----------------------------------------------------
 
@@ -502,7 +507,6 @@ class HierarchicalIndex:
         cell_iters = c_max + _K_SWEEPS
         ovl_iters = B + _K_SWEEPS
 
-        @jax.jit
         def query(p_s: jax.Array, src_local: jax.Array) -> jax.Array:
             S = p_s.shape[0]
             rows = jnp.arange(S)
@@ -540,13 +544,13 @@ class HierarchicalIndex:
 
         return query
 
-    def shortest_device(self, sources: np.ndarray) -> jax.Array:
-        """(S,) global source nodes → (S, N) exact distances, on
-        device (callers chain polish/predecessor kernels without a
-        host round trip)."""
+    def prep_sources(self, sources: np.ndarray) -> Tuple[jax.Array, jax.Array]:
+        """(S,) global source nodes → the ``query_fn`` argument pair
+        (source cell ids, source cell-local ids). The ONE place the
+        source encoding lives — every query goes through it."""
         sources = np.asarray(sources, np.int64)
-        return self._query(jnp.asarray(self.cell[sources]),
-                           jnp.asarray(self.local_of_node[sources]))
+        return (jnp.asarray(self.cell[sources]),
+                jnp.asarray(self.local_of_node[sources]))
 
 
 def hier_cache_path(fingerprint: Dict) -> Optional[str]:

@@ -171,6 +171,27 @@ class RoadRouter:
                     self.coords, self.senders, self.receivers,
                     self.length_m, cache_path=cache,
                     fingerprint=self._fingerprint)
+        if self._hier is not None:
+            # Overlay query + polish sweeps + predecessor recovery
+            # fused into ONE jitted program: a warm solve is a single
+            # dispatch + fetch instead of three dispatches. Through the
+            # axon tunnel each dispatch costs a host round trip (~70 ms
+            # measured), which dominated metro-scale warm latency; it
+            # also collapses three per-bucket compiles into one.
+            hier = self._hier
+
+            @jax.jit
+            def _overlay_solve(p_s, src_local, padded_d):
+                dist = hier.query_fn(p_s, src_local)
+                dist, _ = relax_from(
+                    self._bf_senders, self._bf_receivers, self._bf_length,
+                    dist, n_nodes=self.n_nodes, max_iters=_POLISH_SWEEPS)
+                pred = tight_pred(
+                    self._bf_senders, self._bf_receivers, self._bf_length,
+                    dist, padded_d, n_nodes=self.n_nodes)
+                return dist, pred
+
+            self._overlay_solve = _overlay_solve
         # Learned leg costs: load the trained road-GNN when its training
         # graph fingerprint matches this router's node set.
         self._hour_times: Dict[int, np.ndarray] = {}
@@ -464,14 +485,9 @@ class RoadRouter:
             # the flat relaxation's own tie structure. Convergence is
             # guaranteed by construction (the overlay loop's bound is
             # its exact node count), so no exhaustion re-run exists.
-            dist_d = self._hier.shortest_device(padded)
-            dist_d, _ = relax_from(
-                self._bf_senders, self._bf_receivers, self._bf_length,
-                dist_d, n_nodes=self.n_nodes, max_iters=_POLISH_SWEEPS)
-            pred_d = tight_pred(
-                self._bf_senders, self._bf_receivers, self._bf_length,
-                dist_d, jnp.asarray(padded), n_nodes=self.n_nodes)
-            dist, pred = jax.device_get((dist_d, pred_d))
+            p_s, src_local = self._hier.prep_sources(padded)
+            dist, pred = jax.device_get(self._overlay_solve(
+                p_s, src_local, jnp.asarray(padded)))
             pred = pred[:n_src]
             pred = np.where(pred >= 0, self._bf_perm[np.maximum(pred, 0)], -1)
             return dist[:n_src], pred
