@@ -18,7 +18,7 @@ import datetime as dt
 import os
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -391,23 +391,33 @@ class EtaService:
         return score
 
     @staticmethod
-    def _fused_win_bucket() -> int:
-        """Largest batch size where the measured kernel bench says the
-        Pallas path wins, from ``artifacts/kernel_bench.json``
-        (``scripts/bench_serving_kernel.py`` — per-bucket slope-timed
-        head-to-head on the real chip). 0 = no recorded win."""
-        path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "artifacts", "kernel_bench.json")
+    def _fused_win_bucket() -> Tuple[int, Dict[int, int]]:
+        """(win_bucket, tile_by_batch) from the measured kernel bench
+        (``artifacts/kernel_bench.json``, written by
+        ``scripts/bench_serving_kernel.py`` — per-bucket slope-timed
+        head-to-head on the real chip). ``win_bucket`` is the largest
+        batch size where the Pallas path wins (0 = no recorded win);
+        ``tile_by_batch`` maps each measured batch size to the kernel
+        tile that won its sweep, so serving replays the measured
+        configuration instead of a hardcoded tile.
+        ``ROUTEST_KERNEL_BENCH`` relocates the record (deployments that
+        move artifacts out of the repo tree)."""
+        path = os.environ.get("ROUTEST_KERNEL_BENCH") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "artifacts", "kernel_bench.json")
         try:
             import json
 
             with open(path) as f:
                 rec = json.load(f)
             if not isinstance(rec, dict) or rec.get("backend") != "tpu":
-                return 0
-            return int(rec.get("pallas_wins_max_bucket") or 0)
+                return 0, {}
+            tiles = {int(r["batch"]): int(r["pallas_tile"])
+                     for r in rec.get("rows", ())
+                     if isinstance(r, dict) and r.get("pallas_tile")}
+            return int(rec.get("pallas_wins_max_bucket") or 0), tiles
         except Exception:  # any malformed record means "no recorded win"
-            return 0
+            return 0, {}
 
     def _maybe_fused_score(self, fallback):
         """Measured-selection swap to the fused Pallas kernel
@@ -427,7 +437,8 @@ class EtaService:
         mode = os.environ.get("ROUTEST_FUSED", "auto")
         if mode == "0":
             return fallback
-        win_bucket = None if mode == "1" else self._fused_win_bucket()
+        recorded_bucket, tile_by_batch = self._fused_win_bucket()
+        win_bucket = None if mode == "1" else recorded_bucket
         if win_bucket == 0:
             return fallback
         if jax.default_backend() != "tpu":
@@ -446,10 +457,18 @@ class EtaService:
 
             packed = jax.device_put(pack_eta_params(self._model, self._params))
             n_q = len(self.quantiles)
+            # Replay the measured tile: smallest benched batch that
+            # covers this request's rows (bench batches are the serving
+            # buckets, so warm paths hit exact matches); default to the
+            # kernel's built-in tile when nothing matches.
+            tile_sizes = sorted(tile_by_batch)
 
             def fused(x: np.ndarray) -> np.ndarray:
+                tile = next((tile_by_batch[b] for b in tile_sizes
+                             if len(x) <= b), None)
+                kw = {} if tile is None else {"tile": tile}
                 return fused_eta_forward(packed, jax.numpy.asarray(x),
-                                         n_q=n_q)
+                                         n_q=n_q, **kw)
 
             if win_bucket is None:
                 score = fused                       # forced: all batches

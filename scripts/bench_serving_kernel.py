@@ -33,6 +33,10 @@ def main() -> None:
     parser.add_argument("--batches", type=int, nargs="+",
                         default=[8, 64, 512, 4096, 32768, 131072])
     parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--tiles", type=int, nargs="+",
+                        default=[512, 2048, 8192],
+                        help="kernel batch-tile candidates (clamped to the "
+                             "row-padded batch, deduped, per batch size)")
     parser.add_argument("--cpu", action="store_true",
                         help="interpreter-mode CPU run (correctness/dev "
                              "only; the artifact will not enable serving)")
@@ -109,34 +113,52 @@ def main() -> None:
     rows = []
     for batch in args.batches:
         xla_s = measure(lambda xx: forward_xla(params, xx), batch)
-        try:
-            pal_s = measure(
-                lambda xx: fused_eta_forward(packed, xx, n_q=n_q,
-                                             interpret=interpret), batch)
-        except Exception as e:  # Mosaic failure: record, don't crash
+        # Tile sweep: the grid-step count (batch/tile) sets the kernel's
+        # fixed overhead while VMEM bounds the tile from above — the
+        # best point moves with batch size, so it is measured, not
+        # asserted, and serving replays the recorded winner. Candidates
+        # collapse to what the kernel would actually run (it clamps the
+        # tile to the row-padded batch), so every recorded pallas_tile
+        # is a configuration that really executed.
+        cap = ((batch + 7) // 8) * 8
+        tiles = sorted({min(t, cap) for t in args.tiles})
+        pal_s, pal_tile, err = None, None, None
+        for t in tiles:
+            try:
+                s = measure(
+                    lambda xx: fused_eta_forward(packed, xx, n_q=n_q,
+                                                 tile=t,
+                                                 interpret=interpret), batch)
+            except Exception as e:  # Mosaic failure: record, don't crash
+                err = f"{type(e).__name__}: {e}"[:200]
+                continue
+            if pal_s is None or s < pal_s:
+                pal_s, pal_tile = s, t
+        if pal_s is None:
             rows.append({"batch": batch, "xla_us": round(xla_s * 1e6, 1),
-                         "pallas_us": None,
-                         "error": f"{type(e).__name__}: {e}"[:200]})
+                         "pallas_us": None, "error": err})
             continue
         rows.append({
             "batch": batch,
             "xla_us": round(xla_s * 1e6, 1),
             "pallas_us": round(pal_s * 1e6, 1),
+            "pallas_tile": pal_tile,
             "winner": "pallas" if pal_s < xla_s else "xla",
             "speedup": round(xla_s / pal_s, 2),
         })
         print(f"  batch {batch:>7,}: xla {rows[-1]['xla_us']:>9} us | "
-              f"pallas {rows[-1]['pallas_us']:>9} us | "
+              f"pallas {rows[-1]['pallas_us']:>9} us (tile {pal_tile}) | "
               f"{rows[-1]['winner']} ({rows[-1]['speedup']}x)", flush=True)
 
     # The largest batch the kernel wins at, provided it wins every size
     # below it too (serving dispatches by "batch <= threshold": a
     # non-contiguous win region must not enable the kernel for sizes
-    # where it loses).
+    # where it loses). A row where every tile FAILED breaks the chain
+    # the same as a loss — serving must never route a shape through a
+    # kernel that could not compile at that shape.
     win_max = 0
-    for row in sorted([r for r in rows if r.get("winner")],
-                      key=lambda r: r["batch"]):
-        if row["winner"] == "pallas":
+    for row in sorted(rows, key=lambda r: r["batch"]):
+        if row.get("winner") == "pallas":
             win_max = row["batch"]
         else:
             break
