@@ -250,34 +250,42 @@ def optimize_route(input_data: dict) -> dict:
         leg_cost, leg_geom = _gc_legs(all_points, dist, speed)
 
     if len(destinations) == 1:
-        # Same pricer precedence as multi-stop: the transformer (when an
-        # artifact serves this graph) re-prices the out-and-back pair so
-        # point-to-point and multi-stop responses never disagree on
-        # leg_cost_model for the same deployment.
-        p2p_model = None
-        if use_road:
-            rep = legs.reprice_trips([[0]])
-            if rep:
-                base_cost = leg_cost
-
-                def leg_cost(a: int, b: int, _base=base_cost, _r=rep):
-                    meters, seconds = _base(a, b)
-                    return meters, _r.get((a, b), seconds)
-
-                p2p_model = "transformer"
-        feature = _point_to_point(source, destinations[0], all_points,
-                                  leg_cost, leg_geom, driver_details,
-                                  vehicle_type, cap, max_dist, use_road)
-        if use_road and "error" not in feature:
-            feature["properties"]["leg_cost_model"] = (
-                p2p_model or legs.cost_model)
-        return feature
+        return _finish_point_to_point(p, leg_cost, leg_geom, legs)
 
     # Additive ABI: {"refine": true} runs 2-opt on the greedy order —
     # strictly shorter or equal routes, same response shape. Default off
     # to keep exact reference-greedy semantics.
     sol = solve_host(dist, p["demands"], cap, max_dist, refine=p["refine"])
     return _assemble_multi(p, sol, dist, leg_cost, leg_geom, legs)
+
+
+def _finish_point_to_point(p: dict, leg_cost, leg_geom, legs) -> dict:
+    """Single-destination finishing shared by the single path and the
+    batch path. Same pricer precedence as multi-stop: the transformer
+    (when an artifact serves this graph) re-prices the out-and-back
+    pair so point-to-point and multi-stop responses never disagree on
+    ``leg_cost_model`` for the same deployment. ``legs`` is the
+    problem's :class:`RoadLegs` (road-graph items) or None."""
+    use_road = legs is not None
+    p2p_model = None
+    if use_road:
+        rep = legs.reprice_trips([[0]])
+        if rep:
+            base_cost = leg_cost
+
+            def leg_cost(a: int, b: int, _base=base_cost, _r=rep):
+                meters, seconds = _base(a, b)
+                return meters, _r.get((a, b), seconds)
+
+            p2p_model = "transformer"
+    feature = _point_to_point(p["source"], p["destinations"][0],
+                              p["all_points"], leg_cost, leg_geom,
+                              p["driver_details"], p["vehicle_type"],
+                              p["cap"], p["max_dist"], use_road)
+    if use_road and "error" not in feature:
+        feature["properties"]["leg_cost_model"] = (
+            p2p_model or legs.cost_model)
+    return feature
 
 
 def _assemble_multi(p: dict, sol: dict, dist, leg_cost, leg_geom,
@@ -450,8 +458,12 @@ def optimize_route_batch(items) -> list:
     (shared ``_assemble_multi``).
 
     Per-item errors are returned in place — one malformed problem never
-    poisons the batch. ``road_graph`` and ``top_k`` items are rejected
-    here (their device work is per-item by nature; the single endpoint
+    poisons the batch. ``road_graph`` items batch too: every road
+    problem's waypoints concatenate into shared shortest-path solves
+    (``RoadRouter.route_legs_batch`` — the solver's source axis is
+    batched by design, so B problems cost a few wide solves instead of
+    B narrow ones). ``top_k > 1`` items are rejected here (candidate
+    ranking is a per-problem device program; the single endpoint
     serves them). Point-to-point items are priced host-side directly.
     """
     if not isinstance(items, list) or not items:
@@ -463,7 +475,7 @@ def optimize_route_batch(items) -> list:
         return [{"error": f"batch too large (max {MAX_BATCH_PROBLEMS} "
                           f"problems)"} for _ in items]
     results: list = [None] * len(items)
-    solve: list = []  # (index, parsed, dist, leg_cost, leg_geom)
+    solve: list = []  # (index, parsed, dist, leg_cost, leg_geom, legs)
 
     for i, item in enumerate(items):
         p = _parse_problem(item if isinstance(item, dict) else {})
@@ -473,28 +485,55 @@ def optimize_route_batch(items) -> list:
         # top_k == 1 is a no-op on the single path (alternatives only
         # trigger above 1) — reject only what genuinely needs a
         # per-problem device program.
-        if p["use_road"] or p["top_k"] > 1:
-            results[i] = {"error": "road_graph/top_k are per-problem "
-                                   "features; use /api/optimize_route"}
+        if p["top_k"] > 1:
+            results[i] = {"error": "top_k is a per-problem feature; "
+                                   "use /api/optimize_route"}
             continue
-        solve.append([i, p, None, None, None])
+        solve.append([i, p, None, None, None, None])
 
-    # ONE batched haversine builds every problem's distance matrix
-    # (points padded with origin copies; the pad region is never read —
-    # solve_host_batch re-masks it and assembly slices the real block).
-    if solve:
-        max_pts = max(len(s[1]["all_points"]) for s in solve)
+    # Road-graph problems: ONE grouped shortest-path solve set builds
+    # every problem's true street-network matrix (identical numerics to
+    # the single path — source rows are independent). A router failure
+    # errors the road items in place, never the whole batch.
+    road = [s for s in solve if s[1]["use_road"]]
+    if road:
+        from routest_tpu.optimize.road_router import default_router
+
+        car_speed = geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("car")]
+        try:
+            legs_list = default_router().route_legs_batch([
+                (s[1]["latlon"], car_speed / s[1]["speed"],
+                 _pickup_hour(s[1]["pickup_time"])) for s in road])
+        except Exception as e:  # mirror the per-item error contract
+            for s in road:
+                results[s[0]] = {"error": f"road graph unavailable: "
+                                          f"{type(e).__name__}: {e}"}
+            solve = [s for s in solve if not s[1]["use_road"]]
+        else:
+            for s, legs in zip(road, legs_list):
+                s[2] = legs.dist_m
+                s[3] = (lambda _l: lambda a, b: _l.leg(a, b)[:2])(legs)
+                s[4] = (lambda _l: lambda a, b: _l.leg(a, b)[2])(legs)
+                s[5] = legs
+
+    # ONE batched haversine builds every remaining problem's distance
+    # matrix (points padded with origin copies; the pad region is never
+    # read — solve_host_batch re-masks it and assembly slices the real
+    # block).
+    gc = [s for s in solve if not s[1]["use_road"]]
+    if gc:
+        max_pts = max(len(s[1]["all_points"]) for s in gc)
         pts_pad = 1 << max(0, (max_pts - 1)).bit_length()
-        latlon_b = np.zeros((len(solve), pts_pad, 2), np.float32)
-        factor_b = np.zeros((len(solve),), np.float32)
-        for j, s in enumerate(solve):
+        latlon_b = np.zeros((len(gc), pts_pad, 2), np.float32)
+        factor_b = np.zeros((len(gc),), np.float32)
+        for j, s in enumerate(gc):
             ll = s[1]["latlon"]
             latlon_b[j] = ll[0]  # origin copies fill the pad
             latlon_b[j, : len(ll)] = ll
             factor_b[j] = s[1]["road_factor"]
         mats = np.asarray(_distance_matrix_batch(
             jnp.asarray(latlon_b), jnp.asarray(factor_b)))
-        for j, s in enumerate(solve):
+        for j, s in enumerate(gc):
             n_pts = len(s[1]["all_points"])
             s[2] = mats[j, :n_pts, :n_pts]
             s[3], s[4] = _gc_legs(s[1]["all_points"], s[2], s[1]["speed"])
@@ -502,12 +541,9 @@ def optimize_route_batch(items) -> list:
     # Point-to-point items price host-side directly (one leg each).
     still: list = []
     for s in solve:
-        i, p, dist, leg_cost, leg_geom = s
+        i, p, dist, leg_cost, leg_geom, legs = s
         if len(p["destinations"]) == 1:
-            results[i] = _point_to_point(
-                p["source"], p["destinations"][0], p["all_points"],
-                leg_cost, leg_geom, p["driver_details"], p["vehicle_type"],
-                p["cap"], p["max_dist"], False)
+            results[i] = _finish_point_to_point(p, leg_cost, leg_geom, legs)
         else:
             still.append(s)
     solve = still
@@ -525,9 +561,9 @@ def optimize_route_batch(items) -> list:
             [g[1]["max_dist"] for g in group],
             refine=flavor,
         )
-        for (i, p, dist, leg_cost, leg_geom), sol in zip(group, sols):
+        for (i, p, dist, leg_cost, leg_geom, legs), sol in zip(group, sols):
             results[i] = _assemble_multi(p, sol, dist, leg_cost, leg_geom,
-                                         None)
+                                         legs)
     return results
 
 

@@ -551,22 +551,71 @@ class RoadRouter:
         learned congestion regime when the GNN is active; None prices at
         noon off-peak.
         """
-        self._maybe_reload_models()  # retrained leg models go live here
-        points_latlon = np.asarray(points_latlon, np.float32)
-        nodes = self.snap(points_latlon)
-        dist, pred = self.shortest(nodes)
+        return self.route_legs_batch([(points_latlon, time_scale, hour)])[0]
+
+    def route_legs_batch(self, problems) -> List["RoadLegs"]:
+        """Many waypoint sets → one :class:`RoadLegs` each, sharing as
+        FEW device solves as memory allows.
+
+        ``problems``: list of ``(points_latlon, time_scale, hour)``
+        triples (``route_legs``'s arguments — the single path IS the
+        one-problem batch, so the two can never diverge). The
+        shortest-path solver is batched over sources by design, so
+        problems concatenate along the source axis and split back as
+        row slices — each source row's distances are computed
+        independently, so results are bitwise identical to
+        per-problem solves. Groups are sized so one fetch (dist f32 +
+        pred i32 rows over every node) stays under ~64 MB:
+        serving-default graphs take a single call, metro graphs chunk
+        instead of materializing a (ΣM, N) table.
+        """
+        self._maybe_reload_models()  # once for the whole batch
+        pts_list = [np.asarray(p, np.float32) for p, _, _ in problems]
+        counts = [len(p) for p in pts_list]
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        all_pts = np.concatenate(pts_list, axis=0)
+        all_nodes = self.snap(all_pts)
         # First/last mile: the request point is rarely ON the network;
         # charge the point↔snapped-node gap into every leg (at collector
         # free-flow for the duration) so far-off-network points see
         # physically sensible totals instead of intra-graph-only paths.
-        snap_m = haversine_np(
-            points_latlon[:, 0], points_latlon[:, 1],
-            self.coords[nodes, 0], self.coords[nodes, 1]).astype(np.float32)
-        eff_hour = 12 if hour is None else int(hour) % 24
-        time_s = self.edge_time_s(eff_hour)
-        return RoadLegs(self, points_latlon, nodes, dist, pred, snap_m,
-                        time_scale, time_s, self.leg_cost_model,
-                        hour=eff_hour)
+        all_snap = haversine_np(
+            all_pts[:, 0], all_pts[:, 1],
+            self.coords[all_nodes, 0],
+            self.coords[all_nodes, 1]).astype(np.float32)
+
+        budget = max(16, min(512, (64 << 20) // (8 * max(self.n_nodes, 1))))
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        rows = 0
+        for idx, m in enumerate(counts):
+            if cur and rows + m > budget:
+                groups.append(cur)
+                cur, rows = [], 0
+            cur.append(idx)
+            rows += m
+        if cur:
+            groups.append(cur)
+
+        out: List[Optional[RoadLegs]] = [None] * len(problems)
+        for g in groups:
+            sel = np.concatenate([np.arange(offsets[i], offsets[i + 1])
+                                  for i in g])
+            dist, pred = self.shortest(all_nodes[sel])
+            pos = 0
+            for i in g:
+                m = counts[i]
+                _, time_scale, hour = problems[i]
+                eff_hour = 12 if hour is None else int(hour) % 24
+                out[i] = RoadLegs(
+                    self, pts_list[i],
+                    all_nodes[offsets[i]:offsets[i + 1]],
+                    dist[pos:pos + m], pred[pos:pos + m],
+                    all_snap[offsets[i]:offsets[i + 1]],
+                    time_scale, self.edge_time_s(eff_hour),
+                    self.leg_cost_model, hour=eff_hour)
+                pos += m
+        return out
 
 
 _SNAP_SPEED_MPS = 8.3  # first/last-mile charged at collector free-flow

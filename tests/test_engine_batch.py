@@ -79,16 +79,36 @@ def test_engine_batch_point_to_point_and_errors_in_place():
     items = [
         _body(1),                                  # point-to-point
         {"destination_points": [{"lat": 1, "lon": 2}]},  # missing source
-        _body(3, road_graph=True),                 # rejected in batch
         _body(2, cap="NaN-ish"),                   # malformed details
         _body(3),                                  # valid after errors
     ]
     out = optimize_route_batch(items)
     assert out[0] == optimize_route(items[0])
     assert out[1]["error"] == "no source point specified."
-    assert "per-problem" in out[2]["error"]
-    assert "vehicle_capacity" in out[3]["error"]
-    assert out[4] == optimize_route(items[4])
+    assert "vehicle_capacity" in out[2]["error"]
+    assert out[3] == optimize_route(items[3])
+
+
+def test_engine_batch_road_graph_matches_single():
+    # Road-graph problems batch through shared shortest-path solves
+    # (RoadRouter.route_legs_batch): per-item results must be identical
+    # to the single path — including street-following geometry, leg
+    # pricing, refine, point-to-point, and mixing with non-road items.
+    # pickup_time pinned: leg pricing is hour-dependent when a learned
+    # pricer serves the graph, and the parity assertion must not flake
+    # across a wall-clock hour boundary between the two runs.
+    pt = "2026-03-02T08:30:00"
+    items = [
+        _body(3, road_graph=True, pickup_time=pt),
+        _body(1, road_graph=True, pickup_time=pt),  # road point-to-point
+        _body(4, start=2, road_graph=True, refine=True, pickup_time=pt),
+        _body(3),                                   # non-road batch-mate
+    ]
+    out = optimize_route_batch(items)
+    for item, got in zip(items, out):
+        assert got == optimize_route(item)
+    assert out[0]["properties"]["road_graph"] is True
+    assert "road_graph" not in out[3]["properties"]
 
 
 def test_nonfinite_constraints_rejected_not_hung():
@@ -125,6 +145,10 @@ def test_top_k_one_allowed_in_batch():
     assert "alternatives" not in out[0]["properties"]
     assert "per-problem" in optimize_route_batch(
         [_body(3, top_k=3)])[0]["error"]
+    # road_graph items are NOT rejected (they batch); only top_k > 1 is.
+    road_and_topk = optimize_route_batch([_body(3, road_graph=True,
+                                                top_k=3)])
+    assert "per-problem" in road_and_topk[0]["error"]
 
 
 def test_varying_batch_sizes_share_programs():
