@@ -239,12 +239,7 @@ def optimize_route(input_data: dict) -> dict:
             latlon, car_speed / speed,
             hour=_pickup_hour(p["pickup_time"]))
         dist = legs.dist_m
-
-        def leg_cost(a: int, b: int):
-            return legs.leg(a, b)[:2]
-
-        def leg_geom(a: int, b: int):
-            return legs.leg(a, b)[2]
+        leg_cost, leg_geom = _road_leg_fns(legs)
     else:
         dist = np.asarray(geo.distance_matrix_m(jnp.asarray(latlon), road_factor))
         leg_cost, leg_geom = _gc_legs(all_points, dist, speed)
@@ -257,6 +252,14 @@ def optimize_route(input_data: dict) -> dict:
     # to keep exact reference-greedy semantics.
     sol = solve_host(dist, p["demands"], cap, max_dist, refine=p["refine"])
     return _assemble_multi(p, sol, dist, leg_cost, leg_geom, legs)
+
+
+def _road_leg_fns(legs) -> tuple:
+    """(leg_cost, leg_geom) adapters over one :class:`RoadLegs` — the
+    ONE encoding of its leg() return contract, shared by the single and
+    batch paths."""
+    return (lambda a, b: legs.leg(a, b)[:2],
+            lambda a, b: legs.leg(a, b)[2])
 
 
 def _finish_point_to_point(p: dict, leg_cost, leg_geom, legs) -> dict:
@@ -512,8 +515,7 @@ def optimize_route_batch(items) -> list:
         else:
             for s, legs in zip(road, legs_list):
                 s[2] = legs.dist_m
-                s[3] = (lambda _l: lambda a, b: _l.leg(a, b)[:2])(legs)
-                s[4] = (lambda _l: lambda a, b: _l.leg(a, b)[2])(legs)
+                s[3], s[4] = _road_leg_fns(legs)
                 s[5] = legs
 
     # ONE batched haversine builds every remaining problem's distance

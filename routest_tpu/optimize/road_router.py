@@ -574,7 +574,13 @@ class RoadRouter:
         counts = [len(p) for p in pts_list]
         offsets = np.concatenate([[0], np.cumsum(counts)])
         all_pts = np.concatenate(pts_list, axis=0)
-        all_nodes = self.snap(all_pts)
+        # snap() materializes an (M, N) haversine table — chunk its row
+        # axis too, or a full road batch on a country-scale graph would
+        # build the multi-GB host tensor the solve grouping avoids.
+        snap_chunk = max(1, (16 << 20) // max(self.n_nodes, 1))
+        all_nodes = np.concatenate([
+            self.snap(all_pts[i:i + snap_chunk])
+            for i in range(0, len(all_pts), snap_chunk)])
         # First/last mile: the request point is rarely ON the network;
         # charge the point↔snapped-node gap into every leg (at collector
         # free-flow for the duration) so far-off-network points see

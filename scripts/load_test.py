@@ -179,15 +179,19 @@ def run_load(bases, n_threads: int, n_requests: int):
 
 
 def run_vrp_batch_load(bases, n_threads: int, n_requests: int,
-                       problems_per_request: int = 32):
+                       problems_per_request: int = 32,
+                       road_frac: float = 0.25):
     """Batched route OPTIMIZATION phase: many VRPs per request through
     ``/api/optimize_route_batch`` (one vmapped device solve per request
-    — the batch-of-problems axis on the serving path). Reports
-    problems/sec and per-request latency."""
+    — the batch-of-problems axis on the serving path). ``road_frac``
+    of the problems carry ``road_graph: true``, exercising the grouped
+    street-network solves (``RoadRouter.route_legs_batch``) under the
+    same budget. Reports problems/sec and per-request latency."""
     from routest_tpu.data.locations import SEED_LOCATIONS
 
     latencies: list = []
     solved = [0]
+    road_solved = [0]
     errors: list = []
     lock = threading.Lock()
 
@@ -196,7 +200,7 @@ def run_vrp_batch_load(bases, n_threads: int, n_requests: int,
         for _ in range(problems_per_request):
             picks = rng.sample(range(1, len(SEED_LOCATIONS)),
                                rng.randint(2, 6))
-            items.append({
+            item = {
                 "source_point": {"lat": SEED_LOCATIONS[0][1],
                                  "lon": SEED_LOCATIONS[0][2]},
                 "destination_points": [
@@ -206,7 +210,12 @@ def run_vrp_batch_load(bases, n_threads: int, n_requests: int,
                 "driver_details": {"vehicle_capacity": 100,
                                    "maximum_distance": 200_000},
                 "refine": rng.random() < 0.5,
-            })
+            }
+            if rng.random() < road_frac:
+                item["road_graph"] = True
+                item["pickup_time"] = (
+                    f"2026-03-02T{rng.randint(0, 23):02d}:30:00")
+            items.append(item)
         return {"items": items, "use_ml_eta": True}
 
     def worker(seed: int):
@@ -219,11 +228,14 @@ def run_vrp_batch_load(bases, n_threads: int, n_requests: int,
                 out = json.loads(raw)
                 with lock:
                     if status == 200:
-                        ok = sum(1 for it in out.get("items", [])
-                                 if isinstance(it, dict)
-                                 and "error" not in it)
+                        got = [it for it in out.get("items", [])
+                               if isinstance(it, dict)
+                               and "error" not in it]
                         latencies.append(dt_s)
-                        solved[0] += ok
+                        solved[0] += len(got)
+                        road_solved[0] += sum(
+                            1 for it in got
+                            if (it.get("properties") or {}).get("road_graph"))
                     else:
                         errors.append(status)
             except Exception as e:
@@ -257,9 +269,11 @@ def run_vrp_batch_load(bases, n_threads: int, n_requests: int,
 
     return {
         "problems_per_request": problems_per_request,
+        "road_frac": road_frac,
         "threads": n_threads,
         "requests": len(latencies),
         "problems_solved": solved[0],
+        "road_problems_solved": road_solved[0],
         "wall_seconds": round(wall, 2),
         "problems_per_s": round(solved[0] / wall, 1) if wall else 0.0,
         "errors": len(errors),
