@@ -254,12 +254,108 @@ def optimize_route(input_data: dict) -> dict:
     return _assemble_multi(p, sol, dist, leg_cost, leg_geom, legs)
 
 
+MAX_MATRIX_POINTS = 64
+
+
+def travel_matrix(input_data: dict) -> dict:
+    """S×D travel matrix — the ORS capability the reference RENTS.
+
+    The reference posts its waypoints to openrouteservice's
+    ``distance_matrix`` per optimize request
+    (``/root/reference/backend/route_optimizer_twx2/Flaskr/utils.py:97-103``)
+    but never exposes the capability to its own callers; here it is a
+    first-class API. ``{"points": [{"lat","lon"}, …]}`` → distances and
+    durations between every pair (or the ``sources``/``destinations``
+    index subsets, ORS-style). With ``road_graph: true`` the matrix is
+    true shortest paths over the street network priced by the live leg
+    models (learned congestion at ``pickup_time``'s hour); otherwise
+    great-circle × the vehicle profile's road factor. Unreachable pairs
+    come back ``None``. One batched device solve either way.
+    """
+    points = input_data.get("points") if isinstance(input_data, dict) else None
+    if not isinstance(points, (list, tuple)) or len(points) < 2:
+        return {"error": "points must be a list of at least 2 {lat, lon}"}
+    if len(points) > MAX_MATRIX_POINTS:
+        return {"error": f"too many points (max {MAX_MATRIX_POINTS})"}
+    try:
+        latlon = np.asarray([[float(p["lat"]), float(p["lon"])]
+                             for p in points], dtype=np.float32)
+    except (KeyError, TypeError, ValueError):
+        return {"error": "invalid coordinates: each point needs numeric lat/lon"}
+    if not np.isfinite(latlon).all():
+        return {"error": "invalid coordinates: each point needs numeric lat/lon"}
+
+    def _subset(key):
+        idx = input_data.get(key)
+        if idx is None:
+            return list(range(len(points))), None
+        if not isinstance(idx, (list, tuple)) or not idx:
+            return None, {"error": f"{key} must be a non-empty index list"}
+        if len(idx) > MAX_MATRIX_POINTS:
+            # The points cap must bound the OUTPUT too: unbounded index
+            # lists would let a few-KB body demand a giant S×D response.
+            return None, {"error": f"too many {key} (max {MAX_MATRIX_POINTS})"}
+        try:
+            idx = [int(i) for i in idx]
+        except (TypeError, ValueError):
+            return None, {"error": f"{key} must be a non-empty index list"}
+        if any(i < 0 or i >= len(points) for i in idx):
+            return None, {"error": f"{key} index out of range"}
+        return idx, None
+
+    sources, err = _subset("sources")
+    if err:
+        return err
+    dests, err = _subset("destinations")
+    if err:
+        return err
+
+    vehicle_type = "car"
+    vt = input_data.get("vehicle_type")
+    if isinstance(vt, str) and vt.strip():
+        vehicle_type = vt.lower().strip()
+    profile = geo.profile_for_vehicle(vehicle_type)
+    speed = geo.PROFILE_SPEED_MPS[profile]
+
+    if input_data.get("road_graph"):
+        from routest_tpu.optimize.road_router import default_router
+
+        car_speed = geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("car")]
+        legs = default_router().route_legs(
+            latlon, car_speed / speed,
+            hour=_pickup_hour(input_data.get("pickup_time")))
+        dist = legs.dist_m
+        durations = [[legs.cost(i, j)[1] for j in dests] for i in sources]
+        meta = {"road_graph": True, "leg_cost_model": legs.cost_model}
+    else:
+        dist = np.asarray(geo.distance_matrix_m(
+            jnp.asarray(latlon), geo.PROFILE_ROAD_FACTOR[profile]))
+        durations = [[float(dist[i, j]) / speed for j in dests]
+                     for i in sources]
+        meta = {"road_graph": False, "leg_cost_model": "haversine"}
+
+    def _clean(v):
+        return round(float(v), 1) if math.isfinite(v) else None
+
+    return {
+        "distances_m": [[_clean(dist[i, j]) for j in dests]
+                        for i in sources],
+        "durations_s": [[_clean(durations[si][dj])
+                         for dj in range(len(dests))]
+                        for si in range(len(sources))],
+        "sources": sources,
+        "destinations": dests,
+        "vehicle_type": vehicle_type,
+        **meta,
+    }
+
+
 def _road_leg_fns(legs) -> tuple:
     """(leg_cost, leg_geom) adapters over one :class:`RoadLegs` — the
-    ONE encoding of its leg() return contract, shared by the single and
-    batch paths."""
-    return (lambda a, b: legs.leg(a, b)[:2],
-            lambda a, b: legs.leg(a, b)[2])
+    ONE encoding of its accessor contract, shared by the single and
+    batch paths. Costs avoid polyline construction entirely; geometry
+    is built only for the legs a response actually renders."""
+    return (legs.cost, lambda a, b: legs.leg(a, b)[2])
 
 
 def _finish_point_to_point(p: dict, leg_cost, leg_geom, legs) -> dict:

@@ -804,11 +804,11 @@ class RoadLegs:
         return out
 
     def cost(self, i: int, j: int) -> Tuple[float, float]:
-        """(distance_m, duration_s) for leg i→j WITHOUT building its
-        polyline — for callers pricing many candidate orders (e.g.
-        top-k alternatives) where geometry is never rendered. A later
-        ``leg`` call reuses the memoized walk and only adds the
-        geometry pass."""
+        """(distance_m, duration_s) for waypoint leg i→j WITHOUT
+        building the polyline — for callers pricing many pairs none of
+        which may render (matrix responses, candidate orders). Same
+        memoized walk core as :meth:`leg`, so the two can never
+        disagree; a later ``leg`` call only adds the geometry pass."""
         if i == j:
             return 0.0, 0.0
         _, dist_m, dur = self._walk_cost(i, j)

@@ -25,7 +25,7 @@ from werkzeug.wrappers import Response
 from routest_tpu.core.config import Config, load_config
 from routest_tpu.data.locations import locations_table
 from routest_tpu.optimize.engine import (MAX_BATCH_PROBLEMS, optimize_route,
-                                         optimize_route_batch)
+                                         optimize_route_batch, travel_matrix)
 from routest_tpu.serve import sim
 from routest_tpu.serve import auth as auth_mod
 from routest_tpu.serve.auth import AuthService, mount_auth
@@ -200,6 +200,20 @@ def create_app(config: Optional[Config] = None,
                                 float(m), 4)
                             r["properties"]["eta_completion_time_ml"] = str(ts)
         return {"count": len(items), "items": results}, 200
+
+    @app.route("/api/matrix", methods=("POST",))
+    def matrix_endpoint(request):
+        """Travel matrix — additive ABI (the ORS capability the
+        reference rents per optimize request, ``Flaskr/utils.py:97-103``,
+        exposed as a first-class API). ``{"points": [{"lat","lon"}, …],
+        "road_graph": bool, "sources"/"destinations": [idx], ...}`` →
+        ``{"distances_m": S×D, "durations_s": S×D}``; road matrices are
+        street-network shortest paths priced by the live leg models,
+        with unreachable pairs null."""
+        result = travel_matrix(get_json(request) or {})
+        if "error" in result:
+            return result, 400
+        return result, 200
 
     # ── prediction ─────────────────────────────────────────────────────
 

@@ -17,6 +17,7 @@ ENDPOINTS = [
     "/api/request_route",
     "/api/optimize_route",
     "/api/optimize_route_batch",
+    "/api/matrix",
     "/api/predict_eta",
     "/api/predict_eta_batch",
     "/api/predict",

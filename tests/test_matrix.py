@@ -1,0 +1,133 @@
+"""Travel matrix API (engine.travel_matrix + POST /api/matrix): the
+ORS matrix capability the reference rents per optimize request
+(Flaskr/utils.py:97-103), exposed first-class. Great-circle and
+road-graph regimes, subsets, unreachable pairs, HTTP shape."""
+
+import numpy as np
+import pytest
+
+from routest_tpu.data import geo
+from routest_tpu.optimize.engine import (MAX_MATRIX_POINTS, optimize_route,
+                                         travel_matrix)
+
+PTS = [[14.5836, 121.0409], [14.5355, 121.0621], [14.5866, 121.0566],
+       [14.5507, 121.0262], [14.6091, 121.0223]]
+
+
+def _points(n=len(PTS)):
+    return [{"lat": p[0], "lon": p[1]} for p in PTS[:n]]
+
+
+def test_matrix_great_circle_shape_and_values():
+    out = travel_matrix({"points": _points()})
+    n = len(PTS)
+    assert len(out["distances_m"]) == n and len(out["distances_m"][0]) == n
+    assert out["leg_cost_model"] == "haversine"
+    d = np.asarray(out["distances_m"], np.float64)
+    assert (np.diag(d) == 0).all()
+    assert d[0, 1] > 1000  # ~6 km apart x road factor
+    np.testing.assert_allclose(d, d.T, rtol=1e-5)  # haversine symmetric
+    # durations = distance / profile speed, elementwise
+    speed = geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("car")]
+    np.testing.assert_allclose(
+        np.asarray(out["durations_s"]), d / speed, rtol=0.02, atol=0.26)
+
+
+def test_matrix_subsets():
+    out = travel_matrix({"points": _points(), "sources": [0, 2],
+                         "destinations": [1, 3, 4]})
+    assert out["sources"] == [0, 2]
+    assert out["destinations"] == [1, 3, 4]
+    assert len(out["distances_m"]) == 2
+    assert len(out["distances_m"][0]) == 3
+    full = travel_matrix({"points": _points()})
+    for si, i in enumerate([0, 2]):
+        for dj, j in enumerate([1, 3, 4]):
+            assert out["distances_m"][si][dj] == full["distances_m"][i][j]
+
+
+def test_matrix_road_graph_matches_leg_provider():
+    pt = "2026-03-02T08:30:00"
+    out = travel_matrix({"points": _points(4), "road_graph": True,
+                         "pickup_time": pt})
+    assert out["road_graph"] is True
+    assert out["leg_cost_model"] in ("freeflow", "gnn")
+    d = np.asarray(out["distances_m"], np.float64)
+    assert (np.diag(d) == 0).all()
+    assert (d[~np.eye(len(d), dtype=bool)] > 0).all()
+    # The single-route path must price its leg DISTANCE identically:
+    # matrix (i->j) equals the point-to-point road response's distance.
+    # (Durations may differ there: the p2p response can be
+    # transformer-repriced with tour context, while the matrix is
+    # deliberately context-free pairwise costs.)
+    p2p = optimize_route({
+        "source_point": {"lat": PTS[0][0], "lon": PTS[0][1]},
+        "destination_points": [{"lat": PTS[1][0], "lon": PTS[1][1]}],
+        "driver_details": {"vehicle_type": "car"},
+        "road_graph": True, "pickup_time": pt,
+    })
+    assert p2p["properties"]["summary"]["distance"] == pytest.approx(
+        out["distances_m"][0][1], abs=0.11)
+    # Durations come from the same memoized walk core as the leg
+    # provider: compare against RoadLegs.cost for the same hour.
+    from routest_tpu.optimize.road_router import default_router
+
+    legs = default_router().route_legs(
+        np.asarray(PTS[:4], np.float32),
+        1.0, hour=8)
+    for i in range(4):
+        for j in range(4):
+            want = legs.cost(i, j)[1]
+            assert out["durations_s"][i][j] == pytest.approx(want, abs=0.11)
+
+
+def test_matrix_errors():
+    assert "error" in travel_matrix({})
+    assert "error" in travel_matrix({"points": [{"lat": 1, "lon": 2}]})
+    assert "error" in travel_matrix(
+        {"points": [{"lat": "x", "lon": 2}, {"lat": 1, "lon": 2}]})
+    assert "error" in travel_matrix(
+        {"points": _points(), "sources": [9]})
+    assert "error" in travel_matrix(
+        {"points": _points(), "destinations": "all"})
+    too_many = [{"lat": 14.5, "lon": 121.0}] * (MAX_MATRIX_POINTS + 1)
+    assert "too many" in travel_matrix({"points": too_many})["error"]
+    nan = _points()
+    nan[1]["lat"] = float("nan")
+    assert "error" in travel_matrix({"points": nan})
+
+
+def test_matrix_over_http(tmp_path):
+    import jax
+    from werkzeug.test import Client
+
+    from routest_tpu.core.config import Config, ServeConfig
+    from routest_tpu.core.dtypes import F32_POLICY
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.serve.app import create_app
+    from routest_tpu.serve.ml_service import EtaService
+    from routest_tpu.train.checkpoint import save_model
+
+    mpath = str(tmp_path / "eta.msgpack")
+    model = EtaMLP(hidden=(8,), policy=F32_POLICY)
+    save_model(mpath, model, model.init(jax.random.PRNGKey(0)))
+    client = Client(create_app(
+        Config(), eta_service=EtaService(ServeConfig(), model_path=mpath)))
+    r = client.post("/api/matrix", json={"points": _points(3)})
+    assert r.status_code == 200
+    body = r.get_json()
+    assert len(body["distances_m"]) == 3
+    assert body["durations_s"][0][0] == 0.0
+    r = client.post("/api/matrix", json={"points": []})
+    assert r.status_code == 400
+    assert "error" in r.get_json()
+
+
+def test_matrix_subset_length_bounded():
+    # MAX_MATRIX_POINTS must bound the OUTPUT: a tiny body with huge
+    # index lists may not amplify into an S x D memory bomb.
+    big = [0, 1] * (MAX_MATRIX_POINTS + 1)
+    assert "too many sources" in travel_matrix(
+        {"points": _points(2), "sources": big})["error"]
+    assert "too many destinations" in travel_matrix(
+        {"points": _points(2), "destinations": big})["error"]
