@@ -48,11 +48,15 @@ run_step() {
     failed="$failed $name"
   fi
 }
-run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
-  --osm-nodes 250000 --verify --flat-compare
+# Shortest steps first: a tunnel that recovers for only part of the
+# window should still yield the highest-value artifacts (the bench
+# record the driver compares, then the serving-selection table) before
+# the hour-scale router runs start.
+run_step bench timeout 600 python bench.py
 run_step kernel_bench timeout 2400 python scripts/bench_serving_kernel.py
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
-run_step bench timeout 600 python bench.py
+run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
+  --osm-nodes 250000 --verify --flat-compare
 # Country-scale probe (PARITY's 1M-node record, as a regenerable
 # artifact): osm-topology row only, oracle-verified, own file so the
 # canonical router_scale.json keeps its standard sizes.
