@@ -590,7 +590,7 @@ class RoadRouter:
             self.coords[all_nodes, 0],
             self.coords[all_nodes, 1]).astype(np.float32)
 
-        budget = max(16, min(512, (64 << 20) // (8 * max(self.n_nodes, 1))))
+        budget = _legs_batch_row_budget(self.n_nodes)
         groups: List[List[int]] = []
         cur: List[int] = []
         rows = 0
@@ -625,6 +625,13 @@ class RoadRouter:
 
 
 _SNAP_SPEED_MPS = 8.3  # first/last-mile charged at collector free-flow
+
+
+def _legs_batch_row_budget(n_nodes: int) -> int:
+    """Max source rows per grouped batch solve: bounds each dist f32 +
+    pred i32 fetch to ~64 MB whatever the graph size (clamped so tiny
+    graphs still group generously and huge ones keep ≥16 rows)."""
+    return max(16, min(512, (64 << 20) // (8 * max(n_nodes, 1))))
 
 
 class RoadLegs:

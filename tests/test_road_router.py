@@ -190,3 +190,27 @@ def test_engine_road_graph_with_refine():
     assert "error" not in out
     assert out["properties"]["refined"] is True
     assert out["properties"]["road_graph"] is True
+
+
+def test_route_legs_batch_groups_match_single(monkeypatch, router):
+    # Force the fetch budget below the batch's total rows so the
+    # grouped-solve path actually chunks, and with a budget smaller
+    # than one problem so an oversized problem forms its own group.
+    from routest_tpu.optimize import road_router as rr
+
+    rng = np.random.default_rng(5)
+    problems = []
+    for k in (3, 9, 4, 6, 2):  # 24 rows total, varying sizes
+        pts = np.stack([rng.uniform(14.40, 14.68, k),
+                        rng.uniform(120.96, 121.10, k)],
+                       axis=1).astype(np.float32)
+        problems.append((pts, 1.0, 8))
+    monkeypatch.setattr(rr, "_legs_batch_row_budget", lambda n: 8)
+    batched = router.route_legs_batch(problems)
+    for (pts, ts, hour), legs in zip(problems, batched):
+        single = router.route_legs(pts, ts, hour=hour)
+        np.testing.assert_array_equal(legs.dist_m, single.dist_m)
+        np.testing.assert_array_equal(legs._pred, single._pred)
+        for i in range(len(pts)):
+            for j in range(len(pts)):
+                assert legs.cost(i, j) == single.cost(i, j)
