@@ -11,8 +11,10 @@ Two solver regimes are exercised: the flat batched Bellman-Ford below
 router init (bridging + overlay precompute + device upload), cold solve
 (XLA compile for that source bucket), warm solve wall time for a
 16-waypoint batch (the quantity that gates request latency — one solve
-prices a whole (M, M) leg matrix), and with ``--verify`` a scipy
-Dijkstra oracle parity check.
+prices a whole (M, M) leg matrix), the full matrix-operation time
+(solve + M×M priced pairs incl. duration walks — the ORS matrix call
+the reference rents), and with ``--verify`` a scipy Dijkstra oracle
+parity check.
 
 The ``--osm-nodes`` row builds an OSM-*topology* network (degree-2 bend
 chains + one-ways via ``data/road_graph.py:subdivide_graph``), writes it
@@ -59,7 +61,20 @@ def _bench_router(router, args, np, rng):
     ], axis=1).astype(np.float32)
     nodes = router.snap(pts)
     dist, t_cold, t_warm = _time_solves(router, nodes)
-    return nodes, dist, t_cold, t_warm
+    # Full matrix operation (the ORS-comparable call the reference
+    # rents per optimize request): solve + M x M priced pairs,
+    # including the host-side predecessor walks for durations. Same
+    # min-of-3 protocol as the warm solve (fresh RoadLegs per pass —
+    # memoization would make reused-object passes nearly free).
+    matrix_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        legs = router.route_legs(pts, 1.0, hour=8)
+        for i in range(len(pts)):
+            for j in range(len(pts)):
+                legs.cost(i, j)
+        matrix_times.append(time.perf_counter() - t0)
+    return nodes, dist, t_cold, t_warm, min(matrix_times)
 
 
 def _verify(router, nodes, dist, np):
@@ -134,7 +149,8 @@ def main() -> None:
         t0 = time.perf_counter()
         router = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
         t_init = time.perf_counter() - t0
-        nodes, dist, t_cold, t_warm = _bench_router(router, args, np, rng)
+        nodes, dist, t_cold, t_warm, t_matrix = _bench_router(
+            router, args, np, rng)
         reach = float((dist < 1e37).mean())
         row = {
             "nodes": router.n_nodes,
@@ -145,6 +161,7 @@ def main() -> None:
             "router_init_s": round(t_init, 2),
             "solve_cold_ms": round(1000 * t_cold, 1),
             "solve_warm_ms": round(1000 * t_warm, 1),
+            "matrix_warm_ms": round(1000 * t_matrix, 1),
             "reachable_frac": round(reach, 4),
             **router.solver_info,
         }
@@ -172,7 +189,8 @@ def main() -> None:
         print(f"  {row['nodes']:>7,} nodes {row['edges']:>9,} edges "
               f"[{topology}/{row['solver']}] | build {row['graph_build_s']}s "
               f"init {row['router_init_s']}s | solve cold "
-              f"{row['solve_cold_ms']}ms warm {row['solve_warm_ms']}ms"
+              f"{row['solve_cold_ms']}ms warm {row['solve_warm_ms']}ms "
+              f"matrix {row['matrix_warm_ms']}ms"
               + (f" | oracle err {row.get('oracle_max_rel_err'):.2e}"
                  if args.verify else ""), flush=True)
 
@@ -208,12 +226,13 @@ def main() -> None:
         json.dump(report, f, indent=2)
 
     print(f"\n| nodes | edges | topology | solver | warm solve "
-          f"({args.waypoints} sources) | cold (compile) |")
-    print("|---|---|---|---|---|---|")
+          f"({args.waypoints} sources) | matrix ({args.waypoints}x"
+          f"{args.waypoints}) | cold (compile) |")
+    print("|---|---|---|---|---|---|---|")
     for r in rows:
         print(f"| {r['nodes']:,} | {r['edges']:,} | {r['topology']} | "
               f"{r['solver']} | {r['solve_warm_ms']} ms | "
-              f"{r['solve_cold_ms']} ms |")
+              f"{r['matrix_warm_ms']} ms | {r['solve_cold_ms']} ms |")
     print(f"\nbackend={report['backend']} → {out}")
 
 
