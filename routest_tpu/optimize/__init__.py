@@ -7,5 +7,9 @@ from routest_tpu.optimize.vrp import (  # noqa: F401
     solve_host,
     trips_cost,
 )
-from routest_tpu.optimize.engine import optimize_route  # noqa: F401
+from routest_tpu.optimize.engine import (  # noqa: F401
+    optimize_route,
+    optimize_route_batch,
+    travel_matrix,
+)
 from routest_tpu.optimize.ranking import rank_routes  # noqa: F401
