@@ -155,6 +155,12 @@ def child_main() -> None:
     except Exception:
         model = EtaMLP()
         params = model.init(jax.random.PRNGKey(0))
+    # CPU fallback serves f32 compute (bf16 there is emulation, ~1.8x
+    # slower — core/dtypes.backend_compute_policy); measure what a CPU
+    # host would actually run.
+    from routest_tpu.core.dtypes import backend_compute_policy
+
+    model = backend_compute_policy(model)
     # load_model returns host numpy arrays; without an explicit device_put
     # every jit call re-uploads the params.
     params = jax.device_put(params)

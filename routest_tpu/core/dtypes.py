@@ -35,3 +35,28 @@ class Policy:
 DEFAULT_POLICY = Policy()
 # Full-f32 policy for CPU-emulated meshes and parity tests.
 F32_POLICY = Policy(compute_dtype=jnp.float32)
+
+
+def backend_compute_policy(model):
+    """Swap a model's compute dtype to f32 on the CPU backend.
+
+    bf16 compute on CPU is EMULATED — measured ~1.8× slower than f32
+    on one core with zero bandwidth payoff (bf16 pays on the TPU's
+    MXU/HBM, which is why artifacts train and ship with it). Serving
+    and the bench apply this when they land on the CPU fallback: same
+    params, same output dtype, strictly less rounding.
+    ``RTPU_CPU_COMPUTE=bf16`` keeps the artifact's policy (e.g. to
+    reproduce TPU numerics on a CPU host). Models without a dtype
+    policy (GBDT, AOT exports) pass through unchanged."""
+    import os
+
+    policy = getattr(model, "policy", None)
+    if policy is None:
+        return model
+    if (jax.default_backend() == "cpu"
+            and policy.compute_dtype == jnp.bfloat16
+            and os.environ.get("RTPU_CPU_COMPUTE", "").lower() != "bf16"):
+        return dataclasses.replace(
+            model, policy=dataclasses.replace(policy,
+                                              compute_dtype=jnp.float32))
+    return model

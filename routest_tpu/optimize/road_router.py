@@ -273,7 +273,11 @@ class RoadRouter:
                 f"{tag}_graph_mismatch", path=resolved,
                 artifact=fp, router=self._fingerprint)
             return None
-        return model, params, meta
+        from routest_tpu.core.dtypes import backend_compute_policy
+
+        # Leg pricers serve per request: on the CPU fallback backend,
+        # bf16 compute is emulation — same swap the ETA service applies.
+        return backend_compute_policy(model), params, meta
 
     def _load_gnn(self, path: str):
         from routest_tpu.train.checkpoint import load_gnn

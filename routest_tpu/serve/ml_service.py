@@ -512,6 +512,9 @@ class EtaService:
             return
         try:
             self._model, self._params = load_model(path)
+            from routest_tpu.core.dtypes import backend_compute_policy
+
+            self._model = backend_compute_policy(self._model)
             return
         except Exception as e:
             first_error = f"{type(e).__name__}: {e}"
