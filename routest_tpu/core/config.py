@@ -54,6 +54,12 @@ class TrainConfig:
     # the capability SURVEY.md §5.3/5.4 records as absent upstream).
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 5
+    # Preemptible/elastic runs: train at most this many epochs PER
+    # INVOCATION while ``epochs`` still defines the full schedule (the
+    # optimizer's LR decay spans ``epochs``, so a job that trains in
+    # preempted slices follows the identical trajectory as one
+    # uninterrupted run). None = train to ``epochs``.
+    stop_after_epochs: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)

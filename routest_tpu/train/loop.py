@@ -219,8 +219,20 @@ def fit(
     step_fn = make_train_step(model, optimizer, runtime)
     n_shards = runtime.n_data if runtime else 1
 
+    end_epoch = cfg.epochs
+    if cfg.stop_after_epochs is not None:
+        # Elastic/preemptible slice: this invocation trains a bounded
+        # number of epochs of the FULL schedule (optimizer decay above
+        # is built from cfg.epochs, so resumed slices stay on the
+        # uninterrupted trajectory). 0 is a valid budget: restore,
+        # train nothing, evaluate.
+        if cfg.stop_after_epochs < 0:
+            raise ValueError("stop_after_epochs must be >= 0")
+        end_epoch = min(cfg.epochs, start_epoch + cfg.stop_after_epochs)
+
     losses = []
-    for epoch in range(start_epoch, cfg.epochs):
+    saved_epoch = start_epoch  # nothing new to persist until we train
+    for epoch in range(start_epoch, end_epoch):
         # per-epoch rng: deterministic shuffles that are stable across a
         # resume (epoch k shuffles identically whether or not we restarted)
         rng = np.random.default_rng(cfg.seed + 1 + epoch)
@@ -236,6 +248,18 @@ def fit(
             from routest_tpu.train import checkpoint as ckpt
 
             ckpt.save_checkpoint(cfg.checkpoint_dir, epoch + 1, tuple(state))
+            saved_epoch = epoch + 1
+
+    if (cfg.checkpoint_dir and cfg.stop_after_epochs is not None
+            and saved_epoch != end_epoch):
+        # An elastic slice always persists its endpoint (including the
+        # schedule-completing one): ending between periodic saves would
+        # otherwise make the next invocation redo — and with a budget
+        # below checkpoint_every_epochs, redo FOREVER — the work this
+        # slice just did.
+        from routest_tpu.train import checkpoint as ckpt
+
+        ckpt.save_checkpoint(cfg.checkpoint_dir, end_epoch, tuple(state))
 
     eval_rmse = rmse(model, state.params, eval_data, runtime)
     return FitResult(state=state, train_losses=losses, eval_rmse=eval_rmse)

@@ -2,6 +2,8 @@
 
 import os
 
+import pytest
+
 import numpy as np
 
 from routest_tpu.core.config import TrainConfig
@@ -54,3 +56,32 @@ def test_orbax_tmp_dirs_ignored(tiny_dataset, tmp_path):
     res = fit(model, train, ev, TrainConfig(batch_size=1024, epochs=3,
               checkpoint_dir=ckpt_dir, checkpoint_every_epochs=2))
     assert len(res.train_losses) == 1  # resumed at epoch 2, ran epoch 3 only
+
+
+def test_preempted_slices_complete_the_full_schedule(tiny_dataset, tmp_path):
+    # stop_after_epochs below checkpoint_every_epochs: each preempted
+    # slice must still persist its stop epoch, or every invocation
+    # would redo the same epochs forever. Four 1-epoch slices of a
+    # 4-epoch schedule must land exactly where one uninterrupted run
+    # does (the optimizer schedule spans cfg.epochs either way).
+    import numpy as np
+
+    train, ev = tiny_dataset
+    model = EtaMLP(hidden=(8,), policy=F32_POLICY)
+    kw = dict(batch_size=1024, epochs=4, checkpoint_dir=str(tmp_path),
+              checkpoint_every_epochs=5)  # periodic save never fires
+    for _ in range(4):
+        res = fit(model, train, ev, TrainConfig(stop_after_epochs=1, **kw))
+    full = fit(model, train, ev, TrainConfig(batch_size=1024, epochs=4))
+    np.testing.assert_allclose(
+        np.asarray(res.state.params["layers"][0]["w"]),
+        np.asarray(full.state.params["layers"][0]["w"]), rtol=1e-6)
+    assert res.train_losses[-1] == pytest.approx(full.train_losses[-1],
+                                                 rel=1e-6)
+    # a zero budget restores and trains nothing
+    res0 = fit(model, train, ev, TrainConfig(stop_after_epochs=0, **kw))
+    np.testing.assert_array_equal(
+        np.asarray(res0.state.params["layers"][0]["w"]),
+        np.asarray(res.state.params["layers"][0]["w"]))
+    with pytest.raises(ValueError, match="stop_after_epochs"):
+        fit(model, train, ev, TrainConfig(stop_after_epochs=-1, **kw))

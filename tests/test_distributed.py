@@ -207,3 +207,89 @@ def test_env_var_plumbing(monkeypatch):
     distributed.initialize()
     assert seen == {}
     monkeypatch.setattr(distributed, "_initialized", False)
+
+
+_ELASTIC_CHILD = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from routest_tpu.core import distributed
+
+distributed.initialize()
+runtime = distributed.multihost_runtime()
+
+import numpy as np
+import jax.numpy as jnp
+from routest_tpu.core.config import TrainConfig
+from routest_tpu.core.dtypes import F32_POLICY
+from routest_tpu.data.synthetic import generate_dataset
+from routest_tpu.models.eta_mlp import EtaMLP
+from routest_tpu.train.loop import fit
+
+model = EtaMLP(hidden=(16,), policy=F32_POLICY)
+data = generate_dataset(512, seed=0)
+ev = generate_dataset(128, seed=1)
+ckpt = os.environ.get("ELASTIC_CKPT") or None
+stop = int(os.environ.get("ELASTIC_STOP", "0")) or None
+res = fit(model, data, ev, TrainConfig(batch_size=128, epochs=4,
+                                       seed=0, checkpoint_dir=ckpt,
+                                       checkpoint_every_epochs=1,
+                                       stop_after_epochs=stop),
+          runtime=runtime)
+w0 = res.state.params["layers"][0]["w"]
+norm = float(jnp.linalg.norm(w0.astype(jnp.float32)))
+print(f"ELASTIC wnorm={norm:.10f} loss={res.train_losses[-1]:.10f}", flush=True)
+distributed.shutdown()
+"""
+
+
+def _run_elastic_pair(ports_idx, stop_after, ckpt_dir, ports):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env_base = dict(os.environ)
+    env_base.pop("JAX_PLATFORMS", None)
+    env_base["RTPU_COORDINATOR"] = f"127.0.0.1:{ports[ports_idx]}"
+    env_base["RTPU_NUM_PROCESSES"] = "2"
+    env_base["ELASTIC_STOP"] = str(stop_after)
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, RTPU_PROCESS_ID=str(pid),
+                   ELASTIC_CKPT=ckpt_dir)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _ELASTIC_CHILD], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    lines = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-2000:]
+        lines.append(next(l for l in out.splitlines()
+                          if l.startswith("ELASTIC")))
+    assert lines[0] == lines[1], f"processes disagree: {lines}"
+    return lines[0]
+
+
+def test_two_process_elastic_resume(tmp_path):
+    # Elastic recovery at the distributed level (SURVEY §5.3/§5.4): a
+    # two-process DP job on a 4-epoch schedule is preempted after
+    # epoch 2 (stop_after_epochs — the LR schedule still spans all 4
+    # epochs, as on a preemptible pod slice), then a REPLACEMENT pair
+    # restarts from the shared checkpoint dir and must reach the exact
+    # epoch-4 result an uninterrupted job produces — same losses, same
+    # weights, across both processes.
+    # Both processes point at ONE shared checkpoint dir (the pod
+    # filesystem): orbax's multiprocess protocol has the primary write
+    # while every process participates in the save/restore barriers —
+    # per-process dirs would desynchronize those collectives. The
+    # per-epoch shuffle is seeded per epoch, so the resumed trajectory
+    # is identical by construction.
+    ports = []
+    for _ in range(3):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+    shared = str(tmp_path / "ckpt")
+    _run_elastic_pair(0, 2, shared, ports)            # preempted after ep 2
+    resumed = _run_elastic_pair(1, 0, shared, ports)  # replacement resumes
+    uninterrupted = _run_elastic_pair(2, 0, "", ports)
+    assert resumed == uninterrupted, (resumed, uninterrupted)
