@@ -10,11 +10,13 @@ reference's CORS policy (localhost:3000 + ``*.vercel.app``,
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from werkzeug.exceptions import RequestEntityTooLarge
 from werkzeug.wrappers import Request, Response
 
 from routest_tpu.utils.logging import reset_request_id, set_request_id
@@ -71,6 +73,12 @@ class App:
 
     def __call__(self, environ, start_response):
         request = Request(environ)
+        # Body-size ceiling: get_json buffers the body, so without a cap
+        # one request could swap the host (the largest legitimate bodies
+        # — 131k-row predict_eta_batch payloads — sit under ~20 MB).
+        # Werkzeug enforces it inside get_data → RequestEntityTooLarge,
+        # which _dispatch turns into a clean 413.
+        request.max_content_length = _max_body_bytes()
         # Correlation id: honor a well-formed X-Request-ID, else mint
         # one; bound to the logging context for the handler's duration
         # and echoed on the response (SURVEY.md §5.5 — the reference has
@@ -92,6 +100,9 @@ class App:
     def _dispatch(self, request: Request) -> Response:
         if request.method == "OPTIONS":
             return Response("", 204)
+        return self._dispatch_matched(request)
+
+    def _dispatch_matched(self, request: Request) -> Response:
         fn, template, kwargs, allowed = self._match(request.method, request.path)
         if fn is None:
             if allowed:
@@ -110,6 +121,14 @@ class App:
             else:
                 response = json_response(result)
             return response
+        except RequestEntityTooLarge:
+            # Caught HERE so the finally sees a real response: a 413 is
+            # a client error and must not inflate the route's error
+            # rate (stats convention: error = status >= 500).
+            response = json_response(
+                {"error": "request body too large "
+                          f"(max {_max_body_bytes() >> 20} MB)"}, 413)
+            return response
         finally:
             # Unhandled exceptions (→ 500 in __call__) must count too.
             # Streaming responses (SSE) are long-lived; their duration is
@@ -127,6 +146,19 @@ class App:
             response.headers["Vary"] = "Origin"
             response.headers["Access-Control-Allow-Headers"] = "Content-Type, Authorization"
             response.headers["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
+
+
+def _max_body_bytes() -> int:
+    """Request-body ceiling in bytes (``RTPU_MAX_BODY_MB``, default 64
+    — ~3× the largest legitimate batch payload; malformed values keep
+    the default rather than disabling the guard)."""
+    try:
+        mb = int(os.environ.get("RTPU_MAX_BODY_MB", "64"))
+    except ValueError:
+        mb = 64
+    if mb <= 0:  # malformed includes non-positive: keep the default
+        mb = 64
+    return mb << 20
 
 
 _JSON_MISSING = object()

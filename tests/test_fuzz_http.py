@@ -224,3 +224,30 @@ def test_fuzz_get_endpoints_never_500(client):
     for uid in ids[:4]:
         r = client.get(f"/api/auth/verify-email/{uid}/{rng.random()}")
         assert r.status_code < 500, (uid, r.status_code)
+
+
+def test_oversized_body_rejected_413(client, monkeypatch):
+    # The body buffer must be bounded: a giant payload gets a clean 413
+    # (not an OOM, not a 500), and legitimate bodies pass unaffected.
+    monkeypatch.setenv("RTPU_MAX_BODY_MB", "1")
+    big = b'{"pad": "' + b"x" * (2 << 20) + b'"}'
+    r = client.post("/api/predict_eta", data=big,
+                    content_type="application/json")
+    assert r.status_code == 413
+    assert "too large" in r.get_json()["error"]
+    ok = client.post("/api/predict_eta", json={"distance_m": 1000})
+    assert ok.status_code in (200, 503)
+
+
+def test_oversized_body_not_counted_as_server_error(monkeypatch):
+    # 413 is a CLIENT error: the route's error counter (what health and
+    # the load-test budgets consume) must not move.
+    monkeypatch.setenv("RTPU_MAX_BODY_MB", "1")
+    app = create_app(Config())
+    c = Client(app)
+    big = b'{"pad": "' + b"x" * (2 << 20) + b'"}'
+    assert c.post("/api/predict_eta", data=big,
+                  content_type="application/json").status_code == 413
+    stats = app.request_stats.snapshot()
+    key = "POST /api/predict_eta"
+    assert stats["routes"][key]["errors"] == 0, stats["routes"][key]
