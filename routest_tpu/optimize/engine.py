@@ -325,7 +325,8 @@ def travel_matrix(input_data: dict) -> dict:
             latlon, car_speed / speed,
             hour=_pickup_hour(input_data.get("pickup_time")))
         dist = legs.dist_m
-        durations = [[legs.cost(i, j)[1] for j in dests] for i in sources]
+        durm = legs.duration_matrix()   # one device dispatch, no walks
+        durations = [[float(durm[i, j]) for j in dests] for i in sources]
         meta = {"road_graph": True, "leg_cost_model": legs.cost_model}
     else:
         dist = np.asarray(geo.distance_matrix_m(

@@ -52,6 +52,56 @@ from routest_tpu.utils.logging import get_logger
 
 _INF = jnp.float32(3e38)
 
+
+@functools.partial(jax.jit, static_argnames=("n_rounds",))
+def _time_table(bf_senders: jax.Array, pred: jax.Array, time_bf: jax.Array,
+                dist: jax.Array, *, n_rounds: int) -> jax.Array:
+    """(S, N) travel seconds along every shortest-path tree, on device.
+
+    Matrix consumers need durations for every (source, node) pair; the
+    host-side predecessor walk is O(path length) PER PAIR — seconds of
+    pointer chasing at metro scale. Pointer doubling turns the whole
+    table into ``n_rounds = ceil(log2(N))`` rounds of two (S, N)
+    gathers: each round, every node's accumulated time and parent jump
+    twice as far up its tree. Sums re-associate (tree order instead of
+    walk order), so values match the walk to f32 rounding, not
+    bitwise. Unreachable nodes (no predecessor, infinite distance)
+    come back INF like the distance table."""
+    rows = jnp.arange(pred.shape[0])[:, None]
+    has_pred = pred >= 0
+    safe = jnp.maximum(pred, 0)
+    parent = jnp.where(has_pred, bf_senders[safe],
+                       jnp.arange(pred.shape[1])[None, :])
+    acc = jnp.where(has_pred, time_bf[safe], 0.0)
+
+    # Fixed point after ceil(log2(tree depth)) rounds — the street-graph
+    # diameter, typically far below the n_rounds=log2(N) bound; exit as
+    # soon as every pointer reaches its root (one cheap compare per
+    # round vs. the gathers it saves).
+    def keep_going(state):
+        _, _, changed, i = state
+        return changed & (i < n_rounds)
+
+    def body(state):
+        acc, parent, _, i = state
+        new_parent = parent[rows, parent]
+        return (acc + acc[rows, parent], new_parent,
+                jnp.any(new_parent != parent), i + 1)
+
+    acc, parent, _, _ = jax.lax.while_loop(
+        keep_going, body,
+        (acc, parent, jnp.asarray(True), jnp.zeros((), jnp.int32)))
+    # A predecessor CYCLE (possible with zero-length-edge ties — the
+    # case _walk defends against) must surface as unreachable like the
+    # walk does, not as a plausible partial sum. "Still moving" is NOT
+    # a sufficient test: an even-length cycle squares to a spurious
+    # fixed point where its nodes become their own parents. The sound
+    # invariant: a finished chain ends at a TRUE root — a node with no
+    # predecessor. Anything whose final parent still has a predecessor
+    # sits in (or chains into) a cycle.
+    bad_root = jnp.take_along_axis(has_pred, parent, axis=1)
+    return jnp.where((dist < 1e37) & ~bad_root, acc, jnp.inf)
+
 # Flat-relaxation sweeps run over hierarchy distances before
 # predecessor recovery: the overlay's re-associated sums round a few
 # ulps away from the sweep's own ``dist[s] + w`` assignments; a handful
@@ -661,6 +711,8 @@ class RoadLegs:
         self.dist_m = dist[np.arange(m)[:, None], nodes[None, :]] \
             + snap_m[:, None] + snap_m[None, :]
         np.fill_diagonal(self.dist_m, 0.0)
+        self._dist_rows = dist            # (M, N): duration_matrix masks by it
+        self._dur_rows: Optional[np.ndarray] = None
         self._memo: Dict[Tuple[int, int], Tuple[float, float, list]] = {}
         self._cost_memo: Dict[Tuple[int, int], Tuple[float, float]] = {}
 
@@ -824,6 +876,37 @@ class RoadLegs:
             return 0.0, 0.0
         _, dist_m, dur = self._walk_cost(i, j)
         return dist_m, dur
+
+    def duration_matrix(self) -> np.ndarray:
+        """(M, M) leg seconds for EVERY waypoint pair in one device
+        dispatch. The per-pair walk in :meth:`cost` is O(path length)
+        host pointer chasing — fine for a handful of response legs,
+        seconds for a full matrix at metro scale. Here the whole
+        (M, N) time table accumulates on device via pointer doubling
+        (``_time_table``) and the matrix is one gather; values match
+        the walk to f32 rounding (sums re-associate). Computed lazily,
+        once per solve."""
+        if self._dur_rows is None:
+            r = self._r
+            n_rounds = max(1, (max(r.n_nodes - 1, 1)).bit_length())
+            # Same bucket trick as shortest(): pad the waypoint axis to
+            # a power of two (repeating row 0) so varying M reuses one
+            # compiled table program instead of recompiling per count.
+            m = len(self._pred)
+            bucket = 1 << max(0, (m - 1)).bit_length()
+            pad = [(0, bucket - m), (0, 0)]
+            self._dur_rows = np.asarray(_time_table(
+                r._d_senders,
+                jnp.asarray(np.pad(self._pred, pad, mode="edge")),
+                jnp.asarray(self._time_s),
+                jnp.asarray(np.pad(self._dist_rows, pad, mode="edge")),
+                n_rounds=n_rounds))[:m]
+        dur = self._dur_rows[:, self._nodes].astype(np.float64)
+        dur = self._time_scale * (
+            dur + (self._snap_m[:, None] + self._snap_m[None, :])
+            / _SNAP_SPEED_MPS)
+        np.fill_diagonal(dur, 0.0)
+        return dur
 
     def leg(self, i: int, j: int) -> Tuple[float, float, List[List[float]]]:
         """(distance_m, duration_s, [[lon, lat], …]) for waypoint leg i→j."""

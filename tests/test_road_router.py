@@ -214,3 +214,53 @@ def test_route_legs_batch_groups_match_single(monkeypatch, router):
         for i in range(len(pts)):
             for j in range(len(pts)):
                 assert legs.cost(i, j) == single.cost(i, j)
+
+
+def test_duration_matrix_matches_walks(router, rng):
+    # The device-side pointer-doubling table must agree with the
+    # per-pair predecessor walks (same tree, re-associated sums) —
+    # including unreachable semantics and the diagonal.
+    pts = np.stack([rng.uniform(14.40, 14.68, 7),
+                    rng.uniform(120.96, 121.10, 7)],
+                   axis=1).astype(np.float32)
+    legs = router.route_legs(pts, 1.3, hour=17)
+    durm = legs.duration_matrix()
+    assert durm.shape == (7, 7)
+    for i in range(7):
+        for j in range(7):
+            want = legs.cost(i, j)[1]
+            if np.isinf(want):
+                assert np.isinf(durm[i, j])
+            else:
+                assert durm[i, j] == pytest.approx(want, rel=1e-4,
+                                                   abs=1e-2)
+    assert (np.diag(durm) == 0).all()
+
+
+def test_time_table_cycles_and_unreachable_are_inf():
+    # Unit-level guards for the pointer-doubling table: a predecessor
+    # CYCLE (zero-length-edge ties) and an unreachable row must both
+    # surface as inf — never a plausible partial sum (the same
+    # contract _walk enforces by returning unreachable).
+    import jax.numpy as jnp
+
+    from routest_tpu.optimize.road_router import _time_table
+
+    # Edges: 0->1 (e0), 1->2 (e1), 2->1 (e2). Node 3 isolated.
+    senders = jnp.asarray([0, 1, 2], jnp.int32)
+    time_e = jnp.asarray([5.0, 7.0, 0.0], jnp.float32)
+    # Healthy tree from source 0: pred = [-1, e0, e1, -1]
+    pred_ok = np.asarray([[-1, 0, 1, -1]], np.int32)
+    dist_ok = np.asarray([[0.0, 5.0, 12.0, 3e38]], np.float32)
+    out = np.asarray(_time_table(senders, jnp.asarray(pred_ok), time_e,
+                                 jnp.asarray(dist_ok), n_rounds=4))
+    assert out[0, 0] == 0.0 and out[0, 1] == 5.0 and out[0, 2] == 12.0
+    assert np.isinf(out[0, 3])                      # unreachable row
+    # 2-cycle between nodes 1 and 2 (pred[1]=e2 from 2, pred[2]=e1
+    # from 1) with finite dist: must come back inf, not garbage.
+    pred_cyc = np.asarray([[-1, 2, 1, -1]], np.int32)
+    dist_cyc = np.asarray([[0.0, 5.0, 5.0, 3e38]], np.float32)
+    out = np.asarray(_time_table(senders, jnp.asarray(pred_cyc), time_e,
+                                 jnp.asarray(dist_cyc), n_rounds=4))
+    assert np.isinf(out[0, 1]) and np.isinf(out[0, 2])
+    assert out[0, 0] == 0.0
