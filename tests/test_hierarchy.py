@@ -205,6 +205,24 @@ def test_overlay_serves_metro_extract_over_http(monkeypatch, tmp_path):
     assert road["solver"] == "hierarchy"
     assert road["overlay"]["n_cells"] >= 2
     assert road["nodes"] == rr.default_router().n_nodes
+    # The matrix API rides the same overlay router: S x D street
+    # distances/durations at metro scale through HTTP, durations from
+    # the device-side table (no host walks).
+    res = client.post("/api/matrix", json={
+        "points": [{"lat": 14.5836, "lon": 121.0409},
+                   {"lat": 14.5355, "lon": 121.0621},
+                   {"lat": 14.5866, "lon": 121.0566}],
+        "road_graph": True, "sources": [0],
+        "pickup_time": "2026-03-02T08:30:00",
+    })
+    assert res.status_code == 200, res.get_data(as_text=True)
+    mat = res.get_json()
+    assert mat["road_graph"] is True
+    assert len(mat["distances_m"]) == 1
+    assert len(mat["distances_m"][0]) == 3
+    assert mat["distances_m"][0][0] == 0.0
+    assert all(v > 0 for v in mat["distances_m"][0][1:])
+    assert all(v > 0 for v in mat["durations_s"][0][1:])
 
 
 def test_overlay_disk_cache_roundtrip(force_hier, monkeypatch, tmp_path, rng):
