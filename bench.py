@@ -218,14 +218,30 @@ def child_main() -> None:
             from routest_tpu.ops import fused_eta_forward, pack_eta_params
 
             packed = jax.device_put(pack_eta_params(model, params))
-            fused = lambda xx: fused_eta_forward(packed, xx, n_q=n_q)  # noqa: E731
-            if n_q:
-                # quantile path returns (B, Q); time the same scalar
-                # chain as XLA by feeding the median back
-                candidates["pallas_fused"] = measure(
-                    lambda xx: fused(xx)[:, n_q // 2])
-            else:
-                candidates["pallas_fused"] = measure(fused)
+            # Default tile plus the serving bench's recorded winner for
+            # this batch (scripts/bench_serving_kernel.py sweeps tiles;
+            # without the record the kernel would be timed at a tile
+            # the sweep already beat). ONE parser owns the record —
+            # EtaService's, which also rejects non-TPU (interpreter)
+            # records and honors ROUTEST_KERNEL_BENCH relocation.
+            from routest_tpu.serve.ml_service import EtaService
+
+            tiles = {2048}
+            _, tile_by_batch = EtaService._fused_win_bucket()
+            if batch in tile_by_batch:
+                tiles.add(tile_by_batch[batch])
+            for tile in sorted(tiles):
+                fused = lambda xx, _t=tile: fused_eta_forward(  # noqa: E731
+                    packed, xx, n_q=n_q, tile=_t)
+                label = ("pallas_fused" if len(tiles) == 1
+                         else f"pallas_fused@{tile}")
+                if n_q:
+                    # quantile path returns (B, Q); time the same scalar
+                    # chain as XLA by feeding the median back
+                    candidates[label] = measure(
+                        lambda xx, _f=fused: _f(xx)[:, n_q // 2])
+                else:
+                    candidates[label] = measure(fused)
         except Exception as e:  # kernel is an optimization, never a dependency
             print(f"bench: fused kernel unavailable: {type(e).__name__}: {e}",
                   file=sys.stderr)
