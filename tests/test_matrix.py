@@ -131,3 +131,29 @@ def test_matrix_subset_length_bounded():
         {"points": _points(2), "sources": big})["error"]
     assert "too many destinations" in travel_matrix(
         {"points": _points(2), "destinations": big})["error"]
+
+
+def test_matrix_vehicle_profile_scales_durations():
+    # A slower profile must scale durations (not distances) in both
+    # regimes — same contract as optimize_route's leg pricing.
+    car = travel_matrix({"points": _points(3)})
+    truck = travel_matrix({"points": _points(3), "vehicle_type": "truck"})
+    speed_ratio = (geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("car")]
+                   / geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("truck")])
+    assert speed_ratio > 1.0  # trucks are slower
+    # Great-circle regime: distances approximate streets via the
+    # profile's road factor, so they scale by the factor ratio.
+    factor_ratio = (geo.PROFILE_ROAD_FACTOR[geo.profile_for_vehicle("truck")]
+                    / geo.PROFILE_ROAD_FACTOR[geo.profile_for_vehicle("car")])
+    assert truck["distances_m"][0][1] == pytest.approx(
+        car["distances_m"][0][1] * factor_ratio, rel=0.01)
+    assert truck["durations_s"][0][1] == pytest.approx(
+        car["durations_s"][0][1] * factor_ratio * speed_ratio, rel=0.01)
+    # Road regime: distances are true street paths (profile-free);
+    # only durations scale, by the speed ratio.
+    r_car = travel_matrix({"points": _points(3), "road_graph": True})
+    r_truck = travel_matrix({"points": _points(3), "road_graph": True,
+                             "vehicle_type": "truck"})
+    assert r_truck["distances_m"] == r_car["distances_m"]
+    assert r_truck["durations_s"][0][1] == pytest.approx(
+        r_car["durations_s"][0][1] * speed_ratio, rel=0.01)
