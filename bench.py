@@ -212,6 +212,7 @@ def child_main() -> None:
     else:
         xla_forward = lambda xx: model.apply(params, xx)  # noqa: E731
     candidates = {"xla": measure(xla_forward)}
+    fused_times = {}
 
     if backend == "tpu":
         try:
@@ -233,15 +234,19 @@ def child_main() -> None:
             for tile in sorted(tiles):
                 fused = lambda xx, _t=tile: fused_eta_forward(  # noqa: E731
                     packed, xx, n_q=n_q, tile=_t)
-                label = ("pallas_fused" if len(tiles) == 1
-                         else f"pallas_fused@{tile}")
                 if n_q:
                     # quantile path returns (B, Q); time the same scalar
                     # chain as XLA by feeding the median back
-                    candidates[label] = measure(
+                    fused_times[tile] = measure(
                         lambda xx, _f=fused: _f(xx)[:, n_q // 2])
                 else:
-                    candidates[label] = measure(fused)
+                    fused_times[tile] = measure(fused)
+                # Consumers key on the literal "pallas_fused" name, so
+                # the candidate table carries the best-timed tile under
+                # that stable key; per-tile timings ride a separate
+                # field. Updated per tile so a later tile's failure
+                # (e.g. a stale recorded tile) keeps this one's timing.
+                candidates["pallas_fused"] = min(fused_times.values())
         except Exception as e:  # kernel is an optimization, never a dependency
             print(f"bench: fused kernel unavailable: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -303,6 +308,9 @@ def child_main() -> None:
         "init_s": round(init_s, 1),
         "paths_mps": {k: round(batch / v / 1e6, 2)
                       for k, v in candidates.items()},
+        **({"pallas_tiles_mps": {str(t): round(batch / v / 1e6, 2)
+                                 for t, v in sorted(fused_times.items())}}
+           if len(fused_times) > 1 else {}),
         "roofline": roofline,
     }))
 

@@ -890,8 +890,8 @@ class RoadLegs:
             r = self._r
             n_rounds = max(1, (max(r.n_nodes - 1, 1)).bit_length())
             # Same bucket trick as shortest(): pad the waypoint axis to
-            # a power of two (repeating row 0) so varying M reuses one
-            # compiled table program instead of recompiling per count.
+            # a power of two (repeating the last row) so varying M reuses
+            # one compiled table program instead of recompiling per count.
             m = len(self._pred)
             bucket = 1 << max(0, (m - 1)).bit_length()
             pad = [(0, bucket - m), (0, 0)]
