@@ -16,7 +16,8 @@ city extract does not balloon host memory):
 - ``<way>`` with a ``highway`` tag in the drivable set — split into one
   edge per consecutive ``<nd>`` pair (finest granularity: every bend is
   a graph vertex, lengths are true haversine);
-- ``oneway=yes/-1`` respected; everything else symmetrized;
+- ``oneway=yes/-1`` respected, ``junction=roundabout/circular``
+  implies one-way when no explicit tag; everything else symmetrized;
 - ``maxspeed`` parsed ("50", "50 km/h", "30 mph"), else the class
   default; highway class mapped onto the 3-class scheme the GNN and
   free-flow pricer share (arterial / collector / local).
@@ -264,7 +265,13 @@ def _ingest_way(way_nodes, way_tags, segments) -> None:
             speed = _parse_maxspeed(way_tags["maxspeed"])
         except ValueError:
             pass  # non-numeric maxspeed: keep the class default
-    oneway = way_tags.get("oneway", "no").lower()
+    oneway_tag = way_tags.get("oneway")
+    if oneway_tag is None and way_tags.get("junction", "").lower() in (
+            "roundabout", "circular"):
+        # OSM semantics: junction=roundabout implies oneway=yes in
+        # drawing order unless an explicit oneway tag overrides it.
+        oneway_tag = "yes"
+    oneway = (oneway_tag or "no").lower()
     pairs = zip(way_nodes[:-1], way_nodes[1:])
     if oneway == "-1":  # rare: oneway against drawing direction
         pairs = zip(way_nodes[1:], way_nodes[:-1])

@@ -396,7 +396,9 @@ FfOsmResult* ff_osm_parse(const char* buf, int64_t len,
     int32_t way_cls = -1;
     std::string way_maxspeed;      // raw LAST maxspeed tag value
     bool way_has_maxspeed = false;
-    std::string way_oneway = "no";
+    std::string way_oneway;
+    bool way_has_oneway = false;
+    std::string way_junction;      // junction=roundabout implies oneway
 
     auto flush_way = [&]() {
         if (way_cls < 0 || way_nodes.size() < 2) return;
@@ -408,7 +410,15 @@ FfOsmResult* ff_osm_parse(const char* buf, int64_t len,
         if (way_has_maxspeed && parse_maxspeed(way_maxspeed, &mps))
             spd = mps;
         // Python lowercases WITHOUT stripping ("yes " stays two-way).
-        std::string ow = to_lower(way_oneway);
+        // No explicit oneway tag: junction=roundabout/circular implies
+        // one-way in drawing order (data/osm.py:_ingest_way parity).
+        std::string ow;
+        if (way_has_oneway) {
+            ow = to_lower(way_oneway);
+        } else {
+            std::string j = to_lower(way_junction);
+            ow = (j == "roundabout" || j == "circular") ? "yes" : "no";
+        }
         bool rev = ow == "-1";
         bool both = !(ow == "yes" || ow == "true" || ow == "1" || rev);
         for (size_t i = 0; i + 1 < way_nodes.size(); ++i) {
@@ -484,7 +494,9 @@ FfOsmResult* ff_osm_parse(const char* buf, int64_t len,
             way_nodes.clear();
             way_cls = -1;
             way_has_maxspeed = false;
-            way_oneway = "no";
+            way_oneway.clear();
+            way_has_oneway = false;
+            way_junction.clear();
         } else if (name == "nd" && in_way) {
             for (auto& kv : at)
                 if (kv.first == "ref") {
@@ -506,14 +518,18 @@ FfOsmResult* ff_osm_parse(const char* buf, int64_t len,
             // consumed keys would read, decodes differently under
             // ElementTree: fall back rather than diverge.
             if (!entity_free(k)) { res->code = 1; return res; }
-            if (k == "highway" || k == "maxspeed" || k == "oneway") {
+            if (k == "highway" || k == "maxspeed" || k == "oneway" ||
+                k == "junction") {
                 if (!entity_free(v)) { res->code = 1; return res; }
             }
             if (k == "highway") way_cls = highway_class(v);
             else if (k == "maxspeed") {
                 way_maxspeed = v;       // last tag wins; parsed at flush
                 way_has_maxspeed = true;
-            } else if (k == "oneway") way_oneway = v;
+            } else if (k == "oneway") {
+                way_oneway = v;
+                way_has_oneway = true;
+            } else if (k == "junction") way_junction = v;
         }
     }
     // Truncated document (no root close, or a way left open at EOF):

@@ -50,7 +50,8 @@ class ServerState:
     """Everything the handlers share."""
 
     def __init__(self, config: Config, eta: EtaService, store, bus,
-                 sim_tick_range=(2.0, 5.0), auth: Optional[AuthService] = None) -> None:
+                 sim_tick_range=(2.0, 5.0), auth: Optional[AuthService] = None,
+                 mailer=None) -> None:
         self.config = config
         self.eta = eta
         self.store = store
@@ -58,15 +59,23 @@ class ServerState:
         self.sim_tick_range = sim_tick_range
         self.auth = auth if auth is not None else AuthService(
             required=os.environ.get("ROUTEST_AUTH") == "require")
+        self.mailer = mailer
         self.started = time.time()
+        # tile-probe cache: (checked_at, result) — see health()
+        self._tiles_cache = (0.0, None)
 
 
 def create_app(config: Optional[Config] = None,
                eta_service: Optional[EtaService] = None,
                store=None, bus=None,
                sim_tick_range=(2.0, 5.0),
-               auth: Optional[AuthService] = None) -> App:
+               auth: Optional[AuthService] = None,
+               mailer=None) -> App:
     config = config or load_config()
+    if mailer is None:
+        from routest_tpu.serve.mail import make_mailer
+
+        mailer = make_mailer()
     if eta_service is not None:
         eta = eta_service
     else:
@@ -78,11 +87,12 @@ def create_app(config: Optional[Config] = None,
         config.serve.supabase_url, config.serve.supabase_service_key
     )
     bus = bus if bus is not None else make_bus(config.serve.redis_url)
-    state = ServerState(config, eta, store, bus, sim_tick_range, auth)
+    state = ServerState(config, eta, store, bus, sim_tick_range, auth,
+                        mailer=mailer)
 
     app = App()
     app.state = state  # for tests / introspection
-    mount_auth(app, state.auth)
+    mount_auth(app, state.auth, mailer=state.mailer)
 
     # ── optimization ────────────────────────────────────────────────────
 
@@ -517,6 +527,18 @@ def create_app(config: Optional[Config] = None,
     for _name in ("dashboard", "mvp", "health"):
         with open(os.path.join(_static_dir, _name + ".html"), "rb") as f:
             _pages[_name] = f.read()  # immutable assets: read once, serve cached
+    with open(os.path.join(_static_dir, "lib",
+                           "dashboard_logic.js"), "rb") as f:
+        _dashboard_logic_js = f.read()
+
+    @app.route("/lib/dashboard_logic.js", methods=("GET",))
+    def dashboard_logic_js(request):
+        # The dashboard's pure logic as a real module file so CI can
+        # execute the exact shipped bytes (tests/test_dashboard_logic.py
+        # via utils/minijs.py) — the reference keeps equivalent logic
+        # inside page components (frontend/map-app/app/ui/page.jsx).
+        return Response(_dashboard_logic_js,
+                        mimetype="text/javascript")
 
     @app.route("/", methods=("GET",))
     def mvp_page(request):
@@ -613,7 +635,7 @@ def create_app(config: Optional[Config] = None,
             "db": store_ok,
             "osrm": engine_res["status"] in ("ok", "degraded"),
             "redis": bus_ok,
-            "tiles": True,
+            "tiles": _tiles_status(state),
             "status": overall,
             "version": state.config.serve.version,
         }
@@ -621,6 +643,37 @@ def create_app(config: Optional[Config] = None,
 
     _warm_optimizer()
     return app
+
+
+def _tiles_status(state: ServerState):
+    """The reference's health route actually fetches a map tile from
+    OSM/Carto (``frontend/map-app/app/api/health/route.js:36-49``).
+    The built-in dashboard renders a dependency-free SVG basemap, so
+    with no tile server configured the honest answer is ``"static"``
+    rather than a hardcoded ``true``; when ``ROUTEST_TILE_URL`` names a
+    tile endpoint (e.g. a self-hosted ``/0/0/0.png``) it is probed for
+    real, cached for 30 s so health polls don't hammer it."""
+    url = os.environ.get("ROUTEST_TILE_URL")
+    if not url:
+        return "static"
+    now = time.time()
+    checked, result = state._tiles_cache
+    if result is not None and now - checked < 30.0:
+        return result
+    import http.client
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=2.0) as resp:
+            ok = 200 <= resp.status < 400
+    except (urllib.error.URLError, http.client.HTTPException,
+            OSError, ValueError):
+        # URLError: unreachable; HTTPException: a server speaking
+        # non-HTTP (BadStatusLine etc.) — health stays degraded-not-down
+        ok = False
+    state._tiles_cache = (now, ok)
+    return ok
 
 
 def _prometheus_text(snapshot: dict) -> str:

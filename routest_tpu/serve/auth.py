@@ -12,13 +12,16 @@ exists here as a first-class serving module:
   Bearer <token>`` issued at register/login, revoked at logout;
 - PBKDF2-HMAC-SHA256 password hashing (Laravel uses bcrypt; same
   contract, stdlib-only);
-- password reset and email verification flows are hermetic: where
-  Breeze emails a link, these endpoints RETURN the token/link payload
-  directly — no SMTP dependency, same state machine. The verify-email
-  hash is sha1(email), matching Laravel's signed-URL ingredient.
-  Exception: under ``ROUTEST_AUTH=require`` the reset token is written
-  to the server log instead of the response, so the bearer gate cannot
-  be bypassed by an anonymous forgot-password call.
+- password reset and email verification flows are hermetic BY DEFAULT:
+  where Breeze emails a link, these endpoints RETURN the token/link
+  payload directly — no SMTP dependency, same state machine. The
+  verify-email hash is sha1(email), matching Laravel's signed-URL
+  ingredient. Exception: under ``ROUTEST_AUTH=require`` the reset
+  token is written to the server log instead of the response, so the
+  bearer gate cannot be bypassed by an anonymous forgot-password call.
+  With a mail transport configured (``serve/mail.py``,
+  ``ROUTEST_MAIL_FILE``), both flows instead deliver the secret by
+  mail only — the reference's mail-driver behavior.
 
 Status-code parity with Breeze: validation failures are 422 (including
 bad credentials — Laravel's ValidationException), missing/invalid
@@ -273,8 +276,16 @@ def validation_error(e: Exception):
     return {"message": msg, "errors": {field: [msg]}}, 422
 
 
-def mount_auth(app, auth: AuthService) -> None:
-    """Register the Breeze-parity endpoints on the serving app."""
+def mount_auth(app, auth: AuthService, mailer=None) -> None:
+    """Register the Breeze-parity endpoints on the serving app.
+
+    ``mailer`` (serve/mail.py) is the reference's mail-driver seam:
+    when configured, reset tokens and verification links travel by
+    mail only — the responses match Breeze's (status strings, no
+    secrets), like PasswordResetLinkController / EmailVerification-
+    NotificationController behind a real MAIL_MAILER. When None
+    (hermetic default), the flows keep their in-band token behavior
+    (module docstring)."""
     from routest_tpu.serve.wsgi import get_json
 
     @app.route("/api/auth/register", methods=("POST",))
@@ -326,7 +337,14 @@ def mount_auth(app, auth: AuthService) -> None:
         # (the "mailbox"), never the HTTP response.
         payload = {"status": "We have emailed your password reset link."}
         if token is not None:
-            if auth.required:
+            if mailer is not None:
+                # Reference behavior: the token travels by mail only.
+                email = str(body.get("email") or "")
+                mailer.send(
+                    email, "Reset Password Notification",
+                    "Use this token with POST /api/auth/reset-password: "
+                    + token)
+            elif auth.required:
                 # JsonLogger json-escapes fields, so an attacker-chosen
                 # email cannot inject forged lines into the token stream.
                 get_logger("routest.auth").info(
@@ -352,10 +370,18 @@ def mount_auth(app, auth: AuthService) -> None:
         user = auth.user_from_request(request)
         if user is None:
             return UNAUTHENTICATED
+        verify_url = (f"/api/auth/verify-email/{user['id']}/"
+                      f"{verify_email_hash(user['email'])}")
+        if mailer is not None:
+            # Reference behavior: link travels by mail; the response is
+            # just the Breeze status string.
+            mailer.send(user["email"], "Verify Email Address",
+                        "Open this link while authenticated: "
+                        + verify_url)
+            return {"status": "verification-link-sent"}, 200
         # Hermetic stand-in for the verification email.
         return {"status": "verification-link-sent",
-                "verify_url": f"/api/auth/verify-email/{user['id']}/"
-                              f"{verify_email_hash(user['email'])}"}, 200
+                "verify_url": verify_url}, 200
 
     @app.route("/api/auth/verify-email/<user_id>/<email_hash>", methods=("GET",))
     def verify_email(request, user_id, email_hash):

@@ -103,6 +103,13 @@ def main() -> None:
     parser.add_argument("--osm-nodes", type=int, default=250_000,
                         help="target size for the OSM-topology extract row "
                              "(0 skips it)")
+    parser.add_argument("--osm-file", default="auto",
+                        help="route a COMMITTED OSM extract as its own row "
+                             "(topology=osm_file). Default 'auto' = the "
+                             "curated Metro Manila arterial network "
+                             "(artifacts/manila_arterials.osm.gz) when "
+                             "present; 'none' skips; any path routes that "
+                             "extract")
     parser.add_argument("--waypoints", type=int, default=16)
     parser.add_argument("--verify", action="store_true",
                         help="scipy Dijkstra oracle parity per row")
@@ -215,6 +222,23 @@ def main() -> None:
             save_osm(path, streets)
             extract = load_osm(path)
         run_case(extract, time.perf_counter() - t0, "osm_extract")
+
+    osm_file = args.osm_file
+    if osm_file == "auto":
+        osm_file = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "artifacts",
+            "manila_arterials.osm.gz")
+        if not os.path.exists(osm_file):
+            osm_file = "none"
+    if osm_file != "none":
+        # A real-provenance network (curated Metro Manila arterials,
+        # scripts/make_manila_extract.py — VERDICT r4 next #6) beside
+        # the generator rows: same solver, real street geometry.
+        from routest_tpu.data.osm import load_osm as _load
+
+        t0 = time.perf_counter()
+        extract = _load(osm_file)
+        run_case(extract, time.perf_counter() - t0, "osm_file")
 
     report = {"backend": jax.default_backend(), "rows": rows}
     out = args.out or os.path.join(os.path.dirname(os.path.dirname(

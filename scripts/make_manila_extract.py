@@ -1,0 +1,302 @@
+"""Emit the curated Metro Manila arterial extract (OSM XML, gzipped).
+
+VERDICT r4 next #6 asks for a real road network (the reference rides
+real streets through ORS — ``Flaskr/utils.py:97-103``; SURVEY §7.3.5
+asks for a Metro Manila extract). This sandbox has zero egress, so an
+ODbL database dump cannot be fetched; this script instead encodes the
+city's arterial network from public-knowledge geography:
+
+- REAL roads (EDSA, Quezon Ave, Commonwealth, España, Aurora, Ortigas,
+  Shaw, C-5, Ayala, Gil Puyat, Taft, Roxas, Osmeña, ...), their REAL
+  junction topology, and real-world tagging (trunk/primary/secondary
+  classes, km/h maxspeeds, the Welcome Rotonda and Quezon Memorial
+  Circle as ``junction=roundabout`` rings, a one-way pair in the Makati
+  CBD, a ``PH:urban`` zone maxspeed, Ñ entity references in names);
+- junction coordinates curated to roughly ±300 m (good enough for
+  haversine edge lengths to be city-realistic);
+- way geometry densified by interpolating shape points every ~75 m
+  between junctions (straight chords — the one synthetic aspect, and
+  the reason this is labeled "curated", not "extracted").
+
+The emitted file also carries the real-extract furniture parsers must
+tolerate: ``<bounds>``, a ``<relation>`` (the EDSA Carousel bus route),
+XML comments, a way clipped at the extract boundary (a ``<nd>`` ref
+with no node), and a non-drivable footway.
+
+Output: ``artifacts/manila_arterials.osm.gz`` (deterministic bytes —
+re-running reproduces the committed artifact exactly).
+``tests/test_manila_extract.py`` pins parser parity + routing on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import io
+import math
+import os
+
+# ── curated junction table: name → (lat, lon) ─────────────────────────
+# Approximate real coordinates (±~300 m) of the named intersections.
+JUNCTIONS = {
+    # EDSA (C-4) from the Bonifacio Monument to the Roxas Blvd end
+    "monumento": (14.6565, 120.9840),
+    "balintawak": (14.6575, 121.0040),
+    "munoz": (14.6578, 121.0185),
+    "north_edsa": (14.6527, 121.0321),
+    "quezon_edsa": (14.6424, 121.0384),
+    "kamuning": (14.6351, 121.0414),
+    "cubao": (14.6197, 121.0525),
+    "santolan": (14.6077, 121.0565),
+    "ortigas_edsa": (14.5907, 121.0567),
+    "shaw_edsa": (14.5812, 121.0534),
+    "guadalupe": (14.5669, 121.0457),
+    "buendia_edsa": (14.5539, 121.0343),
+    "ayala_edsa": (14.5495, 121.0277),
+    "magallanes": (14.5374, 121.0190),
+    "taft_edsa": (14.5377, 121.0010),
+    "roxas_edsa": (14.5352, 120.9830),
+    # España → Welcome Rotonda (ring nodes) → Quezon Ave
+    "lerma": (14.6038, 120.9866),
+    "espana_lacson": (14.6096, 120.9934),
+    "rotonda_n": (14.6183, 121.0048),
+    "rotonda_e": (14.6178, 121.0054),
+    "rotonda_s": (14.6173, 121.0048),
+    "rotonda_w": (14.6178, 121.0042),
+    "timog_quezon": (14.6333, 121.0255),
+    # Quezon Memorial Circle ring
+    "qmc_s": (14.6488, 121.0493),
+    "qmc_e": (14.6515, 121.0523),
+    "qmc_n": (14.6542, 121.0493),
+    "qmc_w": (14.6515, 121.0463),
+    "philcoa": (14.6549, 121.0521),
+    "tandang_sora": (14.6714, 121.0665),
+    "fairview": (14.6902, 121.0770),
+    # New Manila / Cubao east
+    "erod_araneta": (14.6208, 121.0174),
+    "erod_gilmore": (14.6192, 121.0330),
+    "gilmore_aurora": (14.6133, 121.0333),
+    "anonas": (14.6245, 121.0646),
+    "katipunan_aurora": (14.6316, 121.0744),
+    # Ortigas / Mandaluyong
+    "ortigas_meralco": (14.5880, 121.0640),
+    "ortigas_c5": (14.5860, 121.0777),
+    "shaw_kalentong": (14.5838, 121.0300),
+    "shaw_meralco": (14.5830, 121.0570),
+    # C-5 corridor
+    "c5_erod_jr": (14.6100, 121.0800),
+    "c5_kalayaan": (14.5496, 121.0553),
+    "c5_slex": (14.5130, 121.0360),
+    # Makati CBD
+    "ayala_makati": (14.5528, 121.0242),
+    "ayala_paseo": (14.5548, 121.0220),
+    "ayala_buendia": (14.5577, 121.0190),
+    "buendia_makati": (14.5552, 121.0292),
+    "buendia_paseo": (14.5562, 121.0251),
+    "buendia_chino": (14.5590, 121.0145),
+    "buendia_osmena": (14.5620, 121.0040),
+    "buendia_taft": (14.5637, 120.9950),
+    "roxas_buendia": (14.5566, 120.9889),
+    # Manila proper
+    "taft_cityhall": (14.5895, 120.9817),
+    "taft_quirino": (14.5705, 120.9893),
+    "taft_libertad": (14.5500, 120.9985),
+    "roxas_luneta": (14.5790, 120.9758),
+    "roxas_quirino": (14.5702, 120.9832),
+    "quirino_osmena": (14.5790, 121.0020),
+    # footway endpoints (non-drivable, must be excluded by the parser)
+    "promenade_a": (14.5825, 120.9760),
+    "promenade_b": (14.5805, 120.9745),
+}
+
+# ── curated way table ─────────────────────────────────────────────────
+# (name [raw XML text: may carry entity refs], [junctions...], tags)
+WAYS = [
+    ("Epifanio de los Santos Avenue",
+     ["monumento", "balintawak", "munoz", "north_edsa", "quezon_edsa",
+      "kamuning", "cubao", "santolan", "ortigas_edsa", "shaw_edsa",
+      "guadalupe", "buendia_edsa", "ayala_edsa", "magallanes",
+      "taft_edsa", "roxas_edsa"],
+     {"highway": "trunk", "ref": "C-4", "maxspeed": "60"}),
+    ("Espa&#241;a Boulevard",          # Ñ as a numeric entity reference
+     ["lerma", "espana_lacson", "rotonda_s"],
+     {"highway": "primary", "maxspeed": "40"}),
+    ("Welcome Rotonda",
+     ["rotonda_n", "rotonda_e", "rotonda_s", "rotonda_w", "rotonda_n"],
+     {"highway": "primary", "junction": "roundabout"}),
+    ("Quezon Avenue",
+     ["rotonda_n", "timog_quezon", "quezon_edsa", "qmc_s"],
+     {"highway": "primary", "maxspeed": "60"}),
+    ("Elliptical Road",
+     ["qmc_s", "qmc_e", "qmc_n", "qmc_w", "qmc_s"],
+     {"highway": "primary", "junction": "roundabout"}),
+    ("Commonwealth Avenue",
+     ["qmc_n", "philcoa", "tandang_sora", "fairview"],
+     {"highway": "primary", "maxspeed": "60"}),
+    ("North Avenue",
+     ["north_edsa", "qmc_w"],
+     {"highway": "secondary"}),
+    ("Eulogio Rodriguez Sr. Avenue",
+     ["rotonda_e", "erod_araneta", "erod_gilmore"],
+     {"highway": "secondary"}),
+    ("Gilmore Avenue",
+     ["erod_gilmore", "gilmore_aurora"],
+     {"highway": "secondary", "maxspeed": "40 km/h"}),
+    ("Aurora Boulevard",
+     ["gilmore_aurora", "cubao", "anonas", "katipunan_aurora"],
+     {"highway": "primary", "maxspeed": "50"}),
+    ("Ortigas Avenue",
+     ["ortigas_edsa", "ortigas_meralco", "ortigas_c5"],
+     {"highway": "primary", "maxspeed": "50"}),
+    ("Shaw Boulevard",
+     ["shaw_kalentong", "shaw_edsa", "shaw_meralco"],
+     {"highway": "secondary", "maxspeed": "40"}),
+    ("Circumferential Road 5",
+     ["katipunan_aurora", "c5_erod_jr", "ortigas_c5", "c5_kalayaan",
+      "c5_slex"],
+     {"highway": "trunk", "ref": "C-5", "maxspeed": "60"}),
+    ("Ayala Avenue",
+     ["ayala_edsa", "ayala_makati", "ayala_paseo", "ayala_buendia"],
+     {"highway": "primary", "maxspeed": "40"}),
+    # CBD one-way pair: one drawn WITH the signed direction, one
+    # against it (oneway=-1) — both real tagging variants. The signed
+    # directions here are approximations (see module docstring).
+    ("Paseo de Roxas",
+     ["ayala_paseo", "buendia_paseo"],
+     {"highway": "secondary", "oneway": "yes"}),
+    ("Makati Avenue",
+     ["ayala_makati", "buendia_makati"],
+     {"highway": "secondary", "oneway": "-1"}),
+    ("Senator Gil Puyat Avenue",
+     ["buendia_edsa", "buendia_makati", "buendia_paseo",
+      "ayala_buendia", "buendia_chino", "buendia_osmena",
+      "buendia_taft", "roxas_buendia"],
+     {"highway": "primary", "maxspeed": "50"}),
+    ("Taft Avenue",
+     ["taft_cityhall", "taft_quirino", "buendia_taft", "taft_libertad",
+      "taft_edsa"],
+     {"highway": "primary", "maxspeed": "40"}),
+    ("Roxas Boulevard",
+     ["roxas_luneta", "roxas_quirino", "roxas_buendia", "roxas_edsa"],
+     {"highway": "primary", "maxspeed": "60"}),
+    ("President Quirino Avenue",
+     ["roxas_quirino", "taft_quirino", "quirino_osmena"],
+     {"highway": "secondary", "maxspeed": "PH:urban"}),  # zone ref →
+    # class-default fallback in both parsers
+    ("Osme&#241;a Highway",
+     ["quirino_osmena", "buendia_osmena", "magallanes"],
+     {"highway": "trunk", "maxspeed": "60"}),
+    # non-drivable: excluded by the highway-class filter
+    ("Rizal Park Promenade",
+     ["promenade_a", "promenade_b"],
+     {"highway": "footway"}),
+]
+
+SPACING_M = 75.0  # shape-point interpolation interval
+
+
+def _haversine_m(a, b) -> float:
+    r = math.pi / 180.0
+    s = (math.sin((b[0] - a[0]) * r / 2) ** 2
+         + math.cos(a[0] * r) * math.cos(b[0] * r)
+         * math.sin((b[1] - a[1]) * r / 2) ** 2)
+    return 2 * 6371008.8 * math.asin(math.sqrt(s))
+
+
+def build_xml() -> str:
+    out = io.StringIO()
+    w = out.write
+    w('<?xml version="1.0" encoding="UTF-8"?>\n')
+    w('<osm version="0.6" generator="routest_tpu '
+      'scripts/make_manila_extract.py">\n')
+    w('  <!-- Curated Metro Manila arterial network: real roads and\n'
+      '       junction topology from public-knowledge geography\n'
+      '       (coordinates +/-300 m, shape points interpolated).\n'
+      '       NOT an OpenStreetMap database extract. -->\n')
+    w('  <bounds minlat="14.50" minlon="120.95" maxlat="14.70" '
+      'maxlon="121.10"/>\n')
+
+    node_ids = {}  # junction name → xml id
+    next_id = 1
+    for name, (lat, lon) in JUNCTIONS.items():
+        node_ids[name] = next_id
+        w(f'  <node id="{next_id}" lat="{lat:.7f}" lon="{lon:.7f}"/>\n')
+        next_id += 1
+
+    # Densified ways: interpolate shape nodes between junctions so edge
+    # granularity matches a real extract's bend-per-vertex geometry.
+    way_id = 100000
+    shape_rows = []   # deferred <node> rows for shape points
+    way_rows = []
+    for name, chain, tags in WAYS:
+        refs = [node_ids[chain[0]]]
+        for a, b in zip(chain[:-1], chain[1:]):
+            pa, pb = JUNCTIONS[a], JUNCTIONS[b]
+            n_seg = max(1, int(_haversine_m(pa, pb) / SPACING_M))
+            for k in range(1, n_seg):
+                t = k / n_seg
+                lat = pa[0] + (pb[0] - pa[0]) * t
+                lon = pa[1] + (pb[1] - pa[1]) * t
+                shape_rows.append(
+                    f'  <node id="{next_id}" lat="{lat:.7f}" '
+                    f'lon="{lon:.7f}"/>\n')
+                refs.append(next_id)
+                next_id += 1
+            refs.append(node_ids[b])
+        rows = [f'  <way id="{way_id}">\n']
+        rows += [f'    <nd ref="{r}"/>\n' for r in refs]
+        rows.append(f'    <tag k="name" v="{name}"/>\n')
+        for k, v in tags.items():
+            rows.append(f'    <tag k="{k}" v="{v}"/>\n')
+        rows.append('  </way>\n')
+        way_rows.append("".join(rows))
+        way_id += 1
+
+    for row in shape_rows:
+        w(row)
+    for row in way_rows:
+        w(row)
+
+    # Boundary-clipped way: EDSA continues north out of the extract —
+    # the second <nd> has no <node>, so parsers must drop the edge.
+    w(f'  <way id="{way_id}">\n'
+      f'    <nd ref="{node_ids["monumento"]}"/>\n'
+      f'    <nd ref="990001"/>\n'
+      f'    <tag k="name" v="Epifanio de los Santos Avenue"/>\n'
+      f'    <tag k="highway" v="trunk"/>\n'
+      f'  </way>\n')
+
+    # Route relation (the EDSA Carousel busway): parsers ignore it.
+    w('  <relation id="500000">\n'
+      '    <member type="way" ref="100000" role=""/>\n'
+      '    <tag k="type" v="route"/>\n'
+      '    <tag k="route" v="bus"/>\n'
+      '    <tag k="name" v="EDSA Carousel"/>\n'
+      '  </relation>\n')
+    w('</osm>\n')
+    return out.getvalue()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    default_out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "manila_arterials.osm.gz")
+    ap.add_argument("--out", default=default_out)
+    args = ap.parse_args()
+
+    xml = build_xml()
+    # mtime=0 + no embedded filename → deterministic gzip bytes
+    # (re-runs reproduce the committed artifact wherever they write)
+    with open(args.out, "wb") as raw:
+        with gzip.GzipFile(filename="", fileobj=raw, mode="wb",
+                           mtime=0) as gz:
+            gz.write(xml.encode("utf-8"))
+    n_nodes = xml.count("<node ")
+    n_ways = xml.count("<way ")
+    print(f"wrote {args.out}: {n_nodes} nodes, {n_ways} ways, "
+          f"{os.path.getsize(args.out)} bytes gz")
+
+
+if __name__ == "__main__":
+    main()

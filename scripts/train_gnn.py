@@ -49,6 +49,11 @@ def main() -> None:
                              "artifacts/road_gnn.msgpack — the same "
                              "resolution the serving router uses)")
     parser.add_argument("--no-save", action="store_true")
+    parser.add_argument("--report-out", default=None, metavar="PATH",
+                        help="report artifact path (default: artifacts/"
+                             "gnn_report_osm.json for --osm runs, else "
+                             "gnn_report.json). Name it for one-off "
+                             "extracts so the canonical reports survive")
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--cpu", action="store_true",
                         help="hermetic 8-virtual-device CPU mesh (use when "
@@ -181,8 +186,9 @@ def main() -> None:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # --osm runs report separately: gnn_report.json is the config-4
     # (full synthetic network) benchmark artifact the driver reads.
-    out = os.path.join(repo, "artifacts",
-                       "gnn_report_osm.json" if args.osm else "gnn_report.json")
+    out = args.report_out or os.path.join(
+        repo, "artifacts",
+        "gnn_report_osm.json" if args.osm else "gnn_report.json")
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(report, f, indent=2)

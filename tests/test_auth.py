@@ -245,3 +245,63 @@ def test_login_throttling_over_http(client):
         assert r.status_code == 422
     msg = r.get_json()["message"]
     assert "too many login attempts" in msg and "seconds" in msg
+
+
+def test_mailer_carries_reset_token_out_of_band(model_artifact):
+    """With a mail transport configured (serve/mail.py), the reset
+    token travels by mail ONLY — reference PasswordResetLinkController
+    behavior — and still resets the password."""
+    from routest_tpu.serve.mail import MemoryMailer
+
+    mailer = MemoryMailer()
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    c = Client(create_app(Config(), eta_service=eta, mailer=mailer))
+    _register(c, email="mail@example.com")
+    r = c.post("/api/auth/forgot-password",
+               json={"email": "mail@example.com"})
+    assert r.status_code == 200
+    assert "reset_token" not in r.get_json()       # no in-band secret
+    assert len(mailer.messages) == 1
+    msg = mailer.messages[0]
+    assert msg["to"] == "mail@example.com"
+    token = msg["body"].rsplit(" ", 1)[-1]
+    r = c.post("/api/auth/reset-password", json={
+        "token": token, "email": "mail@example.com",
+        "password": "brand-new-pass"})
+    assert r.status_code == 200
+    r = c.post("/api/auth/login", json={
+        "email": "mail@example.com", "password": "brand-new-pass"})
+    assert r.status_code == 200
+
+
+def test_mailer_carries_verification_link(model_artifact):
+    from routest_tpu.serve.mail import MemoryMailer
+
+    mailer = MemoryMailer()
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    c = Client(create_app(Config(), eta_service=eta, mailer=mailer))
+    token = _register(c, email="v@example.com").get_json()["token"]
+    hdr = {"Authorization": f"Bearer {token}"}
+    r = c.post("/api/auth/email/verification-notification", headers=hdr)
+    assert r.status_code == 200
+    assert "verify_url" not in r.get_json()        # mail-only delivery
+    assert mailer.messages and mailer.messages[-1]["to"] == "v@example.com"
+    url = mailer.messages[-1]["body"].rsplit(" ", 1)[-1]
+    r = c.get(url, headers=hdr)
+    assert r.status_code == 200 and r.get_json()["verified"] is True
+
+
+def test_file_mailer_appends_parseable_lines(tmp_path):
+    import json
+
+    from routest_tpu.serve.mail import FileMailer, make_mailer
+
+    mbox = str(tmp_path / "mbox.jsonl")
+    FileMailer(mbox).send("a@x.com", "Subject", "Body text")
+    FileMailer(mbox).send("b@x.com", "S2", "B2")
+    rows = [json.loads(line) for line in open(mbox)]
+    assert [r["to"] for r in rows] == ["a@x.com", "b@x.com"]
+    assert rows[0]["subject"] == "Subject"
+    # env wiring: ROUTEST_MAIL_FILE configures; unset disables
+    assert make_mailer({"ROUTEST_MAIL_FILE": mbox}).path == mbox
+    assert make_mailer({}) is None

@@ -1,0 +1,202 @@
+"""The committed Metro Manila arterial extract routes like the city.
+
+``artifacts/manila_arterials.osm.gz`` (written by
+``scripts/make_manila_extract.py``, VERDICT r4 next #6) encodes the
+real arterial network — EDSA, the radial avenues, the two roundabout
+circles, a Makati one-way pair — with real-world OSM tagging. These
+tests pin:
+
+- deterministic regeneration (the script reproduces the committed bytes);
+- parser parity (native scanner vs ElementTree) on a real-shaped file
+  that carries bounds/relations/comments/entity-ref names;
+- the tagging semantics: roundabout rings one-way, ``oneway=-1``
+  against drawing order, zone-ref maxspeed falling back to the class
+  default, footways excluded, boundary-clipped refs dropped;
+- city-scale routing: Monumento → Magallanes rides EDSA at about the
+  real corridor length, and the one-way pair forces asymmetric detours.
+
+The reference gets all of this from ORS SaaS over real OSM data
+(``Flaskr/utils.py:97-103``); here the network is on-device arrays.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from routest_tpu.data.osm import load_osm
+from routest_tpu.data.road_graph import _CLASS_SPEED_MPS
+from routest_tpu.optimize.road_router import RoadRouter
+
+EXTRACT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "artifacts", "manila_arterials.osm.gz")
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return load_osm(EXTRACT)
+
+
+@pytest.fixture(scope="module")
+def router(graph):
+    return RoadRouter(graph=graph, use_gnn=False)
+
+
+def _node(graph, lat, lon):
+    d = (np.abs(graph["node_coords"][:, 0] - lat)
+         + np.abs(graph["node_coords"][:, 1] - lon))
+    i = int(np.argmin(d))
+    assert d[i] < 1e-5, f"no node at ({lat}, {lon})"
+    return i
+
+
+# curated junction coordinates used below (must match the generator)
+MONUMENTO = (14.6565, 120.9840)
+MAGALLANES = (14.5374, 121.0190)
+FAIRVIEW = (14.6902, 121.0770)
+ROXAS_EDSA = (14.5352, 120.9830)
+AYALA_PASEO = (14.5548, 121.0220)
+BUENDIA_PASEO = (14.5562, 121.0251)
+AYALA_MAKATI = (14.5528, 121.0242)
+BUENDIA_MAKATI = (14.5552, 121.0292)
+PROMENADE = (14.5825, 120.9760)
+
+
+def test_regeneration_is_deterministic(tmp_path):
+    import subprocess
+    import sys
+
+    out = str(tmp_path / "regen.osm.gz")
+    subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(EXTRACT), os.pardir, "scripts",
+                      "make_manila_extract.py"), "--out", out],
+        check=True, capture_output=True)
+    with open(out, "rb") as a, open(EXTRACT, "rb") as b:
+        assert a.read() == b.read(), \
+            "script no longer reproduces the committed extract"
+
+
+def test_scale_and_shape(graph):
+    # ~1.2k nodes / ~2.5k directed edges of arterial network, ~95 km of
+    # carriageway — city-scale, not a toy fixture
+    assert 1000 < len(graph["node_coords"]) < 2000
+    assert 2000 < len(graph["senders"]) < 4000
+    total_km = float(graph["length_m"].sum()) / 1000 / 2
+    assert 80 < total_km < 120
+    # every surviving node is inside the extract bounds (the clipped
+    # ref 990001 created no node)
+    lat = graph["node_coords"][:, 0]
+    lon = graph["node_coords"][:, 1]
+    assert lat.min() > 14.50 and lat.max() < 14.70
+    assert lon.min() > 120.95 and lon.max() < 121.10
+
+
+def test_native_and_elementtree_agree(monkeypatch):
+    from routest_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    fast = load_osm(EXTRACT)
+    monkeypatch.setattr(native, "available", lambda: False)
+    slow = load_osm(EXTRACT)
+    for key in slow:
+        np.testing.assert_array_equal(fast[key], slow[key], err_msg=key)
+
+
+def test_footway_and_boundary_exclusions(graph):
+    # the Rizal Park Promenade footway contributes no node
+    d = (np.abs(graph["node_coords"][:, 0] - PROMENADE[0])
+         + np.abs(graph["node_coords"][:, 1] - PROMENADE[1]))
+    assert d.min() > 1e-4
+
+
+def test_roundabout_rings_are_oneway(graph):
+    # Quezon Memorial Circle: qmc_s → qmc_e edge exists, reverse does not
+    s = _node(graph, 14.6488, 121.0493)   # qmc_s
+    e = _node(graph, 14.6515, 121.0523)   # qmc_e
+    pairs = set(zip(graph["senders"].tolist(),
+                    graph["receivers"].tolist()))
+    # qmc_s also terminates the (two-way) Quezon Ave, so test the RING
+    # arcs themselves: the densified shape node qmc_s hands off to on
+    # the way toward qmc_e must be reachable one-way only, and the
+    # shape node that feeds qmc_s must be upstream-only.
+    out_s = {b for a, b in pairs if a == s}
+    in_s = {a for a, b in pairs if b == s}
+    ring_next = out_s - in_s   # downstream-only neighbors = ring arc
+    ring_prev = in_s - out_s   # upstream-only neighbors = ring arc
+    assert ring_next and ring_prev, "ring arcs missing at qmc_s"
+    for nb in ring_next:
+        assert (nb, s) not in pairs, "roundabout arc is two-way"
+    for nb in ring_prev:
+        assert (s, nb) not in pairs, "roundabout arc is two-way"
+    assert e != s  # sanity: the two ring anchors are distinct nodes
+
+
+def test_zone_maxspeed_falls_back_to_class_default(graph):
+    # President Quirino Avenue carries maxspeed="PH:urban" (a zone ref
+    # both parsers must reject) → secondary-class default speed
+    a = _node(graph, 14.5702, 120.9832)  # roxas_quirino
+    out_edges = np.where(graph["senders"] == a)[0]
+    assert len(out_edges) > 0
+    quirino = [e for e in out_edges
+               if graph["road_class"][e] == 1]
+    assert quirino, "Quirino edges missing"
+    for e in quirino:
+        assert graph["speed_limit"][e] == np.float32(_CLASS_SPEED_MPS[1])
+
+
+def test_oneway_pair_asymmetry(router, graph):
+    # Paseo de Roxas is one-way toward Buendia; the return path must
+    # detour (via Makati Ave / Gil Puyat / Ayala), so durations are
+    # asymmetric between its endpoints.
+    a = _node(graph, *AYALA_PASEO)
+    b = _node(graph, *BUENDIA_PASEO)
+    dist, _ = router.shortest(np.asarray([a, b]))
+    fwd = float(dist[0, b])
+    back = float(dist[1, a])
+    assert np.isfinite(fwd) and np.isfinite(back)
+    assert back > fwd * 1.5, (fwd, back)
+    # Makati Avenue is drawn Ayala→Buendia but signed -1: traversal is
+    # Buendia→Ayala only
+    am = _node(graph, *AYALA_MAKATI)
+    bm = _node(graph, *BUENDIA_MAKATI)
+    dist2, _ = router.shortest(np.asarray([bm, am]))
+    assert float(dist2[0, am]) < float(dist2[1, bm]), \
+        "oneway=-1 direction not honored"
+
+
+def test_monumento_to_magallanes_rides_edsa(router, graph):
+    # The EDSA corridor end to end: curated junction chords sum to a
+    # bit under the real 23.8 km carriageway; the shortest path must be
+    # the corridor (within chord slack), not a cross-town zigzag.
+    a = _node(graph, *MONUMENTO)
+    b = _node(graph, *MAGALLANES)
+    dist, _ = router.shortest(np.asarray([a]))
+    d_km = float(dist[0, b]) / 1000
+    assert 18.0 < d_km < 26.0, d_km
+
+
+def test_city_is_strongly_connected_enough(router, graph):
+    # Far corners reach each other despite one-ways and roundabouts:
+    # Fairview (NE) ↔ Roxas/EDSA (SW bay side)
+    a = _node(graph, *FAIRVIEW)
+    b = _node(graph, *ROXAS_EDSA)
+    dist, _ = router.shortest(np.asarray([a, b]))
+    there = float(dist[0, b]) / 1000
+    back = float(dist[1, a]) / 1000
+    assert 20.0 < there < 45.0
+    assert 20.0 < back < 45.0
+
+
+def test_route_legs_follow_streets(router, graph):
+    # OD routing between landmark coordinates snaps to the arterial
+    # network and the polyline follows graph nodes (street-following)
+    pts = np.asarray([[14.6565, 120.9840],   # Monumento
+                      [14.6197, 121.0525]],  # Cubao
+                     np.float32)
+    legs = router.route_legs(pts)
+    d, dur, poly = legs.leg(0, 1)
+    assert np.isfinite(d) and d > 8_000     # EDSA Monumento→Cubao ≈ 10 km
+    assert dur > 0 and len(poly) > 50       # densified geometry

@@ -265,6 +265,43 @@ def test_native_parser_handles_oneway_and_maxspeed_variants(tmp_path,
     assert fast["speed_limit"][2] == np.float32(5.6)
 
 
+def test_roundabout_implies_oneway_both_parsers(tmp_path, monkeypatch):
+    """junction=roundabout/circular is one-way in drawing order unless
+    an explicit oneway tag overrides it (OSM semantics; exercised for
+    real by the Quezon Memorial Circle / Welcome Rotonda rings in
+    artifacts/manila_arterials.osm.gz)."""
+    from routest_tpu import native
+
+    xml = """<?xml version="1.0"?>
+<osm>
+  <node id="1" lat="14.60" lon="121.00"/>
+  <node id="2" lat="14.601" lon="121.001"/>
+  <node id="3" lat="14.602" lon="121.000"/>
+  <way id="10"><nd ref="1"/><nd ref="2"/><nd ref="3"/><nd ref="1"/>
+    <tag k="highway" v="primary"/><tag k="junction" v="roundabout"/></way>
+  <way id="11"><nd ref="1"/><nd ref="3"/>
+    <tag k="highway" v="secondary"/><tag k="junction" v="Roundabout"/>
+    <tag k="oneway" v="no"/></way>
+  <way id="12"><nd ref="2"/><nd ref="3"/>
+    <tag k="highway" v="tertiary"/><tag k="junction" v="circular"/></way>
+</osm>"""
+    path = tmp_path / "roundabout.osm"
+    path.write_text(xml)
+    monkeypatch.setattr(native, "available", lambda: False)
+    slow = load_osm(str(path))
+    monkeypatch.undo()
+    # ring: 3 directed edges, no reverses; explicit oneway=no wins over
+    # (case-insensitive) junction; circular behaves like roundabout
+    pairs = sorted(zip(slow["senders"].tolist(),
+                       slow["receivers"].tolist()))
+    assert pairs == [(0, 1), (0, 2), (1, 2), (1, 2), (2, 0), (2, 0)]
+    if native.available():
+        fast = load_osm(str(path))
+        for key in slow:
+            np.testing.assert_array_equal(fast[key], slow[key],
+                                          err_msg=key)
+
+
 def test_native_parity_on_review_divergence_cases(tmp_path, monkeypatch):
     # Cases found diverging in review, now locked to parity: truncated
     # document, whitespace-padded oneway, last-maxspeed-wins, hex/inf

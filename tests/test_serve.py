@@ -72,6 +72,39 @@ def test_health_shape_and_always_200(client):
     assert {"engine", "redis", "supabase", "model", "tpu"} <= set(body["checks"])
     assert body["status"] in ("ok", "degraded")
     assert body["checks"]["tpu"]["devices"]
+    # no tile server configured → the SVG basemap needs none; the
+    # honest label is "static", not a hardcoded true (the reference
+    # probes OSM/Carto for real — app/api/health/route.js:36-49)
+    assert body["tiles"] == "static"
+
+
+def test_health_probes_configured_tile_url(client, monkeypatch):
+    import http.server
+    import threading
+
+    class Tile(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.end_headers()
+            self.wfile.write(b"\x89PNG")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Tile)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}/0/0/0.png"
+        monkeypatch.setenv("ROUTEST_TILE_URL", url)
+        assert client.get("/api/health").get_json()["tiles"] is True
+        # dead endpoint → False (fresh state: the 30 s cache is per-app)
+        monkeypatch.setenv("ROUTEST_TILE_URL",
+                           "http://127.0.0.1:9/0/0/0.png")
+        client.application.state._tiles_cache = (0.0, None)
+        assert client.get("/api/health").get_json()["tiles"] is False
+    finally:
+        srv.shutdown()
 
 
 def test_locations_laravel_shape(client):
