@@ -527,18 +527,23 @@ def create_app(config: Optional[Config] = None,
     for _name in ("dashboard", "mvp", "health"):
         with open(os.path.join(_static_dir, _name + ".html"), "rb") as f:
             _pages[_name] = f.read()  # immutable assets: read once, serve cached
-    with open(os.path.join(_static_dir, "lib",
-                           "dashboard_logic.js"), "rb") as f:
-        _dashboard_logic_js = f.read()
+    # Front-end logic modules as real shipped files so CI can execute
+    # the exact served bytes (tests/test_dashboard_logic.py via
+    # utils/minijs.py) — the reference splits these between page
+    # components (app/ui/page.jsx) and lib/ (lib/classify.js).
+    _lib_dir = os.path.join(_static_dir, "lib")
+    _lib_files = {}
+    for _name in sorted(os.listdir(_lib_dir)):
+        if _name.endswith(".js"):
+            with open(os.path.join(_lib_dir, _name), "rb") as f:
+                _lib_files[_name] = f.read()
 
-    @app.route("/lib/dashboard_logic.js", methods=("GET",))
-    def dashboard_logic_js(request):
-        # The dashboard's pure logic as a real module file so CI can
-        # execute the exact shipped bytes (tests/test_dashboard_logic.py
-        # via utils/minijs.py) — the reference keeps equivalent logic
-        # inside page components (frontend/map-app/app/ui/page.jsx).
-        return Response(_dashboard_logic_js,
-                        mimetype="text/javascript")
+    @app.route("/lib/<name>", methods=("GET",))
+    def lib_js(request, name):
+        body = _lib_files.get(name)
+        if body is None:
+            return {"error": "not found"}, 404
+        return Response(body, mimetype="text/javascript")
 
     @app.route("/", methods=("GET",))
     def mvp_page(request):

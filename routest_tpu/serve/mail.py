@@ -70,7 +70,12 @@ class FileMailer:
         line = json.dumps({"to": to, "subject": subject, "body": body,
                            "at": time.time()}) + "\n"
         try:
-            with self._lock, open(self.path, "a", encoding="utf-8") as f:
+            # 0600 create: the mailbox carries password-reset tokens —
+            # under ROUTEST_AUTH=require its whole point is that only
+            # the operator reads them, so no group/world bits.
+            fd = os.open(self.path,
+                         os.O_CREAT | os.O_APPEND | os.O_WRONLY, 0o600)
+            with self._lock, os.fdopen(fd, "a", encoding="utf-8") as f:
                 f.write(line)
         except OSError:
             # fire-and-forget: a full disk must not 500 a password reset

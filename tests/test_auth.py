@@ -302,6 +302,11 @@ def test_file_mailer_appends_parseable_lines(tmp_path):
     rows = [json.loads(line) for line in open(mbox)]
     assert [r["to"] for r in rows] == ["a@x.com", "b@x.com"]
     assert rows[0]["subject"] == "Subject"
+    # the mailbox carries reset tokens: owner-only permissions
+    import os as _os
+    import stat
+
+    assert stat.S_IMODE(_os.stat(mbox).st_mode) == 0o600
     # env wiring: ROUTEST_MAIL_FILE configures; unset disables
     assert make_mailer({"ROUTEST_MAIL_FILE": mbox}).path == mbox
     assert make_mailer({}) is None

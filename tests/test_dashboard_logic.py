@@ -442,6 +442,30 @@ def test_auth_next_step(js):
     assert js.call("authNextStep", 401) == "error"
 
 
+def test_classify_lib_executes_over_seeded_locations(client, locations):
+    """The MVP map's classify.js (reference lib/classify.js) is a real
+    shipped module too — execute the served bytes over the 21-location
+    seed table."""
+    r = client.get("/lib/classify.js")
+    assert r.status_code == 200
+    from routest_tpu.utils.minijs import run_source
+
+    it = run_source(r.get_data(as_text=True))
+    got = {row["name"]: it.call("classify", row["name"])
+           for row in locations}
+    assert set(got.values()) == {"warehouse", "mall"}
+    for name, kind in got.items():
+        want = ("warehouse" if re.search(
+            r"warehouse|distribution|depot|hub", name, re.I) else "mall")
+        assert kind == want, (name, kind)
+    # mvp.html loads it and no longer redefines it inline
+    with open(os.path.join(_STATIC, "mvp.html"), encoding="utf-8") as f:
+        page = f.read()
+    assert '<script src="/lib/classify.js"></script>' in page
+    assert "function classify(" not in page
+    assert client.get("/lib/nope.js").status_code == 404
+
+
 def test_inline_page_script_stays_in_engine_subset(js):
     """Every function the inline page script CALLS from the logic module
     must exist there — catches a rename in one file but not the other."""
