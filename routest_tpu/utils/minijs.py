@@ -33,12 +33,30 @@ in (and ``tests/test_minijs.py`` pins the semantics):
   the common string/array methods, and regex ``test/exec`` +
   ``String.replace/match/split`` with the ``g`` flag.
 
-Not implemented (the logic modules don't use them): ``this``/classes/
-prototypes, ``async/await`` (the modules keep fetch/DOM on the page
-side), generators, labels, ``switch``, getters/setters, ``Symbol``,
-sparse arrays. Unknown syntax raises ``JSSyntaxError`` at parse time,
-so an accidental use of an unsupported feature fails CI loudly instead
-of silently skipping the file.
+Also supported, for executing the PAGE GLUE (not just the pure-logic
+modules) under a host DOM (``utils/jsdom.py``):
+
+- ``async function`` / ``async () =>``: the body runs eagerly and
+  synchronously; the call returns a settled :class:`JSPromise`
+  (fulfilled with the return value, rejected if the body threw);
+- ``await expr``: unwraps a settled JSPromise (rethrows a rejection);
+  non-promise values pass through; awaiting a PENDING promise raises —
+  the host must settle promises before handing them over (there is no
+  event loop, by design: CI wants deterministic, synchronous runs);
+- ``new Ctor(args)``: invokes the callee like a call — host
+  constructors (Date, Blob, EventSource, Option, ...) are plain
+  factories injected as globals; ``new Promise(executor)`` runs the
+  executor immediately with capturing resolve/reject;
+- host objects: any Python object exposing ``js_get_member(name)`` /
+  ``js_set_member(name, value)`` participates in member access and
+  method calls — the seam jsdom's elements/fetch/localStorage use.
+
+Not implemented (the modules don't use them): ``this``/classes/
+prototypes, generators, labels, ``switch``, getters/setters,
+``Symbol``, sparse arrays, a microtask queue. Unknown syntax raises
+``JSSyntaxError`` at parse time, so an accidental use of an
+unsupported feature fails CI loudly instead of silently skipping the
+file.
 
 JS-semantics corners handled on purpose (each pinned by a test):
 - truthiness (``0 "" null undefined NaN`` falsy; ``[] {}`` truthy);
@@ -372,6 +390,10 @@ class _Parser:
                 return d
             if t.value == "function":
                 return self.function_decl()
+            if t.value == "async" and self.peek(1).kind == "kw" \
+                    and self.peek(1).value == "function":
+                self.next()
+                return self.function_decl(is_async=True)
             if t.value == "if":
                 return self.if_stmt()
             if t.value == "for":
@@ -475,12 +497,12 @@ class _Parser:
             raise JSSyntaxError(f"line {t.line}: bad binding {t.value!r}")
         return ("ident_pat", t.value)
 
-    def function_decl(self):
+    def function_decl(self, is_async: bool = False):
         self.eat("kw", "function")
         name = self.eat("ident").value
         params = self.param_list()
         body = self.block()
-        return ("funcdecl", name, params, body)
+        return ("funcdecl", name, params, body, is_async)
 
     def param_list(self):
         self.eat("punct", "(")
@@ -573,6 +595,14 @@ class _Parser:
         return e
 
     def assignment(self):
+        if self.at("kw", "async"):
+            # `async x => ...` / `async (a, b) => ...`; anything else
+            # (async function, stray token) restores and falls through
+            save = self.i
+            self.next()
+            if self.is_arrow_ahead():
+                return self.arrow_function(is_async=True)
+            self.i = save
         if self.is_arrow_ahead():
             return self.arrow_function()
         left = self.ternary()
@@ -607,7 +637,7 @@ class _Parser:
                 j += 1
         return False
 
-    def arrow_function(self):
+    def arrow_function(self, is_async: bool = False):
         if self.peek().kind == "ident":
             params = [("param", ("ident_pat", self.next().value), None)]
         else:
@@ -615,8 +645,9 @@ class _Parser:
         self.eat("punct", "=>")
         if self.at("punct", "{"):
             body = self.block()
-            return ("func", None, params, body)
-        return ("func", None, params, ("return", self.assignment()))
+            return ("func", None, params, body, is_async)
+        return ("func", None, params, ("return", self.assignment()),
+                is_async)
 
     def ternary(self):
         cond = self.binary(0)
@@ -650,6 +681,9 @@ class _Parser:
         if t.kind == "kw" and t.value in ("typeof", "void", "delete"):
             self.next()
             return ("unary", t.value, self.unary())
+        if t.kind == "kw" and t.value == "await":
+            self.next()
+            return ("await", self.unary())
         if t.kind == "punct" and t.value in ("++", "--"):
             self.next()
             return ("update", t.value, self.unary(), True)
@@ -732,11 +766,21 @@ class _Parser:
                 if self.peek().kind == "ident":
                     name = self.next().value
                 params = self.param_list()
-                return ("func", name, params, self.block())
+                return ("func", name, params, self.block(), False)
+            if t.value == "async" and self.at("kw", "function"):
+                self.next()
+                name = None
+                if self.peek().kind == "ident":
+                    name = self.next().value
+                params = self.param_list()
+                return ("func", name, params, self.block(), True)
             if t.value == "new":
-                raise JSSyntaxError(
-                    f"line {t.line}: 'new' is not supported in logic "
-                    "modules (keep constructors on the page side)")
+                # `new Ctor(args)` / `new Ctor` — host constructors are
+                # plain factories, so construction == invocation
+                target = self.postfix()
+                if target[0] == "call":
+                    return ("new", target[1], target[2])
+                return ("new", target, [])
             raise JSSyntaxError(
                 f"line {t.line}: unexpected keyword {t.value!r}")
         if t.kind == "ident":
@@ -782,7 +826,8 @@ class _Parser:
                         params = self.param_list()
                         body = self.block()
                         props.append(("kv", key,
-                                      ("func", k.value, params, body)))
+                                      ("func", k.value, params, body,
+                                       False)))
                     else:  # shorthand {a}
                         props.append(("kv", key, ("name", k.value)))
                 if not self.at("punct", "}"):
@@ -801,20 +846,89 @@ def _js_num_to_key(v: float) -> str:
 # ---------------------------------------------------------------------------
 
 class JSFunction:
-    __slots__ = ("name", "params", "body", "env", "interp")
+    __slots__ = ("name", "params", "body", "env", "interp", "is_async")
 
-    def __init__(self, name, params, body, env, interp):
+    def __init__(self, name, params, body, env, interp,
+                 is_async: bool = False):
         self.name = name or "<anonymous>"
         self.params = params
         self.body = body
         self.env = env
         self.interp = interp
+        self.is_async = is_async
 
     def __call__(self, *args):
         return self.interp.call_function(self, list(args))
 
     def __repr__(self):
         return f"<JSFunction {self.name}>"
+
+
+class JSPromise:
+    """A settled-or-pending promise value — NO event loop.
+
+    Async functions run eagerly and return one of these already
+    settled; ``new Promise(executor)`` runs the executor immediately
+    and is pending until the captured resolve/reject fires (the host
+    drives that, e.g. a dialog's button handler). Reactions attached
+    with ``then/catch/finally`` run synchronously when settled — and a
+    reaction attached while PENDING is queued and runs the moment the
+    host settles the promise.
+
+    ``handled`` supports the unhandled-rejection check: awaiting or
+    attaching any reaction marks a promise handled; a rejected promise
+    nobody ever observed is surfaced loudly by ``Interpreter.run``."""
+
+    __slots__ = ("state", "value", "error", "handled", "_callbacks")
+
+    def __init__(self):
+        self.state = "pending"
+        self.value = UNDEFINED
+        self.error = UNDEFINED
+        self.handled = False
+        self._callbacks: List[Callable[["JSPromise"], None]] = []
+
+    @classmethod
+    def fulfilled(cls, value):
+        p = cls()
+        p.state = "fulfilled"
+        p.value = value
+        return p
+
+    @classmethod
+    def rejected(cls, error):
+        p = cls()
+        p.state = "rejected"
+        p.error = error
+        return p
+
+    def resolve(self, value=UNDEFINED):
+        if self.state == "pending":
+            self.state = "fulfilled"
+            self.value = value
+            self._flush()
+
+    def reject(self, error=UNDEFINED):
+        if self.state == "pending":
+            self.state = "rejected"
+            self.error = error
+            self._flush()
+
+    def subscribe(self, cb: Callable[["JSPromise"], None]):
+        """Run ``cb(self)`` now if settled, else when settled."""
+        self.handled = True
+        if self.state == "pending":
+            self._callbacks.append(cb)
+        else:
+            cb(self)
+
+    def _flush(self):
+        cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    def __repr__(self):
+        return f"<JSPromise {self.state}>"
 
 
 class JSRegex:
@@ -1108,12 +1222,32 @@ class Interpreter:
 
     def __init__(self, rng: Optional[Callable[[], float]] = None):
         self.globals = _Env()
+        self._promises: List[JSPromise] = []
         self._install_builtins(rng or (lambda: 0.5))
+
+    def _track(self, p: JSPromise) -> JSPromise:
+        self._promises.append(p)
+        return p
+
+    def check_unhandled_rejections(self):
+        """Raise if any tracked promise was rejected and never observed
+        (no await, no then/catch/finally) — an async code path failed
+        silently otherwise, eroding the fail-loudly guarantee. Hosts
+        driving handlers across run() boundaries should call this after
+        each interaction."""
+        bad = [p for p in self._promises
+               if p.state == "rejected" and not p.handled]
+        self._promises = [p for p in self._promises
+                          if p.state == "pending"]
+        if bad:
+            raise JSError("unhandled promise rejection: "
+                          + _js_display(bad[0].error))
 
     # -- public API ------------------------------------------------------
     def run(self, source: str):
         ast = _Parser(_tokenize(source)).parse_program()
         self.exec_block(ast, self.globals)
+        self.check_unhandled_rejections()
 
     def call(self, name: str, *args) -> Any:
         fn = self.globals.lookup(name)
@@ -1235,6 +1369,18 @@ class Interpreter:
             "error": lambda *a: None,
         })
 
+        def _promise(executor=UNDEFINED):
+            # `new Promise(executor)`: run the executor NOW; resolve/
+            # reject capture into the (possibly still pending) promise
+            p = self._track(JSPromise())
+            if executor is not UNDEFINED and executor is not None:
+                self.invoke(executor,
+                            [lambda v=UNDEFINED: p.resolve(v),
+                             lambda e=UNDEFINED: p.reject(e)])
+            return p
+
+        g.declare("Promise", _promise)
+
     # -- statement execution ---------------------------------------------
     def exec_block(self, node, env: _Env):
         assert node[0] == "block"
@@ -1244,7 +1390,7 @@ class Interpreter:
             if stmt[0] == "funcdecl":
                 env.declare(stmt[1],
                             JSFunction(stmt[1], stmt[2], stmt[3], env,
-                                       self))
+                                       self, is_async=stmt[4]))
         for stmt in node[1]:
             self.exec_stmt(stmt, env)
 
@@ -1259,7 +1405,8 @@ class Interpreter:
                 self.bind_pattern(target, value, env, declare=True)
         elif kind == "funcdecl":
             env.declare(node[1], JSFunction(node[1], node[2], node[3],
-                                            env, self))
+                                            env, self,
+                                            is_async=node[4]))
         elif kind == "block":
             self.exec_block(node, _Env(env))
         elif kind == "if":
@@ -1407,7 +1554,35 @@ class Interpreter:
             raise JSError(f"unknown pattern {kind}")
 
     # -- function calls --------------------------------------------------
+    def await_value(self, v):
+        """``await``: unwrap a settled promise; rethrow rejections."""
+        if isinstance(v, JSPromise):
+            v.handled = True
+            if v.state == "pending":
+                raise JSError(
+                    "await on a PENDING promise — no event loop here; "
+                    "the host must settle it first (see module "
+                    "docstring)")
+            if v.state == "rejected":
+                raise _Thrown(v.error)
+            return v.value
+        return v
+
     def call_function(self, fn: JSFunction, args: List[Any]):
+        if fn.is_async:
+            try:
+                out = self._call_sync(fn, args)
+                if isinstance(out, JSPromise):  # returned a promise:
+                    return out                  # adopt, don't re-wrap
+                return JSPromise.fulfilled(out)
+            except _Thrown as e:
+                return self._track(JSPromise.rejected(e.value))
+            except JSError as e:
+                return self._track(JSPromise.rejected(
+                    {"name": "Error", "message": str(e)}))
+        return self._call_sync(fn, args)
+
+    def _call_sync(self, fn: JSFunction, args: List[Any]):
         env = _Env(fn.env)
         i = 0
         for p in fn.params:
@@ -1478,7 +1653,19 @@ class Interpreter:
                     out[_js_str(key)] = self.eval(val_node, env)
             return out
         if kind == "func":
-            return JSFunction(node[1], node[2], node[3], env, self)
+            return JSFunction(node[1], node[2], node[3], env, self,
+                              is_async=node[4])
+        if kind == "await":
+            return self.await_value(self.eval(node[1], env))
+        if kind == "new":
+            args = []
+            for k, e in node[2]:
+                v = self.eval(e, env)
+                if k == "spread":
+                    args.extend(v if isinstance(v, list) else [v])
+                else:
+                    args.append(v)
+            return self.invoke(self.eval(node[1], env), args)
         if kind == "cond":
             return self.eval(node[2] if _truthy(self.eval(node[1], env))
                              else node[3], env)
@@ -1573,7 +1760,9 @@ class Interpreter:
         elif target[0] == "member":
             obj = self.eval(target[1], env)
             key = self.eval(target[2], env)
-            if isinstance(obj, dict):
+            if hasattr(obj, "js_set_member"):   # host objects
+                obj.js_set_member(_js_str(key), value)
+            elif isinstance(obj, dict):
                 obj[_js_str(key)] = value
             elif isinstance(obj, list):
                 idx = _to_int(key)
@@ -1648,6 +1837,10 @@ class Interpreter:
             raise JSError(
                 f"TypeError: cannot read property {name!r} of "
                 f"{_js_str(obj)}")
+        if isinstance(obj, JSPromise):
+            return self._promise_member(obj, name)
+        if hasattr(obj, "js_get_member"):  # host objects (jsdom etc.)
+            return obj.js_get_member(name)
         if isinstance(obj, dict):
             if name in obj:
                 return obj[name]
@@ -1673,8 +1866,8 @@ class Interpreter:
         if isinstance(obj, JSRegex):
             return _regex_method(obj, name)
         if isinstance(obj, JSFunction) or callable(obj):
-            if name == "name":
-                return getattr(obj, "name", "")
+            if name == "name" and isinstance(obj, JSFunction):
+                return obj.name
             if name == "call":
                 return lambda _this=UNDEFINED, *a: self.invoke(obj,
                                                                list(a))
@@ -1684,6 +1877,64 @@ class Interpreter:
             return UNDEFINED
         raise JSError(f"TypeError: cannot read {name!r} of "
                       f"{type(obj).__name__}")
+
+    def _promise_member(self, p: JSPromise, name: str):
+        """then/catch/finally: reactions run synchronously once the
+        promise is settled (queued if attached while pending), with
+        SYMMETRIC semantics for both branches — handler results are
+        flattened through await_value and handler throws become
+        downstream rejections."""
+
+        def settle_with(handler, arg, d: JSPromise):
+            try:
+                d.resolve(self.await_value(self.invoke(handler, [arg])))
+            except _Thrown as e:
+                d.reject(e.value)
+            except JSError as e:
+                d.reject({"name": "Error", "message": str(e)})
+
+        def make_then(on_ok=UNDEFINED, on_err=UNDEFINED):
+            d = self._track(JSPromise())
+
+            def react(pp: JSPromise):
+                if pp.state == "fulfilled":
+                    if on_ok is not None and on_ok is not UNDEFINED:
+                        settle_with(on_ok, pp.value, d)
+                    else:
+                        d.resolve(pp.value)
+                else:
+                    if on_err is not None and on_err is not UNDEFINED:
+                        settle_with(on_err, pp.error, d)
+                    else:
+                        d.reject(pp.error)
+
+            p.subscribe(react)
+            return d
+
+        if name == "then":
+            return make_then
+        if name == "catch":
+            return lambda on_err=UNDEFINED: make_then(UNDEFINED, on_err)
+        if name == "finally":
+            def finally_(fn=UNDEFINED):
+                d = self._track(JSPromise())
+
+                def react(pp: JSPromise):
+                    if fn is not None and fn is not UNDEFINED:
+                        try:
+                            self.invoke(fn, [])
+                        except _Thrown as e:
+                            d.reject(e.value)
+                            return
+                    if pp.state == "fulfilled":
+                        d.resolve(pp.value)
+                    else:
+                        d.reject(pp.error)
+
+                p.subscribe(react)
+                return d
+            return finally_
+        return UNDEFINED
 
 
 # ---------------------------------------------------------------------------

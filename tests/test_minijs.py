@@ -355,9 +355,96 @@ def test_typeof():
 
 def test_unsupported_syntax_fails_loudly():
     with pytest.raises(JSSyntaxError):
-        run("const d = new Date();")
-    with pytest.raises(JSSyntaxError):
         run("class Foo {}")
+    with pytest.raises(JSSyntaxError):
+        run("function* gen() { yield 1; }")
+
+
+def test_new_invokes_host_constructors():
+    from routest_tpu.utils.minijs import JSError
+
+    it = Interpreter()
+    it.set_global("Thing", lambda a, b: {"sum": a + b})
+    it.run("const t = new Thing(2, 3);")
+    assert it.get("t") == {"sum": 5.0}
+    # no host constructor registered → runtime ReferenceError
+    with pytest.raises(JSError):
+        run("const d = new Date();")
+
+
+def test_async_await_eager_semantics():
+    from routest_tpu.utils.minijs import JSPromise
+
+    it = run("""
+      async function f(x) { return x * 2; }
+      let got = null;
+      f(21).then(v => { got = v; });
+      async function g() { return (await f(4)) + 1; }
+      const nine = g();
+      let caught = null;
+      async function boom() { throw { message: 'x' }; }
+      boom().catch(e => { caught = e.message; });
+      const settled = new Promise(resolve => resolve(7));
+      async function use() { return await settled; }
+      const seven = use();
+      const arrow = async x => x + 1;
+      const five = arrow(4);
+    """)
+    assert it.get("got") == 42.0
+    assert it.get("nine").value == 9.0
+    assert it.get("caught") == "x"
+    assert it.get("seven").value == 7.0
+    assert it.get("five").value == 5.0
+    # awaiting a pending promise is an explicit error (no event loop)
+    from routest_tpu.utils.minijs import JSError
+
+    with pytest.raises(JSError, match="PENDING"):
+        run("const p = new Promise(resolve => {}); const v = await p;")
+
+
+def test_pending_promise_reactions_run_on_host_settle():
+    # the jsdom dialog pattern: a reaction attached while pending runs
+    # the moment the host fires the captured resolve
+    it = run("""
+      let res = null; let got = null;
+      const p = new Promise(resolve => { res = resolve; });
+      p.then(v => { got = v; });
+    """)
+    assert it.get("got") is None
+    it.invoke(it.get("res"), [42.0])
+    assert it.get("got") == 42.0
+
+
+def test_rejection_handlers_flatten_and_rethrow_symmetrically():
+    # catch returning an async call flattens; catch throwing rejects
+    # the downstream promise instead of escaping as a Python error
+    it = run("""
+      async function retry() { return 7; }
+      let flat = null;
+      async function boom() { throw { message: 'x' }; }
+      boom().catch(e => retry()).then(v => { flat = v; });
+      let second = null;
+      boom().catch(e => { throw { message: 'again' }; })
+            .catch(e2 => { second = e2.message; });
+    """)
+    assert it.get("flat") == 7.0
+    assert it.get("second") == "again"
+
+
+def test_unobserved_async_failure_is_loud():
+    # an async call nobody awaits or catches must not swallow a
+    # ReferenceError — run() surfaces it at the end
+    from routest_tpu.utils.minijs import JSError
+
+    with pytest.raises(JSError, match="unhandled promise rejection"):
+        run("async function f() { return noSuchVariable + 1; } f();")
+    # ...but an OBSERVED rejection is fine
+    it = run("""
+      let seen = null;
+      async function f() { return noSuchVariable + 1; }
+      f().catch(e => { seen = e.message; });
+    """)
+    assert "noSuchVariable" in it.get("seen")
 
 
 def test_python_interop_roundtrip():
