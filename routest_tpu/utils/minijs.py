@@ -2315,6 +2315,9 @@ def _number_method(x: float, name: str):
     table: Dict[str, Callable] = {
         "toFixed": lambda digits=0.0: _js_tofixed(x, _to_int(digits)),
         "toString": lambda base=10.0: _num_to_string(x, _to_int(base)),
+        # en-US default: thousands separators, ≤3 fraction digits,
+        # ties away from zero via _js_tofixed (the pinned semantics)
+        "toLocaleString": lambda *a: _num_to_locale(x),
         "toPrecision": lambda p=UNDEFINED: _js_number_str(x)
         if p is UNDEFINED else f"{x:.{_to_int(p)}g}",
         "valueOf": lambda: x,
@@ -2322,6 +2325,22 @@ def _number_method(x: float, name: str):
     if name in table:
         return table[name]
     return UNDEFINED
+
+
+def _num_to_locale(x: float) -> str:
+    """Number.prototype.toLocaleString, en-US defaults: grouping +
+    up to 3 fraction digits, ties away from zero (Intl halfExpand —
+    same rule _js_tofixed pins for toFixed)."""
+    if not math.isfinite(x):
+        return _js_number_str(x)
+    if x == int(x):
+        return f"{int(x):,}"
+    fixed = _js_tofixed(x, 3)           # sign + tie handling pinned
+    sign = "-" if fixed.startswith("-") else ""
+    whole, frac = fixed.lstrip("-").split(".")
+    frac = frac.rstrip("0")
+    grouped = f"{int(whole):,}"
+    return sign + grouped + ("." + frac if frac else "")
 
 
 def _num_to_string(x: float, base: int) -> str:

@@ -273,6 +273,68 @@ def test_history_delete_removes_the_row(host, client):
     assert all(row["request_id"] != req_id for row in items)
 
 
+# ── the MVP map page boots and routes too ─────────────────────────────
+
+@pytest.fixture()
+def mvp(client) -> DomHost:
+    page = client.get("/").get_data(as_text=True)
+    h = DomHost(page, client)
+    h.run_scripts()
+    return h
+
+
+def test_mvp_boot_lists_and_classifies_locations(mvp):
+    rows = [c for c in mvp.by_id("locList").children
+            if getattr(c, "tag", None) == "div"]
+    assert len(rows) == 21
+    tags = {t._text() for r in rows for t in r.select(".tag")}
+    assert tags == {"warehouse", "mall"}
+    # search narrows the list (oninput handler re-renders)
+    mvp.by_id("search").props["value"] = "warehouse"
+    mvp.interp.invoke(mvp.by_id("search").props["oninput"], [])
+    rows = [c for c in mvp.by_id("locList").children
+            if getattr(c, "tag", None) == "div"]
+    assert 0 < len(rows) < 21
+    assert all("warehouse" in r._text().lower() for r in rows)
+
+
+def test_mvp_pick_two_and_route_end_to_end(mvp):
+    rows = [c for c in mvp.by_id("locList").children
+            if getattr(c, "tag", None) == "div"]
+    mvp._click(rows[0])          # first click = origin
+    assert mvp.text("fromName") != "–"
+    assert mvp.by_id("route").props.get("disabled") is not False
+    mvp._click(rows[0])          # re-render replaced rows: re-query
+    rows = [c for c in mvp.by_id("locList").children
+            if getattr(c, "tag", None) == "div"]
+    mvp._click(rows[3])          # second click = destination
+    assert mvp.text("toName") != "–"
+    assert mvp.by_id("route").props["disabled"] is False
+    mvp.click("route")
+    assert mvp.text("error") == ""
+    assert mvp.by_id("result").style.props["display"] == "block"
+    assert float(mvp.text("r-dist")) > 0
+    # the polyline landed
+    assert any(c.tag == "path" for c in mvp.by_id("map").walk())
+
+
+# ── the health page boots ─────────────────────────────────────────────
+
+def test_health_page_renders_live_checks(client):
+    page = client.get("/health").get_data(as_text=True)
+    h = DomHost(page, client)
+    h.run_scripts()
+    assert h.text("overall") in ("ok", "degraded")
+    cards = [c for c in h.by_id("cards").children
+             if getattr(c, "tag", None) == "div"]
+    names = {t._text() for card in cards for t in card.select(".name")}
+    assert {"engine", "redis", "supabase", "model", "tpu"} <= names
+    # the raw JSON dump parses back to the live health payload
+    raw = json.loads(h.text("raw"))
+    assert raw["status"] == h.text("overall")
+    assert any(t["repeating"] and t["delay"] == 30000 for t in h.timers)
+
+
 # ── basemap toggle ────────────────────────────────────────────────────
 
 def test_layer_toggle_flips_class_and_label(host):

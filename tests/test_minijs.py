@@ -74,6 +74,15 @@ def test_number_tostring_bases():
     assert ev("(5).toString()") == "5"
 
 
+def test_to_locale_string_en_us_defaults():
+    assert ev("(1234567).toLocaleString()") == "1,234,567"
+    assert ev("(-1234.5).toLocaleString()") == "-1,234.5"
+    assert ev("(0.0625).toLocaleString()") == "0.063"  # tie: halfExpand
+    assert ev("(1234.5678).toLocaleString()") == "1,234.568"
+    assert ev("(0/0).toLocaleString()") == "NaN"
+    assert ev("(1/0).toLocaleString()") == "Infinity"
+
+
 # ── truthiness / equality / nullish ───────────────────────────────────
 
 def test_js_truthiness():
