@@ -182,8 +182,26 @@ class AuthService:
             return self._public(user) if user else None
 
     def user_from_request(self, request) -> Optional[dict]:
-        """The one bearer-auth guard: resolve the request's token, or None."""
-        return self.user_for_token(bearer_token(request))
+        """Resolve the request's identity: bearer token first (Sanctum
+        API mode), else the session cookie (Sanctum stateful SPA mode,
+        ``laravel/bootstrap/app.php:14-21``). Cookie-sourced identity
+        on an UNSAFE method additionally requires the double-submit
+        CSRF proof — the ``X-XSRF-TOKEN`` header must equal the
+        ``XSRF-TOKEN`` cookie the SPA read (Sanctum's
+        ``EnsureFrontendRequestsAreStateful`` behavior)."""
+        user = self.user_for_token(bearer_token(request))
+        if user is not None:
+            return user
+        token = request.cookies.get(SESSION_COOKIE)
+        if not token:
+            return None
+        user = self.user_for_token(token)
+        if user is None:
+            return None
+        if request.method not in ("GET", "HEAD", "OPTIONS") \
+                and not _csrf_ok(request):
+            return None
+        return user
 
     # ── password reset ─────────────────────────────────────────────────
 
@@ -261,6 +279,24 @@ class AuthService:
                 ("id", "name", "email", "email_verified_at", "created_at")}
 
 
+# Sanctum SPA-mode cookie names: the XSRF token is readable (the SPA
+# echoes it in a header — double submit); the session id is HttpOnly.
+XSRF_COOKIE = "XSRF-TOKEN"
+SESSION_COOKIE = "routest_session"
+
+
+def _csrf_ok(request) -> bool:
+    """Double-submit proof: X-XSRF-TOKEN header equals the XSRF-TOKEN
+    cookie. Compared as bytes — ``hmac.compare_digest`` raises on
+    non-ASCII str, and both values are attacker-controlled, so a weird
+    byte must mean 401, never a 500."""
+    cookie = request.cookies.get(XSRF_COOKIE, "")
+    header = request.headers.get("X-XSRF-TOKEN", "")
+    return bool(cookie) and hmac.compare_digest(
+        cookie.encode("utf-8", "surrogateescape"),
+        header.encode("utf-8", "surrogateescape"))
+
+
 def bearer_token(request) -> Optional[str]:
     header = request.headers.get("Authorization", "")
     return header[7:] if header.startswith("Bearer ") else None
@@ -286,7 +322,37 @@ def mount_auth(app, auth: AuthService, mailer=None) -> None:
     NotificationController behind a real MAIL_MAILER. When None
     (hermetic default), the flows keep their in-band token behavior
     (module docstring)."""
-    from routest_tpu.serve.wsgi import get_json
+    from routest_tpu.serve.wsgi import get_json, json_response
+
+    @app.route("/sanctum/csrf-cookie", methods=("GET",))
+    def csrf_cookie(request):
+        # Sanctum's stateful-SPA handshake: the SPA fetches this first;
+        # the readable XSRF-TOKEN cookie is echoed back as the
+        # X-XSRF-TOKEN header on subsequent unsafe requests.
+        from werkzeug.wrappers import Response
+
+        resp = Response("", 204)
+        resp.set_cookie(XSRF_COOKIE, secrets.token_urlsafe(24),
+                        samesite="Lax", path="/")
+        return resp
+
+    def _session_login_wanted(request) -> bool:
+        """SPA-mode signature on a credential request: the CSRF pair
+        (cookie + matching header) is present — bearer-only clients
+        never send it, so they keep getting plain token responses."""
+        return _csrf_ok(request)
+
+    def _credential_response(request, user, token, status):
+        payload = {"user": user, "token": token}
+        if not _session_login_wanted(request):
+            return payload, status
+        # SPA mode: the session ALSO rides an HttpOnly cookie, so the
+        # frontend needs no token storage (Sanctum stateful behavior);
+        # the body keeps the token for wire-shape compatibility.
+        resp = json_response(payload, status)
+        resp.set_cookie(SESSION_COOKIE, token, httponly=True,
+                        samesite="Lax", path="/")
+        return resp
 
     @app.route("/api/auth/register", methods=("POST",))
     def register(request):
@@ -297,7 +363,7 @@ def mount_auth(app, auth: AuthService, mailer=None) -> None:
                 str(body.get("password") or ""))
         except ValueError as e:
             return validation_error(e)
-        return {"user": user, "token": token}, 201
+        return _credential_response(request, user, token, 201)
 
     @app.route("/api/auth/login", methods=("POST",))
     def login(request):
@@ -308,15 +374,24 @@ def mount_auth(app, auth: AuthService, mailer=None) -> None:
                                      source=request.remote_addr or "")
         except ValueError as e:
             return validation_error(e)
-        return {"user": user, "token": token}, 200
+        return _credential_response(request, user, token, 200)
 
     @app.route("/api/auth/logout", methods=("POST",))
     def logout(request):
-        if not auth.logout(bearer_token(request) or ""):
+        token = bearer_token(request)
+        if token is None:
+            # cookie-sourced logout is an unsafe method like any other:
+            # it needs the double-submit proof (the docstring invariant)
+            if not _csrf_ok(request):
+                return UNAUTHENTICATED
+            token = request.cookies.get(SESSION_COOKIE) or ""
+        if not auth.logout(token):
             return UNAUTHENTICATED
         from werkzeug.wrappers import Response
 
-        return Response("", 204)
+        resp = Response("", 204)
+        resp.delete_cookie(SESSION_COOKIE, path="/")
+        return resp
 
     @app.route("/api/user", methods=("GET",))
     def current_user(request):
@@ -385,8 +460,13 @@ def mount_auth(app, auth: AuthService, mailer=None) -> None:
 
     @app.route("/api/auth/verify-email/<user_id>/<email_hash>", methods=("GET",))
     def verify_email(request, user_id, email_hash):
+        # resolve the token like user_from_request: bearer first, then
+        # the SPA session cookie (a GET is safe — no CSRF proof needed),
+        # so cookie-mode users can open the link they were mailed
+        token = bearer_token(request) \
+            or request.cookies.get(SESSION_COOKIE) or ""
         try:
-            auth.verify_email(bearer_token(request) or "", user_id, email_hash)
+            auth.verify_email(token, user_id, email_hash)
         except PermissionError:
             return UNAUTHENTICATED
         except ValueError as e:

@@ -144,8 +144,16 @@ class App:
         if origin and _ALLOWED_ORIGIN_RE.match(origin):
             response.headers["Access-Control-Allow-Origin"] = origin
             response.headers["Vary"] = "Origin"
-            response.headers["Access-Control-Allow-Headers"] = "Content-Type, Authorization"
+            # X-XSRF-TOKEN + credentials: the Sanctum SPA cookie mode
+            # must work from the allowed cross-origin frontend (the
+            # browser drops cookies without Allow-Credentials, and the
+            # unsafe-method preflight must admit the CSRF header).
+            # Allow-Origin is always a specific echoed origin here,
+            # never "*", so credentials mode is spec-legal.
+            response.headers["Access-Control-Allow-Headers"] = \
+                "Content-Type, Authorization, X-XSRF-TOKEN"
             response.headers["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
+            response.headers["Access-Control-Allow-Credentials"] = "true"
 
 
 def _max_body_bytes() -> int:

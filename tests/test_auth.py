@@ -310,3 +310,138 @@ def test_file_mailer_appends_parseable_lines(tmp_path):
     # env wiring: ROUTEST_MAIL_FILE configures; unset disables
     assert make_mailer({"ROUTEST_MAIL_FILE": mbox}).path == mbox
     assert make_mailer({}) is None
+
+
+def _csrf_pair(client):
+    """Do the Sanctum SPA handshake; return the XSRF token to echo."""
+    r = client.get("/sanctum/csrf-cookie")
+    assert r.status_code == 204
+    cookie = client.get_cookie("XSRF-TOKEN")
+    assert cookie is not None
+    return cookie.value
+
+
+def test_sanctum_cookie_spa_flow(client):
+    """Stateful SPA mode (laravel bootstrap/app.php:14-21): CSRF
+    handshake -> login sets an HttpOnly session cookie -> /api/user
+    authenticates by cookie alone -> unsafe methods need the
+    double-submit header -> logout clears the session."""
+    xsrf = _csrf_pair(client)
+    r = client.post("/api/auth/register",
+                    json={"name": "Spa", "email": "spa@example.com",
+                          "password": "s3cretpass"},
+                    headers={"X-XSRF-TOKEN": xsrf})
+    assert r.status_code == 201
+    session = client.get_cookie("routest_session")
+    assert session is not None and session.http_only
+    # cookie-only identity on a safe method (no Authorization header)
+    r = client.get("/api/user")
+    assert r.status_code == 200
+    assert r.get_json()["email"] == "spa@example.com"
+    # logout via the cookie revokes the session server-side
+    r = client.post("/api/auth/logout",
+                    headers={"X-XSRF-TOKEN": xsrf})
+    assert r.status_code == 204
+    assert client.get("/api/user").status_code == 401
+
+
+def test_sanctum_unsafe_methods_require_csrf_header(model_artifact,
+                                                    monkeypatch):
+    """A cookie-authenticated DELETE without (or with a wrong)
+    X-XSRF-TOKEN header is rejected — the double-submit proof."""
+    monkeypatch.setenv("ROUTEST_AUTH", "require")
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    c = Client(create_app(Config(), eta_service=eta))
+    xsrf = _csrf_pair(c)
+    r = c.post("/api/auth/register",
+               json={"name": "C", "email": "csrf@example.com",
+                     "password": "s3cretpass"},
+               headers={"X-XSRF-TOKEN": xsrf})
+    assert r.status_code == 201 and c.get_cookie("routest_session")
+    # create a history row to delete
+    r = c.post("/api/optimize_route", json={
+        "source_point": {"lat": 14.5836, "lon": 121.0409},
+        "destination_points": [{"lat": 14.5355, "lon": 121.0621,
+                                "payload": 1}],
+        "driver_details": {"driver_name": "C", "vehicle_type": "car",
+                           "vehicle_capacity": 9999,
+                           "maximum_distance": 100000}})
+    req_id = r.get_json()["properties"]["request_id"]
+    # no header -> 401; wrong header -> 401; correct header -> deleted
+    assert c.delete(f"/api/history/{req_id}").status_code == 401
+    assert c.delete(f"/api/history/{req_id}",
+                    headers={"X-XSRF-TOKEN": "forged"}).status_code == 401
+    assert c.delete(f"/api/history/{req_id}",
+                    headers={"X-XSRF-TOKEN": xsrf}).status_code in (200,
+                                                                    204)
+
+
+def test_bearer_clients_get_no_cookies(client):
+    """A plain API client (no handshake) keeps the pure token flow:
+    no Set-Cookie on login, bearer works as before."""
+    _register(client, email="api@example.com")
+    r = client.post("/api/auth/login", json={
+        "email": "api@example.com", "password": "s3cretpass"})
+    assert r.status_code == 200
+    assert "routest_session" not in (r.headers.get("Set-Cookie") or "")
+    token = r.get_json()["token"]
+    r = client.get("/api/user",
+                   headers={"Authorization": f"Bearer {token}"})
+    assert r.status_code == 200
+
+
+def test_cookie_logout_requires_csrf_proof(client):
+    xsrf = _csrf_pair(client)
+    client.post("/api/auth/register",
+                json={"name": "L", "email": "lo@example.com",
+                      "password": "s3cretpass"},
+                headers={"X-XSRF-TOKEN": xsrf})
+    # cookie-only logout without (or with a forged) header is refused
+    assert client.post("/api/auth/logout").status_code == 401
+    assert client.post("/api/auth/logout",
+                       headers={"X-XSRF-TOKEN": "forged"}
+                       ).status_code == 401
+    assert client.get("/api/user").status_code == 200  # still live
+    assert client.post("/api/auth/logout",
+                       headers={"X-XSRF-TOKEN": xsrf}).status_code == 204
+
+
+def test_cookie_session_can_use_verification_link(client):
+    xsrf = _csrf_pair(client)
+    client.post("/api/auth/register",
+                json={"name": "V", "email": "vc@example.com",
+                      "password": "s3cretpass"},
+                headers={"X-XSRF-TOKEN": xsrf})
+    r = client.post("/api/auth/email/verification-notification",
+                    headers={"X-XSRF-TOKEN": xsrf})
+    assert r.status_code == 200
+    url = r.get_json()["verify_url"]
+    r = client.get(url)          # session cookie only, no bearer
+    assert r.status_code == 200 and r.get_json()["verified"] is True
+
+
+def test_non_ascii_csrf_values_yield_401_not_500(client):
+    xsrf = _csrf_pair(client)
+    client.post("/api/auth/register",
+                json={"name": "N", "email": "na@example.com",
+                      "password": "s3cretpass"},
+                headers={"X-XSRF-TOKEN": xsrf})
+    # attacker-shaped non-ASCII header must be a clean 401, never a 500
+    r = client.post("/api/auth/logout",
+                    headers={"X-XSRF-TOKEN": "café"})
+    assert r.status_code == 401
+
+
+def test_cors_admits_spa_cookie_mode():
+    from routest_tpu.serve.wsgi import App
+
+    app = App()
+
+    @app.route("/x", methods=("GET",))
+    def x(request):
+        return {"ok": True}, 200
+
+    c = Client(app)
+    r = c.get("/x", headers={"Origin": "http://localhost:3000"})
+    assert r.headers["Access-Control-Allow-Credentials"] == "true"
+    assert "X-XSRF-TOKEN" in r.headers["Access-Control-Allow-Headers"]
