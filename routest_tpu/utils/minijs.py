@@ -1240,8 +1240,14 @@ class Interpreter:
         self._promises = [p for p in self._promises
                           if p.state == "pending"]
         if bad:
-            raise JSError("unhandled promise rejection: "
-                          + _js_display(bad[0].error))
+            err = bad[0].error
+            if isinstance(err, dict):  # Error-shaped: show the payload
+                try:
+                    err = _json_stringify(err)
+                except Exception:      # non-JSON members (host objects)
+                    pass               # fall back to [object Object]
+            raise JSError(f"unhandled promise rejection: "
+                          f"{_js_display(err)}")
 
     # -- public API ------------------------------------------------------
     def run(self, source: str):
@@ -1887,11 +1893,22 @@ class Interpreter:
 
         def settle_with(handler, arg, d: JSPromise):
             try:
-                d.resolve(self.await_value(self.invoke(handler, [arg])))
+                out = self.invoke(handler, [arg])
             except _Thrown as e:
                 d.reject(e.value)
+                return
             except JSError as e:
                 d.reject({"name": "Error", "message": str(e)})
+                return
+            if isinstance(out, JSPromise):
+                # ADOPT a returned promise (even a pending one — the
+                # chain resumes when the host settles it); `await` is
+                # the only place pending is an error
+                out.subscribe(
+                    lambda pp: d.resolve(pp.value)
+                    if pp.state == "fulfilled" else d.reject(pp.error))
+            else:
+                d.resolve(out)
 
         def make_then(on_ok=UNDEFINED, on_err=UNDEFINED):
             d = self._track(JSPromise())

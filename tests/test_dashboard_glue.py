@@ -273,6 +273,73 @@ def test_history_delete_removes_the_row(host, client):
     assert all(row["request_id"] != req_id for row in items)
 
 
+# ── the auth dialog flow (bearer-gated delete) ────────────────────────
+
+def test_delete_opens_auth_dialog_and_retries_with_token(
+        tmp_path_factory, monkeypatch):
+    """The subtlest glue path, end-to-end under ROUTEST_AUTH=require:
+    delete → 401 → masked sign-in dialog → login-or-register against
+    the live Breeze API → token stored → retry succeeds → history
+    reloads without the row. The dialog promise stays PENDING until
+    the user clicks; everything downstream rides its .then."""
+    from routest_tpu.serve.auth import AuthService
+
+    path = str(tmp_path_factory.mktemp("authmodel") / "eta.msgpack")
+    model = EtaMLP(hidden=(16, 16), policy=F32_POLICY)
+    save_model(path, model, model.init(jax.random.PRNGKey(0)))
+    eta = EtaService(ServeConfig(), model_path=path)
+    client = Client(create_app(Config(), eta_service=eta,
+                               auth=AuthService(required=True),
+                               sim_tick_range=(0.001, 0.002)))
+    page = client.get("/ui").get_data(as_text=True)
+    host = DomHost(page, client)
+    host.run_scripts()
+    feature = _calc(host, 2)
+    req_id = host.interp.to_py(feature)["properties"]["request_id"]
+    rows = [c for c in host.by_id("historyRows").children
+            if getattr(c, "tag", None) == "div"]
+    ev = Event()
+    host._click(rows[0].select(".del")[0], ev)
+    # gate hit: dialog opened, nothing deleted yet
+    assert "open" in host.by_id("authbox").props["className"]
+    assert any(r["request_id"] == req_id for r in
+               client.get("/api/history?limit=50",
+                          headers={"Accept": "application/json"}
+                          ).get_json()["items"])
+    # empty submit surfaces the validation hint and keeps the box open
+    host.click("auth-go")
+    assert host.text("auth-msg") == "email and password required"
+    # real credentials: unknown account → auto-register path
+    host.by_id("auth-email").props["value"] = "dispatcher@example.com"
+    host.by_id("auth-pass").props["value"] = "s3cretpass99"
+    host.click("auth-go")
+    assert "open" not in host.by_id("authbox").props["className"]
+    assert host.localStorage.data.get("api_token")
+    # the pending delete resumed with the token and the row is gone
+    items = client.get("/api/history?limit=50").get_json()["items"]
+    assert all(r["request_id"] != req_id for r in items)
+    assert host.text("error") == ""
+
+    # second round: WRONG password for the now-existing account surfaces
+    # both the login and the register failure, dialog stays open
+    feature = _calc(host, 2)
+    req_id2 = host.interp.to_py(feature)["properties"]["request_id"]
+    host.localStorage.data.pop("api_token")
+    rows = [c for c in host.by_id("historyRows").children
+            if getattr(c, "tag", None) == "div"]
+    host._click(rows[0].select(".del")[0], Event())
+    host.by_id("auth-email").props["value"] = "dispatcher@example.com"
+    host.by_id("auth-pass").props["value"] = "wrong-password"
+    host.click("auth-go")
+    assert "open" in host.by_id("authbox").props["className"]
+    assert "/" in host.text("auth-msg")      # "login msg / register msg"
+    # cancel resolves null: nothing deleted, box closed
+    host.click("auth-cancel")
+    assert "open" not in host.by_id("authbox").props["className"]
+    assert any(r["request_id"] == req_id2 for r in
+               client.get("/api/history?limit=50").get_json()["items"])
+
+
 # ── the MVP map page boots and routes too ─────────────────────────────
 
 @pytest.fixture()

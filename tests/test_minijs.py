@@ -411,6 +411,20 @@ def test_async_await_eager_semantics():
         run("const p = new Promise(resolve => {}); const v = await p;")
 
 
+def test_then_adopts_a_returned_pending_promise():
+    # a .then handler returning a PENDING promise chains: downstream
+    # reactions wait for the host to settle it (the auth-dialog shape)
+    it = run("""
+      let res = null; let seen = null;
+      const dialog = () => new Promise(resolve => { res = resolve; });
+      const settled = new Promise(r => r('go'));
+      settled.then(v => dialog()).then(tok => { seen = tok; });
+    """)
+    assert it.get("seen") is None            # dialog still open
+    it.invoke(it.get("res"), ["tok-123"])
+    assert it.get("seen") == "tok-123"       # chain resumed on settle
+
+
 def test_pending_promise_reactions_run_on_host_settle():
     # the jsdom dialog pattern: a reaction attached while pending runs
     # the moment the host fires the captured resolve
