@@ -84,11 +84,54 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Multi-replica serving fleet (``serve/fleet``): a supervisor that
+    keeps N shared-nothing worker processes alive plus a gateway that
+    routes, sheds, and hedges in front of them. All knobs are env-
+    tunable (``RTPU_FLEET_*``); the defaults target a small multi-core
+    host."""
+
+    replicas: int = 2
+    gateway_host: str = "127.0.0.1"
+    gateway_port: int = 8099
+    # First replica port; replica i listens on base_port + i.
+    base_port: int = 5101
+    # Admission control: at most ``max_inflight`` requests proxying at
+    # once; up to ``queue_depth`` more may wait. Beyond that (or past a
+    # request's deadline) the gateway sheds with 429 + Retry-After.
+    max_inflight: int = 64
+    queue_depth: int = 128
+    deadline_ms: float = 30_000.0
+    # Circuit breaker: ``eject_after`` consecutive failures open the
+    # breaker for ``cooldown_s``; then ONE half-open probe decides.
+    eject_after: int = 3
+    cooldown_s: float = 2.0
+    # Tail hedging for idempotent predict reads: a second copy goes to
+    # another replica once the first has been in flight for the fleet's
+    # observed p95 (floored at ``hedge_min_ms``). 0/False disables.
+    # Only small requests hedge (``hedge_max_body_bytes``): duplicating
+    # a 131k-row batch doubles real device work, which is exactly the
+    # overload hedging is supposed to relieve — Tail-at-Scale hedges
+    # cheap reads, not bulk compute.
+    hedge: bool = True
+    hedge_min_ms: float = 50.0
+    hedge_max_body_bytes: int = 16_384
+    # Supervisor restart backoff: min(cap, base * 2**consecutive_crashes).
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 30.0
+    # Health probing: /up every ``probe_interval_s``; this many
+    # consecutive probe failures restart the worker.
+    probe_interval_s: float = 1.0
+    unhealthy_after: int = 3
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -150,4 +193,23 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         ors_api_key=_env(env, "ORS_API_KEY", "OPENROUTESERVICE_API_KEY"),
         version=_env(env, "RENDER_GIT_COMMIT", "GIT_COMMIT_SHA"),
     )
-    return Config(mesh=mesh, model=model, train=train, serve=serve)
+    fleet = FleetConfig(
+        replicas=_int("RTPU_FLEET_REPLICAS", 2),
+        gateway_host=env.get("RTPU_GATEWAY_HOST", "127.0.0.1"),
+        gateway_port=_int("RTPU_GATEWAY_PORT", 8099),
+        base_port=_int("RTPU_FLEET_BASE_PORT", 5101),
+        max_inflight=_int("RTPU_FLEET_MAX_INFLIGHT", 64),
+        queue_depth=_int("RTPU_FLEET_QUEUE_DEPTH", 128),
+        deadline_ms=_float("RTPU_FLEET_DEADLINE_MS", 30_000.0),
+        eject_after=_int("RTPU_FLEET_EJECT_AFTER", 3),
+        cooldown_s=_float("RTPU_FLEET_COOLDOWN_S", 2.0),
+        hedge=env.get("RTPU_FLEET_HEDGE", "1") != "0",
+        hedge_min_ms=_float("RTPU_FLEET_HEDGE_MIN_MS", 50.0),
+        hedge_max_body_bytes=_int("RTPU_FLEET_HEDGE_MAX_BODY", 16_384),
+        backoff_base_s=_float("RTPU_FLEET_BACKOFF_BASE_S", 0.5),
+        backoff_cap_s=_float("RTPU_FLEET_BACKOFF_CAP_S", 30.0),
+        probe_interval_s=_float("RTPU_FLEET_PROBE_S", 1.0),
+        unhealthy_after=_int("RTPU_FLEET_UNHEALTHY_AFTER", 3),
+    )
+    return Config(mesh=mesh, model=model, train=train, serve=serve,
+                  fleet=fleet)

@@ -15,8 +15,12 @@ exists here as a first-class serving module:
 - password reset and email verification flows are hermetic BY DEFAULT:
   where Breeze emails a link, these endpoints RETURN the token/link
   payload directly — no SMTP dependency, same state machine. The
-  verify-email hash is sha1(email), matching Laravel's signed-URL
-  ingredient. Exception: under ``ROUTEST_AUTH=require`` the reset
+  verify-email URL carries Laravel's two path ingredients (user id +
+  sha1(email)) AND is signed like Laravel's ``signed`` middleware: an
+  ``expires`` timestamp plus an HMAC-SHA256 ``signature`` over a server
+  secret (``ROUTEST_APP_KEY``, else a per-process random key), so a
+  link cannot be forged from a known email or replayed after expiry.
+  Exception: under ``ROUTEST_AUTH=require`` the reset
   token is written to the server log instead of the response, so the
   bearer gate cannot be bypassed by an anonymous forgot-password call.
   With a mail transport configured (``serve/mail.py``,
@@ -38,6 +42,7 @@ from __future__ import annotations
 import datetime as dt
 import hashlib
 import hmac
+import os
 import secrets
 import threading
 import time
@@ -68,8 +73,19 @@ class AuthService:
     the same interface the way ``store.py`` does it.
     """
 
-    def __init__(self, required: bool = False) -> None:
+    # Signed verify-email links stay valid this long (Laravel's default
+    # is 60 minutes — ``Auth/VerifyEmail::verificationUrl``).
+    VERIFY_TTL_S = 3600.0
+
+    def __init__(self, required: bool = False,
+                 secret: Optional[str] = None) -> None:
         self.required = required
+        # Signing key for verification URLs. A per-process random key is
+        # the hermetic default (links survive as long as the process, like
+        # every other in-memory credential here); set ROUTEST_APP_KEY for
+        # links that survive restarts / multi-replica fleets.
+        self._secret = (secret or os.environ.get("ROUTEST_APP_KEY")
+                        or secrets.token_hex(32)).encode()
         self._lock = threading.Lock()
         self._users: Dict[str, dict] = {}          # email -> user row
         self._tokens: Dict[str, str] = {}          # bearer token -> email
@@ -246,9 +262,43 @@ class AuthService:
 
     # ── email verification ─────────────────────────────────────────────
 
-    def verify_email(self, token: str, user_id: str, email_hash: str) -> bool:
-        """Mark the bearer's email verified if id+hash match (the two
-        ingredients of Laravel's signed verification URL)."""
+    def _verify_signature(self, user_id: str, email_hash: str,
+                          expires: int) -> str:
+        msg = f"{user_id}|{email_hash}|{expires}".encode()
+        return hmac.new(self._secret, msg, hashlib.sha256).hexdigest()
+
+    def signed_verify_url(self, user_id: str, email: str,
+                          *, now: Optional[float] = None) -> str:
+        """Laravel-style signed verification URL: the two path
+        ingredients (id + sha1(email)) plus ``expires`` and an
+        HMAC-SHA256 ``signature`` over the server secret covering all
+        three — tampering with any component invalidates the link."""
+        expires = int((time.time() if now is None else now)
+                      + self.VERIFY_TTL_S)
+        email_hash = verify_email_hash(email)
+        sig = self._verify_signature(user_id, email_hash, expires)
+        return (f"/api/auth/verify-email/{user_id}/{email_hash}"
+                f"?expires={expires}&signature={sig}")
+
+    def verify_email(self, token: str, user_id: str, email_hash: str,
+                     expires: Optional[str] = None,
+                     signature: Optional[str] = None,
+                     *, now: Optional[float] = None) -> bool:
+        """Mark the bearer's email verified. The link must carry a
+        valid, unexpired HMAC signature (Laravel's signed-URL check) on
+        top of the id+hash match — ``sha1(email)`` alone is forgeable
+        by anyone who knows the address."""
+        try:
+            exp = int(expires or "")
+        except ValueError:
+            raise ValueError("invalid verification link")
+        # Signature check BEFORE expiry: a tampered link reads as
+        # invalid, not expired, regardless of its claimed timestamp.
+        want = self._verify_signature(user_id, email_hash, exp)
+        if not hmac.compare_digest(want, signature or ""):
+            raise ValueError("invalid verification link")
+        if (time.time() if now is None else now) > exp:
+            raise ValueError("verification link expired")
         with self._lock:
             email = self._tokens.get(token or "")
             user = self._users.get(email) if email else None
@@ -297,6 +347,19 @@ def _csrf_ok(request) -> bool:
         header.encode("utf-8", "surrogateescape"))
 
 
+def secure_cookies(request) -> bool:
+    """Whether session/XSRF cookies should carry ``Secure`` (ADVICE r5:
+    a session cookie without it leaks over any plain-HTTP subresource).
+    True when the request arrived over HTTPS — directly or behind a
+    TLS-terminating proxy (``X-Forwarded-Proto``) — or when
+    ``ROUTEST_SECURE_COOKIES`` forces it for deploys whose proxy strips
+    forwarding headers."""
+    if os.environ.get("ROUTEST_SECURE_COOKIES"):
+        return True
+    return (request.scheme == "https"
+            or request.headers.get("X-Forwarded-Proto", "") == "https")
+
+
 def bearer_token(request) -> Optional[str]:
     header = request.headers.get("Authorization", "")
     return header[7:] if header.startswith("Bearer ") else None
@@ -333,7 +396,8 @@ def mount_auth(app, auth: AuthService, mailer=None) -> None:
 
         resp = Response("", 204)
         resp.set_cookie(XSRF_COOKIE, secrets.token_urlsafe(24),
-                        samesite="Lax", path="/")
+                        samesite="Lax", path="/",
+                        secure=secure_cookies(request))
         return resp
 
     def _session_login_wanted(request) -> bool:
@@ -351,7 +415,8 @@ def mount_auth(app, auth: AuthService, mailer=None) -> None:
         # the body keeps the token for wire-shape compatibility.
         resp = json_response(payload, status)
         resp.set_cookie(SESSION_COOKIE, token, httponly=True,
-                        samesite="Lax", path="/")
+                        samesite="Lax", path="/",
+                        secure=secure_cookies(request))
         return resp
 
     @app.route("/api/auth/register", methods=("POST",))
@@ -445,8 +510,7 @@ def mount_auth(app, auth: AuthService, mailer=None) -> None:
         user = auth.user_from_request(request)
         if user is None:
             return UNAUTHENTICATED
-        verify_url = (f"/api/auth/verify-email/{user['id']}/"
-                      f"{verify_email_hash(user['email'])}")
+        verify_url = auth.signed_verify_url(user["id"], user["email"])
         if mailer is not None:
             # Reference behavior: link travels by mail; the response is
             # just the Breeze status string.
@@ -466,7 +530,9 @@ def mount_auth(app, auth: AuthService, mailer=None) -> None:
         token = bearer_token(request) \
             or request.cookies.get(SESSION_COOKIE) or ""
         try:
-            auth.verify_email(token, user_id, email_hash)
+            auth.verify_email(token, user_id, email_hash,
+                              expires=request.args.get("expires"),
+                              signature=request.args.get("signature"))
         except PermissionError:
             return UNAUTHENTICATED
         except ValueError as e:

@@ -1,0 +1,28 @@
+"""Multi-replica serving fleet: supervisor + gateway.
+
+The single-process server (``python -m routest_tpu.serve``) tops out at
+one batcher on one device client. Production inference stacks put a
+scheduling tier in front of per-replica batchers (Orca-style continuous
+batching schedulers; tail-tolerant routing per "The Tail at Scale").
+This package is that tier, process-level and stdlib-only:
+
+- ``supervisor.ReplicaSupervisor`` — spawns N shared-nothing worker
+  processes (each the full ``serve/wsgi.py`` stack on its own port),
+  health-probes them, restarts crashes with capped exponential backoff,
+  and drains gracefully on SIGTERM;
+- ``gateway.Gateway`` — least-outstanding-requests routing, a
+  consecutive-failure circuit breaker with half-open probing, one
+  idempotent retry across replicas, optional p95-delay hedging, and a
+  bounded admission queue that degrades overload into fast 429s;
+- ``python -m routest_tpu.serve.fleet`` — wires both up from
+  ``core.config.FleetConfig`` (``RTPU_FLEET_*`` env knobs).
+
+Replicas share nothing in-process; cross-replica state (SSE fanout,
+history) rides the same broker/store backends the workers already speak
+(``REDIS_URL``/``SUPABASE_URL``), exactly like ``tests/test_cross_process.py``.
+"""
+
+from routest_tpu.serve.fleet.gateway import Gateway
+from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
+
+__all__ = ["Gateway", "ReplicaSupervisor"]

@@ -1,0 +1,78 @@
+"""Fleet entry point: ``python -m routest_tpu.serve.fleet``.
+
+Boots ``RTPU_FLEET_REPLICAS`` worker processes (each the full
+``python -m routest_tpu.serve`` stack on ``base_port + i``) under the
+supervisor, then serves the gateway on ``RTPU_GATEWAY_PORT``. With more
+than one replica and no ``REDIS_URL`` configured, a hermetic TCP broker
+(``serve/netbus.py``) is started so SSE events cross replicas — the
+same wiring ``scripts/load_test.py --workers N`` uses. SIGTERM/SIGINT
+drain gracefully: the gateway stops admitting and finishes inflight
+requests, then the workers get SIGTERM.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+from routest_tpu.core.config import load_config
+from routest_tpu.serve.fleet.gateway import Gateway
+from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
+
+
+def main() -> None:
+    config = load_config()
+    fleet = config.fleet
+    n = max(1, fleet.replicas)
+    ports = [fleet.base_port + i for i in range(n)]
+
+    env = dict(os.environ)
+    broker = None
+    if n > 1 and not env.get("REDIS_URL"):
+        from routest_tpu.serve.netbus import start_broker
+
+        broker, _ = start_broker()
+        env["REDIS_URL"] = f"tcp://127.0.0.1:{broker.port}"
+        print(f"[fleet] SSE broker at {env['REDIS_URL']}")
+
+    supervisor = ReplicaSupervisor(
+        ports, env=env,
+        probe_interval_s=fleet.probe_interval_s,
+        unhealthy_after=fleet.unhealthy_after,
+        backoff_base_s=fleet.backoff_base_s,
+        backoff_cap_s=fleet.backoff_cap_s,
+        quiet=False)
+    supervisor.start()
+    print(f"[fleet] supervising {n} replica(s) on ports {ports}")
+    if not supervisor.ready(timeout=300):
+        print("[fleet] replicas never became ready", file=sys.stderr)
+        supervisor.drain(timeout=10)
+        sys.exit(2)
+
+    gateway = Gateway([("127.0.0.1", p) for p in ports], fleet,
+                      supervisor=supervisor)
+    gateway.serve(fleet.gateway_host, fleet.gateway_port)
+    print(f"[fleet] gateway on "
+          f"http://{fleet.gateway_host}:{fleet.gateway_port} "
+          f"(replicas: {', '.join(f'127.0.0.1:{p}' for p in ports)})")
+
+    stop = threading.Event()
+
+    def _term(*_):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    print("[fleet] draining …")
+    gateway.drain(timeout=30)
+    supervisor.drain(timeout=30)
+    if broker is not None:
+        broker.shutdown()
+    print("[fleet] bye")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,628 @@
+"""Health-aware HTTP gateway: routing, admission control, tail hedging.
+
+Pure stdlib (``http.server`` + ``http.client``), consistent with the
+serving layer's no-framework bent. One handler thread per connection;
+every proxied request flows through three stages:
+
+1. **Admission** — a bounded queue: at most ``max_inflight`` requests
+   proxy concurrently, at most ``queue_depth`` more wait, and a waiter
+   whose deadline (``X-Deadline-Ms`` header, default
+   ``FleetConfig.deadline_ms``) would pass sheds immediately. Shed =
+   429 + ``Retry-After`` — overload degrades to fast rejections, never
+   collapse (the Tail-at-Scale prescription).
+2. **Routing** — least-outstanding-requests across replicas whose
+   circuit breaker is closed. ``eject_after`` consecutive failures
+   (connect errors or 5xx) open a replica's breaker for ``cooldown_s``;
+   after cooldown exactly one half-open probe request decides between
+   close and re-open. Idempotent requests that die on a connection
+   error retry once on a different replica.
+3. **Hedging** (optional) — idempotent predict reads still in flight
+   after the fleet's observed p95 (floored at ``hedge_min_ms``) send a
+   second copy to another replica; first response wins.
+
+``/api/metrics`` is answered by the gateway itself with fleet
+aggregates (inflight, queue depth, sheds, retries, hedges, ejections,
+per-replica latency quantiles + supervisor restart counts) in JSON or
+Prometheus text, same ``?format=prometheus`` convention as the worker
+metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from routest_tpu.core.config import FleetConfig
+from routest_tpu.utils.logging import get_logger
+from routest_tpu.utils.profiling import RequestStats
+
+_log = get_logger("routest_tpu.fleet.gateway")
+
+# Idempotent pure-compute POST paths: safe to retry on connection death
+# and to hedge (nothing persists; same body → same answer). optimize_
+# route and the auth/tracker endpoints mutate state and are excluded.
+_IDEMPOTENT_POST = {
+    "/api/predict_eta", "/api/predict_eta_batch", "/api/predict",
+    "/api/matrix", "/api/request_route",
+}
+# Hop-by-hop headers (RFC 7230 §6.1) never forwarded either direction.
+_HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
+                "proxy-authorization", "te", "trailer",
+                "transfer-encoding", "upgrade"}
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+def _fresh_conn(host: str, port: int,
+                timeout: float) -> http.client.HTTPConnection:
+    """Connected upstream connection with TCP_NODELAY — headers and
+    body go out as separate small writes, and Nagle + delayed ACK turns
+    that into a flat ~40 ms per proxied request otherwise."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.connect()
+    conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return conn
+
+
+class _Upstream:
+    """One replica as the gateway sees it: outstanding-request gauge,
+    circuit breaker, connection pool, counters."""
+
+    def __init__(self, rid: str, host: str, port: int) -> None:
+        self.id = rid
+        self.host = host
+        self.port = port
+        self.outstanding = 0
+        self.consecutive_failures = 0
+        self.state = CLOSED
+        self.opened_at = 0.0
+        self.probe_inflight = False
+        self.requests = 0
+        self.errors = 0
+        self.ejections = 0
+        self._pool: List[http.client.HTTPConnection] = []
+
+    @property
+    def base(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def get_conn(self, timeout: float) -> Tuple[http.client.HTTPConnection,
+                                                bool]:
+        """→ (connection, was_pooled). Pooled keep-alive connections may
+        have been closed by the replica since; callers retry those once
+        on a fresh connection before charging the breaker."""
+        if self._pool:
+            conn = self._pool.pop()
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            return conn, True
+        return _fresh_conn(self.host, self.port, timeout), False
+
+    def put_conn(self, conn: http.client.HTTPConnection) -> None:
+        if len(self._pool) < 8:
+            self._pool.append(conn)
+        else:
+            conn.close()
+
+    def drop_conns(self) -> None:
+        while self._pool:
+            self._pool.pop().close()
+
+
+class Gateway:
+    def __init__(self, targets: Sequence[Tuple[str, int]],
+                 config: Optional[FleetConfig] = None,
+                 supervisor=None) -> None:
+        self.config = config or FleetConfig()
+        self.supervisor = supervisor
+        self.replicas = [_Upstream(f"r{i}", host, port)
+                         for i, (host, port) in enumerate(targets)]
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._rr = 0                       # round-robin tie-breaker
+        self._inflight = 0
+        self._waiters = 0
+        self.shed_count = 0
+        self.retries = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.draining = False
+        self.started = time.time()
+        # Per-replica latency quantiles, keyed by replica id (reuses the
+        # serving layer's reservoir stats).
+        self.stats = RequestStats()
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+
+    # ── admission control ─────────────────────────────────────────────
+
+    def _admit(self, deadline: float) -> Tuple[bool, int]:
+        """→ (admitted, status). Sheds with 429 when the queue is full
+        or the deadline would pass while queued; 503 while draining."""
+        cfg = self.config
+        with self._cond:
+            if self.draining:
+                return False, 503
+            if self._inflight < cfg.max_inflight:
+                self._inflight += 1
+                return True, 0
+            if self._waiters >= cfg.queue_depth:
+                self.shed_count += 1
+                return False, 429
+            self._waiters += 1
+            try:
+                while True:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        self.shed_count += 1
+                        return False, 429
+                    if self.draining:
+                        return False, 503
+                    if self._inflight < cfg.max_inflight:
+                        self._inflight += 1
+                        return True, 0
+                    self._cond.wait(min(remaining, 0.1))
+            finally:
+                self._waiters -= 1
+
+    def _release(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify()
+
+    # ── routing + circuit breaker ─────────────────────────────────────
+
+    def _pick(self, exclude: Tuple[str, ...] = ()) -> Optional[_Upstream]:
+        now = time.time()
+        with self._lock:
+            candidates = []
+            for r in self.replicas:
+                if r.id in exclude:
+                    continue
+                if r.state == OPEN:
+                    if now - r.opened_at >= self.config.cooldown_s:
+                        r.state = HALF_OPEN       # cooled: allow one probe
+                    else:
+                        continue
+                if r.state == HALF_OPEN and r.probe_inflight:
+                    continue
+                candidates.append(r)
+            if not candidates:
+                return None
+            self._rr += 1
+            # A half-open replica that is due its probe takes priority
+            # for exactly ONE request (probe_inflight gates the rest) —
+            # otherwise a recovered replica starves behind its closed
+            # peers and never re-joins. Everything else: least
+            # outstanding, round-robin tie-break.
+            chosen = next((r for r in candidates if r.state == HALF_OPEN),
+                          None)
+            if chosen is None:
+                chosen = min(
+                    candidates,
+                    key=lambda r: (r.outstanding,
+                                   (self.replicas.index(r) - self._rr)
+                                   % len(self.replicas)))
+            chosen.outstanding += 1
+            chosen.requests += 1
+            if chosen.state == HALF_OPEN:
+                chosen.probe_inflight = True
+            return chosen
+
+    def _complete(self, r: _Upstream, ok: bool, seconds: float) -> None:
+        self.stats.add(r.id, seconds, error=not ok)
+        with self._lock:
+            r.outstanding -= 1
+            if r.state == HALF_OPEN:
+                r.probe_inflight = False
+            if ok:
+                r.consecutive_failures = 0
+                if r.state in (HALF_OPEN, OPEN):
+                    r.state = CLOSED
+                    _log.info("breaker_closed", replica=r.id)
+                return
+            r.errors += 1
+            r.consecutive_failures += 1
+            if r.state == HALF_OPEN:
+                r.state = OPEN                     # failed probe: re-open
+                r.opened_at = time.time()
+                r.drop_conns()
+                _log.warning("breaker_reopened", replica=r.id)
+            elif (r.state == CLOSED
+                  and r.consecutive_failures >= self.config.eject_after):
+                r.state = OPEN
+                r.opened_at = time.time()
+                r.ejections += 1
+                r.drop_conns()
+                _log.warning("breaker_opened", replica=r.id,
+                             failures=r.consecutive_failures)
+
+    # ── proxying ──────────────────────────────────────────────────────
+
+    def _forward_once(self, r: _Upstream, method: str, path: str,
+                      body: Optional[bytes], headers: Dict[str, str],
+                      timeout: float):
+        """→ (status, headers, body) or raises OSError/HTTPException.
+        Counts the exchange into the replica's breaker + stats."""
+        t0 = time.perf_counter()
+        conn = None
+        pooled = False
+        try:
+            try:
+                conn, pooled = r.get_conn(timeout)
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError):
+                if conn is not None:
+                    conn.close()
+                if not pooled:
+                    raise
+                # Stale keep-alive, not a sick replica: one fresh try.
+                conn = _fresh_conn(r.host, r.port, timeout)
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+            data = resp.read()
+            resp_headers = [(k, v) for k, v in resp.getheaders()
+                            if k.lower() not in _HOP_HEADERS]
+            status = resp.status
+        except (http.client.HTTPException, OSError):
+            if conn is not None:
+                conn.close()
+            self._complete(r, ok=False,
+                           seconds=time.perf_counter() - t0)
+            raise
+        if resp.will_close:
+            conn.close()
+        else:
+            r.put_conn(conn)
+        # Breaker failure = transport error or 5xx (a 4xx is the
+        # client's fault, not the replica's).
+        self._complete(r, ok=status < 500,
+                       seconds=time.perf_counter() - t0)
+        return status, resp_headers, data
+
+    def _hedge_delay_s(self) -> float:
+        """p95 of recent proxied latencies, floored at hedge_min_ms."""
+        floor = self.config.hedge_min_ms / 1000.0
+        snap = self.stats.snapshot().get("routes", {})
+        p95s = [s["p95_ms"] for s in snap.values() if "p95_ms" in s]
+        return max(floor, max(p95s) / 1000.0) if p95s else floor
+
+    def handle(self, method: str, path: str, body: Optional[bytes],
+               headers: Dict[str, str], deadline_ms: Optional[float]):
+        """Full gateway pipeline → (status, headers, body)."""
+        cfg = self.config
+        budget_ms = deadline_ms if deadline_ms else cfg.deadline_ms
+        deadline = time.time() + budget_ms / 1000.0
+        admitted, status = self._admit(deadline)
+        if not admitted:
+            if status == 429:
+                return 429, [("Retry-After", "1"),
+                             ("Content-Type", "application/json")], \
+                    json.dumps({"error": "fleet saturated; retry later"
+                                }).encode()
+            return 503, [("Content-Type", "application/json")], \
+                json.dumps({"error": "gateway draining"}).encode()
+        try:
+            return self._routed(method, path, body, headers, deadline)
+        finally:
+            self._release()
+
+    def _routed(self, method, path, body, headers, deadline):
+        bare = path.split("?", 1)[0]
+        idempotent = method in ("GET", "HEAD") or bare in _IDEMPOTENT_POST
+        fwd_headers = {k: v for k, v in headers.items()
+                       if k.lower() not in _HOP_HEADERS
+                       and k.lower() != "host"}
+        timeout = max(0.2, deadline - time.time())
+
+        primary = self._pick()
+        if primary is None:
+            return 503, [("Content-Type", "application/json")], \
+                json.dumps({"error": "no healthy replica"}).encode()
+
+        hedgeable = (self.config.hedge and idempotent
+                     and len(self.replicas) > 1
+                     and bare != "/api/realtime_feed"
+                     and (body is None
+                          or len(body) <= self.config.hedge_max_body_bytes))
+        if hedgeable:
+            result = self._forward_hedged(primary, method, path, body,
+                                          fwd_headers, timeout)
+            if result is not None:
+                return result
+        else:
+            try:
+                status, rh, data = self._forward_once(
+                    primary, method, path, body, fwd_headers, timeout)
+                rh.append(("X-Fleet-Replica", primary.id))
+                return status, rh, data
+            except (http.client.HTTPException, OSError):
+                if not idempotent:
+                    return 502, [("Content-Type", "application/json")], \
+                        json.dumps({"error": "upstream connection failed"
+                                    }).encode()
+            # idempotent fall-through: retry once on another replica
+        retry = self._pick(exclude=(primary.id,)) or self._pick()
+        if retry is None:
+            return 503, [("Content-Type", "application/json")], \
+                json.dumps({"error": "no healthy replica"}).encode()
+        with self._lock:
+            self.retries += 1
+        try:
+            status, rh, data = self._forward_once(
+                retry, method, path, body, fwd_headers,
+                max(0.2, deadline - time.time()))
+            rh.append(("X-Fleet-Replica", retry.id))
+            return status, rh, data
+        except (http.client.HTTPException, OSError):
+            return 502, [("Content-Type", "application/json")], \
+                json.dumps({"error": "upstream connection failed"}).encode()
+
+    def _forward_hedged(self, primary, method, path, body, headers,
+                        timeout):
+        """Primary in a worker thread; if it is still in flight after
+        the p95-based delay, race a hedge on another replica. Returns
+        the first SUCCESSFUL result, else the primary's failure — or
+        None to signal "connection-level failure, let caller retry"."""
+        box: List = []          # (source, result-or-None)
+        done = threading.Event()
+
+        def run(r, slot):
+            try:
+                res = self._forward_once(r, method, path, body,
+                                         dict(headers), timeout)
+            except (http.client.HTTPException, OSError):
+                res = None
+            box.append((slot, r, res))
+            done.set()
+
+        t = threading.Thread(target=run, args=(primary, "primary"),
+                             daemon=True)
+        t.start()
+        done.wait(self._hedge_delay_s())
+        hedge_r = None
+        if not box:
+            hedge_r = self._pick(exclude=(primary.id,))
+            if hedge_r is not None:
+                with self._lock:
+                    self.hedges += 1
+                threading.Thread(target=run, args=(hedge_r, "hedge"),
+                                 daemon=True).start()
+        # Wait for the first result; if it's a transport failure, wait
+        # for the other copy before giving up.
+        expected = 2 if hedge_r is not None else 1
+        deadline = time.time() + timeout
+        while len(box) < expected and time.time() < deadline:
+            done.wait(0.05)
+            done.clear()
+            if box and box[0][2] is not None:
+                break
+        for slot, r, res in box:
+            if res is not None:
+                if slot == "hedge":
+                    with self._lock:
+                        self.hedge_wins += 1
+                status, rh, data = res
+                rh.append(("X-Fleet-Replica", r.id))
+                return status, rh, data
+        if len(box) >= expected:
+            return None          # every copy died at transport level
+        return 504, [("Content-Type", "application/json")], \
+            json.dumps({"error": "upstream timeout"}).encode()
+
+    # ── metrics ───────────────────────────────────────────────────────
+
+    def snapshot(self) -> dict:
+        lat = self.stats.snapshot()["routes"]
+        with self._lock:
+            replicas = {}
+            for r in self.replicas:
+                replicas[r.id] = {
+                    "base": r.base,
+                    "state": r.state,
+                    "outstanding": r.outstanding,
+                    "requests": r.requests,
+                    "errors": r.errors,
+                    "ejections": r.ejections,
+                    "consecutive_failures": r.consecutive_failures,
+                    "latency": lat.get(r.id, {"count": 0}),
+                }
+            fleet = {
+                "uptime_s": round(time.time() - self.started, 1),
+                "replica_count": len(self.replicas),
+                "inflight": self._inflight,
+                "queued": self._waiters,
+                "max_inflight": self.config.max_inflight,
+                "queue_depth": self.config.queue_depth,
+                "shed": self.shed_count,
+                "retries": self.retries,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "draining": self.draining,
+            }
+        if self.supervisor is not None:
+            sup = self.supervisor.snapshot()
+            for rid, info in sup.items():
+                if rid in replicas:
+                    replicas[rid]["supervisor"] = info
+            fleet["restarts"] = sum(i["restarts"] for i in sup.values())
+        return {"fleet": fleet, "replicas": replicas}
+
+    # ── serving ───────────────────────────────────────────────────────
+
+    def serve(self, host: str, port: int):
+        """Start the gateway's HTTP server (returns the bound server;
+        runs in a daemon thread)."""
+        gw = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def log_message(self, *args):   # structured logs only
+                pass
+
+            def _respond(self, status, headers, data):
+                try:
+                    self.send_response(status)
+                    for k, v in headers:
+                        if k.lower() in _HOP_HEADERS | {"content-length"}:
+                            continue
+                        self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def _handle(self):
+                path = self.path
+                bare = path.split("?", 1)[0]
+                if bare == "/api/metrics":
+                    return self._metrics()
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else None
+                deadline_ms = None
+                raw = self.headers.get("X-Deadline-Ms")
+                if raw:
+                    try:
+                        deadline_ms = max(1.0, float(raw))
+                    except ValueError:
+                        deadline_ms = None
+                if bare == "/api/realtime_feed":
+                    return self._stream(path)
+                status, rh, data = gw.handle(
+                    self.command, path, body, dict(self.headers.items()),
+                    deadline_ms)
+                self._respond(status, rh, data)
+
+            def _metrics(self):
+                snap = gw.snapshot()
+                if "format=prometheus" in self.path:
+                    data = _prometheus_fleet_text(snap).encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    data = json.dumps(snap).encode()
+                    ctype = "application/json"
+                self._respond(200, [("Content-Type", ctype)], data)
+
+            def _stream(self, path):
+                """SSE pass-through: pick a replica, pipe bytes until
+                either side closes. No admission queueing (streams are
+                long-lived connections, not units of work)."""
+                r = gw._pick()
+                if r is None:
+                    return self._respond(
+                        503, [("Content-Type", "application/json")],
+                        json.dumps({"error": "no healthy replica"}).encode())
+                t0 = time.perf_counter()
+                try:
+                    conn = _fresh_conn(r.host, r.port, timeout=300)
+                except OSError:
+                    gw._complete(r, ok=False, seconds=0.0)
+                    return self._respond(
+                        502, [("Content-Type", "application/json")],
+                        json.dumps({"error": "upstream connection failed"
+                                    }).encode())
+                try:
+                    fwd = {k: v for k, v in self.headers.items()
+                           if k.lower() not in _HOP_HEADERS
+                           and k.lower() != "host"}
+                    conn.request("GET", path, headers=fwd)
+                    resp = conn.getresponse()
+                    self.send_response(resp.status)
+                    for k, v in resp.getheaders():
+                        if k.lower() in _HOP_HEADERS | {"content-length"}:
+                            continue
+                        self.send_header(k, v)
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    while True:
+                        chunk = resp.read(8192)
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                    gw._complete(r, ok=True,
+                                 seconds=time.perf_counter() - t0)
+                except (http.client.HTTPException, OSError):
+                    gw._complete(r, ok=True,   # client hangup ≠ replica sick
+                                 seconds=time.perf_counter() - t0)
+                finally:
+                    conn.close()
+                    self.close_connection = True
+
+            do_GET = do_POST = do_DELETE = do_PUT = do_OPTIONS = _handle
+
+        httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                                  name="fleet-gateway")
+        thread.start()
+        _log.info("gateway_listening", host=host, port=port,
+                  replicas=[r.base for r in self.replicas])
+        return httpd
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown: stop admitting, finish inflight, stop the
+        listener."""
+        with self._cond:
+            self.draining = True
+            self._cond.notify_all()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.05)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def _prometheus_fleet_text(snapshot: dict) -> str:
+    """Fleet snapshot → Prometheus exposition format (the worker
+    endpoint's ``text/plain; version=0.0.4`` convention)."""
+
+    def esc(v: str) -> str:
+        return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+
+    fleet = snapshot["fleet"]
+    lines = []
+    gauges = ("inflight", "queued", "replica_count", "uptime_s")
+    counters = ("shed", "retries", "hedges", "hedge_wins", "restarts")
+    for key in gauges:
+        if key in fleet:
+            lines.append(f"# TYPE routest_fleet_{key} gauge")
+            lines.append(f"routest_fleet_{key} {fleet[key]}")
+    for key in counters:
+        if key in fleet:
+            lines.append(f"# TYPE routest_fleet_{key} counter")
+            lines.append(f"routest_fleet_{key} {fleet[key]}")
+    rep_counters = ("requests", "errors", "ejections")
+    rep_gauges = ("outstanding",)
+    for key in rep_counters + rep_gauges:
+        kind = "gauge" if key in rep_gauges else "counter"
+        lines.append(f"# TYPE routest_fleet_replica_{key} {kind}")
+        for rid, r in sorted(snapshot["replicas"].items()):
+            lines.append(
+                f'routest_fleet_replica_{key}{{replica="{esc(rid)}"}} '
+                f"{r[key]}")
+    lines.append("# TYPE routest_fleet_replica_up gauge")
+    lines.append("# TYPE routest_fleet_replica_latency_ms gauge")
+    for rid, r in sorted(snapshot["replicas"].items()):
+        lines.append(f'routest_fleet_replica_up{{replica="{esc(rid)}"}} '
+                     f"{int(r['state'] != OPEN)}")
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            if q in r.get("latency", {}):
+                lines.append(
+                    f'routest_fleet_replica_latency_ms{{replica='
+                    f'"{esc(rid)}",quantile="{q[:-3]}"}} '
+                    f"{r['latency'][q]}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,239 @@
+"""Replica supervisor: keep N worker processes alive, restart crashes.
+
+Each replica is a full server process (default command:
+``python -m routest_tpu.serve`` with ``PORT`` set) — shared-nothing, so
+a crash takes out one batcher, not the fleet. The monitor thread
+detects exits AND failed health probes (``/up``), restarts with capped
+exponential backoff (a worker that keeps dying must not busy-loop the
+host), and resets the backoff once a worker has been up long enough to
+count as stable. ``drain()`` is the SIGTERM path: TERM every child,
+wait, KILL stragglers.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence
+
+from routest_tpu.utils.logging import get_logger
+
+_log = get_logger("routest_tpu.fleet.supervisor")
+
+
+def default_worker_command(port: int) -> List[str]:
+    # The existing single-process stack IS the worker; the supervisor
+    # only multiplies it.
+    return [sys.executable, "-m", "routest_tpu.serve"]
+
+
+class _Replica:
+    __slots__ = ("index", "port", "proc", "restarts", "started_at",
+                 "next_start_at", "consecutive_crashes", "health_failures",
+                 "last_exit_code", "last_probe_at", "ever_up", "waiting")
+
+    def __init__(self, index: int, port: int) -> None:
+        self.index = index
+        self.port = port
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.started_at = 0.0
+        self.next_start_at = 0.0          # backoff gate for the next spawn
+        self.consecutive_crashes = 0
+        self.health_failures = 0
+        self.last_exit_code: Optional[int] = None
+        self.last_probe_at = 0.0
+        # Startup-probe semantics: liveness failures only count once the
+        # worker has answered /up at least once this incarnation — a
+        # slow boot (JAX import + bucket warm is tens of seconds) must
+        # not be killed into a restart loop.
+        self.ever_up = False
+        self.waiting = False              # crashed, sitting out backoff
+
+
+class ReplicaSupervisor:
+    """Spawn + babysit one worker process per port.
+
+    ``command`` maps a port to an argv (tests substitute a cheap stub
+    worker); ``env`` is the base environment — ``PORT`` is set per
+    worker. A worker is restarted when its process exits OR when
+    ``unhealthy_after`` consecutive ``/up`` probes fail (hung-but-alive
+    processes are indistinguishable from dead ones to callers).
+    """
+
+    # A worker that stayed up this long gets its crash backoff reset.
+    STABLE_RESET_S = 30.0
+
+    def __init__(self, ports: Sequence[int],
+                 command: Optional[Callable[[int], List[str]]] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None,
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 2.0,
+                 unhealthy_after: int = 3,
+                 backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 30.0,
+                 health_path: str = "/up",
+                 quiet: bool = True) -> None:
+        self._replicas = [_Replica(i, p) for i, p in enumerate(ports)]
+        self._command = command or default_worker_command
+        self._env = dict(env if env is not None else os.environ)
+        self._cwd = cwd
+        self._probe_interval_s = probe_interval_s
+        self._probe_timeout_s = probe_timeout_s
+        self._unhealthy_after = max(1, unhealthy_after)
+        self._backoff_base_s = backoff_base_s
+        self._backoff_cap_s = backoff_cap_s
+        self._health_path = health_path
+        self._quiet = quiet
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ── lifecycle ──────────────────────────────────────────────────────
+
+    @property
+    def ports(self) -> List[int]:
+        return [r.port for r in self._replicas]
+
+    def start(self) -> None:
+        for r in self._replicas:
+            self._spawn(r)
+        self._thread = threading.Thread(target=self._monitor, daemon=True,
+                                        name="fleet-supervisor")
+        self._thread.start()
+
+    def _spawn(self, r: _Replica) -> None:
+        env = dict(self._env)
+        env["PORT"] = str(r.port)
+        out = subprocess.DEVNULL if self._quiet else None
+        r.proc = subprocess.Popen(self._command(r.port), env=env,
+                                  cwd=self._cwd, stdout=out, stderr=out)
+        r.started_at = time.time()
+        r.health_failures = 0
+        r.ever_up = False
+        r.waiting = False
+        r.last_exit_code = None
+        _log.info("replica_spawned", index=r.index, port=r.port,
+                  pid=r.proc.pid, restarts=r.restarts)
+
+    def ready(self, timeout: float = 240.0) -> bool:
+        """Block until every replica answers its health probe."""
+        deadline = time.time() + timeout
+        for r in self._replicas:
+            while time.time() < deadline and not self._stopping.is_set():
+                if self._probe(r.port):
+                    break
+                time.sleep(0.2)
+            else:
+                return False
+        return True
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful stop: TERM everyone, wait, KILL stragglers."""
+        self._stopping.set()
+        with self._lock:
+            procs = [r.proc for r in self._replicas
+                     if r.proc is not None and r.proc.poll() is None]
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.time() + timeout
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ── monitoring ─────────────────────────────────────────────────────
+
+    def _probe(self, port: int) -> bool:
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{self._health_path}")
+            with urllib.request.urlopen(
+                    req, timeout=self._probe_timeout_s) as resp:
+                return 200 <= resp.status < 400
+        except Exception:
+            return False
+
+    def _backoff_s(self, r: _Replica) -> float:
+        return min(self._backoff_cap_s,
+                   self._backoff_base_s * (2 ** max(0, r.consecutive_crashes - 1)))
+
+    def _note_crash(self, r: _Replica) -> None:
+        # Stable-for-a-while workers crash with a fresh backoff clock.
+        if time.time() - r.started_at > self.STABLE_RESET_S:
+            r.consecutive_crashes = 0
+        r.consecutive_crashes += 1
+        r.restarts += 1
+        r.next_start_at = time.time() + self._backoff_s(r)
+
+    def _monitor(self) -> None:
+        while not self._stopping.is_set():
+            for r in self._replicas:
+                now = time.time()
+                with self._lock:
+                    if self._stopping.is_set() or r.proc is None:
+                        continue
+                    code = r.proc.poll()
+                    if code is not None:
+                        if not r.waiting:
+                            r.waiting = True
+                            r.last_exit_code = code
+                            self._note_crash(r)
+                            _log.error("replica_exited", index=r.index,
+                                       port=r.port, code=code,
+                                       backoff_s=round(
+                                           r.next_start_at - now, 2))
+                        elif r.next_start_at <= now:
+                            self._spawn(r)
+                        continue
+                # Alive — liveness probe OUTSIDE the lock (2 s timeout
+                # each; holding the lock would stall drain()).
+                if now - r.last_probe_at < self._probe_interval_s:
+                    continue
+                r.last_probe_at = now
+                if self._probe(r.port):
+                    r.ever_up = True
+                    r.health_failures = 0
+                    if now - r.started_at > self.STABLE_RESET_S:
+                        r.consecutive_crashes = 0
+                elif r.ever_up:
+                    r.health_failures += 1
+                    if r.health_failures >= self._unhealthy_after:
+                        _log.error("replica_unresponsive", index=r.index,
+                                   port=r.port, failures=r.health_failures)
+                        with self._lock:
+                            if r.proc is not None:
+                                try:
+                                    r.proc.kill()
+                                except OSError:
+                                    pass
+                        # the exit is picked up next tick → backoff path
+            self._stopping.wait(min(0.2, self._probe_interval_s))
+
+    # ── observability ──────────────────────────────────────────────────
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            out = {}
+            for r in self._replicas:
+                alive = r.proc is not None and r.proc.poll() is None
+                out[f"r{r.index}"] = {
+                    "port": r.port,
+                    "alive": alive,
+                    "restarts": r.restarts,
+                    "uptime_s": round(time.time() - r.started_at, 1)
+                    if alive else 0.0,
+                }
+            return out

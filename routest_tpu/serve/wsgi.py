@@ -28,10 +28,17 @@ _PARAM_RE = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
 # than injecting arbitrary bytes into every structured log line.
 _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
-# Origins the reference allows (Flaskr/__init__.py CORS config).
-_ALLOWED_ORIGIN_RE = re.compile(
-    r"^https?://localhost:3000$|^https?://127\.0\.0\.1:3000$|^https://[a-z0-9-]+\.vercel\.app$"
+# Origins the reference allows (Flaskr/__init__.py CORS config), split
+# by trust (ADVICE r5): the localhost dev origins plus the configured
+# production frontend (``ROUTEST_FRONTEND_ORIGIN``) get credentialed
+# CORS (cookies + the XSRF header); the ``*.vercel.app`` wildcard stays
+# reachable but CREDENTIAL-LESS — any Vercel tenant can host an origin
+# matching it, and Allow-Credentials on an attacker-controllable
+# pattern hands every preview deployment the user's session.
+_CREDENTIALED_ORIGIN_RE = re.compile(
+    r"^https?://localhost:3000$|^https?://127\.0\.0\.1:3000$"
 )
+_PUBLIC_ORIGIN_RE = re.compile(r"^https://[a-z0-9-]+\.vercel\.app$")
 
 
 def json_response(payload: Any, status: int = 200,
@@ -141,19 +148,30 @@ class App:
     @staticmethod
     def _apply_cors(request: Request, response: Response) -> None:
         origin = request.headers.get("Origin", "")
-        if origin and _ALLOWED_ORIGIN_RE.match(origin):
-            response.headers["Access-Control-Allow-Origin"] = origin
-            response.headers["Vary"] = "Origin"
+        if not origin:
+            return
+        credentialed = bool(_CREDENTIALED_ORIGIN_RE.match(origin)) or \
+            origin == os.environ.get("ROUTEST_FRONTEND_ORIGIN")
+        if not credentialed and not _PUBLIC_ORIGIN_RE.match(origin):
+            return
+        response.headers["Access-Control-Allow-Origin"] = origin
+        response.headers["Vary"] = "Origin"
+        response.headers["Access-Control-Allow-Methods"] = \
+            "GET, POST, DELETE, OPTIONS"
+        if credentialed:
             # X-XSRF-TOKEN + credentials: the Sanctum SPA cookie mode
-            # must work from the allowed cross-origin frontend (the
+            # must work from the TRUSTED cross-origin frontend (the
             # browser drops cookies without Allow-Credentials, and the
             # unsafe-method preflight must admit the CSRF header).
             # Allow-Origin is always a specific echoed origin here,
             # never "*", so credentials mode is spec-legal.
             response.headers["Access-Control-Allow-Headers"] = \
                 "Content-Type, Authorization, X-XSRF-TOKEN"
-            response.headers["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
             response.headers["Access-Control-Allow-Credentials"] = "true"
+        else:
+            # Wildcard-matched origins: bearer-token API use only.
+            response.headers["Access-Control-Allow-Headers"] = \
+                "Content-Type, Authorization"
 
 
 def _max_body_bytes() -> int:

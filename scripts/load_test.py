@@ -575,6 +575,12 @@ def main() -> None:
                         help="batch requests per batch worker")
     parser.add_argument("--batch-threads", type=int, default=4,
                         help="concurrent batch clients")
+    parser.add_argument("--out", default=None,
+                        help="report artifact path (default: artifacts/"
+                             "load_test.json, or load_test_tpu.json on "
+                             "an accelerator backend). Name it for "
+                             "one-off runs so the canonical artifacts "
+                             "survive")
     args = parser.parse_args()
     # NB: --cpu configures the SERVER subprocess (via ROUTEST_FORCE_CPU
     # below); the load generator itself never touches jax.
@@ -735,9 +741,12 @@ def main() -> None:
         print(f"FAIL: {section} p95 {p95} ms exceeds budget {budget} ms",
               file=sys.stderr)
     name = "load_test_tpu.json" if on_tpu else "load_test.json"
-    out = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                       "artifacts", name)
-    os.makedirs(os.path.dirname(out), exist_ok=True)
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", name)
+    out_dir = os.path.dirname(out)
+    if out_dir:  # bare filename ⇒ cwd; makedirs("") would raise
+        os.makedirs(out_dir, exist_ok=True)
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
     print(f"[load_test] report → {out}", file=sys.stderr)

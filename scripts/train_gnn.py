@@ -68,6 +68,14 @@ def main() -> None:
                         help="hermetic 8-virtual-device CPU mesh (use when "
                              "the TPU tunnel is unavailable)")
     args = parser.parse_args()
+    if args.report_out:
+        # Resolve (and create) the report directory NOW: a bare filename
+        # has an empty dirname (makedirs("") raises), and an unwritable
+        # path must fail here, before hours of training, not after.
+        args.report_out = os.path.abspath(args.report_out)
+        report_dir = os.path.dirname(args.report_out)
+        if report_dir:
+            os.makedirs(report_dir, exist_ok=True)
     if args.quick:
         args.nodes, args.steps = 512, 120
     if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
@@ -220,7 +228,9 @@ def main() -> None:
     out = args.report_out or os.path.join(
         repo, "artifacts",
         "gnn_report_osm.json" if args.osm else "gnn_report.json")
-    os.makedirs(os.path.dirname(out), exist_ok=True)
+    out_dir = os.path.dirname(out)
+    if out_dir:  # bare filename ⇒ cwd; makedirs("") would raise
+        os.makedirs(out_dir, exist_ok=True)
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
     print(f"      report → {out}")

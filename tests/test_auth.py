@@ -130,7 +130,8 @@ def test_email_verification_flow(client):
                     headers={"Authorization": f"Bearer {token}"})
     assert r.status_code == 200
     url = r.get_json()["verify_url"]
-    assert url.endswith(verify_email_hash("ana@example.com"))
+    assert verify_email_hash("ana@example.com") in url
+    assert "expires=" in url and "signature=" in url  # Laravel signed URL
 
     assert client.get(url).status_code == 401  # needs the bearer
     r = client.get(url, headers={"Authorization": f"Bearer {token}"})
@@ -141,6 +142,78 @@ def test_email_verification_flow(client):
     bad = f"/api/auth/verify-email/{user['id']}/deadbeef"
     assert client.get(bad, headers={
         "Authorization": f"Bearer {token}"}).status_code == 403
+
+
+def test_verify_link_signature_tampering_rejected(client):
+    r = _register(client, email="sig@example.com")
+    token = r.get_json()["token"]
+    hdr = {"Authorization": f"Bearer {token}"}
+    url = client.post("/api/auth/email/verification-notification",
+                      headers=hdr).get_json()["verify_url"]
+
+    # breaking the signature breaks the link, even with a correct hash
+    assert client.get(url.replace("signature=", "signature=0"),
+                      headers=hdr).status_code == 403
+    # extending the expiry without re-signing breaks the link too
+    import re
+
+    stretched = re.sub(r"expires=(\d+)",
+                       lambda m: f"expires={int(m.group(1)) + 99999}", url)
+    assert client.get(stretched, headers=hdr).status_code == 403
+    # stripping the signed query entirely: forgeable pre-fix shape → 403
+    assert client.get(url.split("?")[0], headers=hdr).status_code == 403
+    # the untouched link still verifies
+    r = client.get(url, headers=hdr)
+    assert r.status_code == 200 and r.get_json()["verified"] is True
+
+
+def test_verify_link_expires_and_secret_scoped():
+    from urllib.parse import parse_qs, urlsplit
+
+    auth = AuthService(secret="server-key")
+    user, token = auth.register("E", "e@example.com", "s3cretpass")
+    url = auth.signed_verify_url(user["id"], "e@example.com", now=1000.0)
+    q = parse_qs(urlsplit(url).query)
+    email_hash = verify_email_hash("e@example.com")
+    args = (token, user["id"], email_hash, q["expires"][0],
+            q["signature"][0])
+
+    # past the TTL the signature is still valid but the link is dead
+    with pytest.raises(ValueError, match="expired"):
+        auth.verify_email(*args, now=1000.0 + AuthService.VERIFY_TTL_S + 1)
+    # a different server secret cannot mint acceptable links
+    other = AuthService(secret="attacker-key")
+    forged = other.signed_verify_url(user["id"], "e@example.com", now=1000.0)
+    fq = parse_qs(urlsplit(forged).query)
+    with pytest.raises(ValueError, match="invalid"):
+        auth.verify_email(token, user["id"], email_hash,
+                          fq["expires"][0], fq["signature"][0],
+                          now=1001.0)
+    # inside the TTL with the right secret it verifies
+    assert auth.verify_email(*args, now=1000.0 + 60) is True
+
+
+def test_cookies_secure_on_https_or_env(client, monkeypatch):
+    # plain HTTP, no env: cookies stay un-Secure (dev default)
+    r = client.get("/sanctum/csrf-cookie")
+    assert "Secure" not in r.headers["Set-Cookie"]
+    # HTTPS request scheme → Secure
+    r = client.get("/sanctum/csrf-cookie", base_url="https://localhost/")
+    assert "Secure" in r.headers["Set-Cookie"]
+    # forced via env (TLS-terminating proxy that strips forwarding hdrs)
+    monkeypatch.setenv("ROUTEST_SECURE_COOKIES", "1")
+    r = client.get("/sanctum/csrf-cookie")
+    assert "Secure" in r.headers["Set-Cookie"]
+    monkeypatch.delenv("ROUTEST_SECURE_COOKIES")
+    # session cookie honors X-Forwarded-Proto from the TLS proxy
+    xsrf = _csrf_pair(client)
+    r = client.post("/api/auth/register",
+                    json={"name": "S", "email": "sec@example.com",
+                          "password": "s3cretpass"},
+                    headers={"X-XSRF-TOKEN": xsrf,
+                             "X-Forwarded-Proto": "https"})
+    cookies = r.headers.get_all("Set-Cookie")
+    assert any("routest_session" in c and "Secure" in c for c in cookies)
 
 
 def test_auth_required_gates_history_delete(model_artifact):
@@ -312,11 +385,28 @@ def test_file_mailer_appends_parseable_lines(tmp_path):
     assert make_mailer({}) is None
 
 
+def _jar_cookie(client, name):
+    """Cookie from the test client's jar across werkzeug versions:
+    ``Client.get_cookie`` arrived in 2.3; older clients expose the
+    stdlib ``cookie_jar``. Returns an object with ``.value`` and
+    ``.http_only`` or None."""
+    get = getattr(client, "get_cookie", None)
+    if get is not None:
+        return get(name)
+    for cookie in client.cookie_jar:
+        if cookie.name == name:
+            class _C:
+                value = cookie.value
+                http_only = "HttpOnly" in str(cookie._rest or {})
+            return _C()
+    return None
+
+
 def _csrf_pair(client):
     """Do the Sanctum SPA handshake; return the XSRF token to echo."""
     r = client.get("/sanctum/csrf-cookie")
     assert r.status_code == 204
-    cookie = client.get_cookie("XSRF-TOKEN")
+    cookie = _jar_cookie(client, "XSRF-TOKEN")
     assert cookie is not None
     return cookie.value
 
@@ -332,7 +422,7 @@ def test_sanctum_cookie_spa_flow(client):
                           "password": "s3cretpass"},
                     headers={"X-XSRF-TOKEN": xsrf})
     assert r.status_code == 201
-    session = client.get_cookie("routest_session")
+    session = _jar_cookie(client, "routest_session")
     assert session is not None and session.http_only
     # cookie-only identity on a safe method (no Authorization header)
     r = client.get("/api/user")
@@ -357,7 +447,7 @@ def test_sanctum_unsafe_methods_require_csrf_header(model_artifact,
                json={"name": "C", "email": "csrf@example.com",
                      "password": "s3cretpass"},
                headers={"X-XSRF-TOKEN": xsrf})
-    assert r.status_code == 201 and c.get_cookie("routest_session")
+    assert r.status_code == 201 and _jar_cookie(c, "routest_session")
     # create a history row to delete
     r = c.post("/api/optimize_route", json={
         "source_point": {"lat": 14.5836, "lon": 121.0409},

@@ -420,6 +420,28 @@ def test_cors_headers(client):
     assert r.headers.get("Access-Control-Allow-Origin") == "https://my-app.vercel.app"
 
 
+def test_cors_vercel_wildcard_is_credential_less(client):
+    # Any Vercel tenant matches the wildcard → it must never receive
+    # Allow-Credentials (cookie-mode auth stays scoped to trusted
+    # origins); bearer-token API use keeps working.
+    r = client.get("/api/ping", headers={"Origin": "https://my-app.vercel.app"})
+    assert "Access-Control-Allow-Credentials" not in r.headers
+    assert "X-XSRF-TOKEN" not in r.headers.get("Access-Control-Allow-Headers", "")
+    assert "Authorization" in r.headers.get("Access-Control-Allow-Headers", "")
+
+
+def test_cors_configured_frontend_origin_credentialed(client, monkeypatch):
+    origin = "https://fleet.example.com"
+    monkeypatch.setenv("ROUTEST_FRONTEND_ORIGIN", origin)
+    r = client.get("/api/ping", headers={"Origin": origin})
+    assert r.headers.get("Access-Control-Allow-Origin") == origin
+    assert r.headers.get("Access-Control-Allow-Credentials") == "true"
+    assert "X-XSRF-TOKEN" in r.headers.get("Access-Control-Allow-Headers", "")
+    # …and only THAT origin: a sibling host gets nothing
+    r = client.get("/api/ping", headers={"Origin": "https://other.example.com"})
+    assert "Access-Control-Allow-Origin" not in r.headers
+
+
 def test_method_not_allowed(client):
     r = client.get("/api/predict_eta")
     assert r.status_code == 405
