@@ -126,12 +126,35 @@ class FleetConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability spine (``routest_tpu/obs``): request tracing +
+    unified metrics registry. All knobs are ``RTPU_OBS_*`` env vars.
+
+    ``sample_rate`` is the head-based trace sampling probability decided
+    at the first hop (gateway or replica edge) and propagated via the
+    W3C ``traceparent`` flags, so a trace records everywhere or nowhere.
+    ``trace_export_path`` appends every finished sampled span as one
+    JSON line (the bounded in-memory buffer behind ``/api/trace`` is a
+    flight recorder, not storage). ``device_trace_dir`` attaches a
+    TensorBoard xplane capture to at most ``device_trace_max`` sampled
+    batcher flushes per process."""
+
+    enabled: bool = True
+    sample_rate: float = 1.0
+    buffer_spans: int = 2048
+    trace_export_path: Optional[str] = None
+    device_trace_dir: Optional[str] = None
+    device_trace_max: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -193,6 +216,14 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         ors_api_key=_env(env, "ORS_API_KEY", "OPENROUTESERVICE_API_KEY"),
         version=_env(env, "RENDER_GIT_COMMIT", "GIT_COMMIT_SHA"),
     )
+    obs = ObsConfig(
+        enabled=env.get("RTPU_OBS_TRACE", "1") != "0",
+        sample_rate=_float("RTPU_OBS_SAMPLE", 1.0),
+        buffer_spans=_int("RTPU_OBS_BUFFER", 2048),
+        trace_export_path=env.get("RTPU_OBS_EXPORT_PATH"),
+        device_trace_dir=env.get("RTPU_OBS_DEVICE_TRACE_DIR"),
+        device_trace_max=_int("RTPU_OBS_DEVICE_TRACE_MAX", 1),
+    )
     fleet = FleetConfig(
         replicas=_int("RTPU_FLEET_REPLICAS", 2),
         gateway_host=env.get("RTPU_GATEWAY_HOST", "127.0.0.1"),
@@ -212,4 +243,28 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         unhealthy_after=_int("RTPU_FLEET_UNHEALTHY_AFTER", 3),
     )
     return Config(mesh=mesh, model=model, train=train, serve=serve,
-                  fleet=fleet)
+                  fleet=fleet, obs=obs)
+
+
+def load_obs_config(env: Optional[Mapping[str, str]] = None) -> ObsConfig:
+    """Just the observability knobs (the obs package reads these lazily
+    at first-tracer-use without paying for a full Config build)."""
+    env = dict(env if env is not None else os.environ)
+
+    def _num(name: str, default, cast):
+        raw = env.get(name)
+        if not raw:
+            return default
+        try:
+            return cast(raw)
+        except ValueError:
+            return default  # ops knob: malformed value must not abort boot
+
+    return ObsConfig(
+        enabled=env.get("RTPU_OBS_TRACE", "1") != "0",
+        sample_rate=_num("RTPU_OBS_SAMPLE", 1.0, float),
+        buffer_spans=_num("RTPU_OBS_BUFFER", 2048, int),
+        trace_export_path=env.get("RTPU_OBS_EXPORT_PATH"),
+        device_trace_dir=env.get("RTPU_OBS_DEVICE_TRACE_DIR"),
+        device_trace_max=_num("RTPU_OBS_DEVICE_TRACE_MAX", 1, int),
+    )

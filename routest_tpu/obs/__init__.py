@@ -1,0 +1,27 @@
+"""Observability spine: request tracing + one process-wide metrics registry.
+
+Three pieces (ISSUE 2; Dapper §2, W3C Trace Context):
+
+- ``trace``   — a sampling :class:`Tracer` producing :class:`Span`s with
+  contextvar-carried parentage and ``traceparent`` inject/extract, so one
+  trace id survives client → gateway → replica → batcher → device;
+- ``registry`` — process-wide counters/gauges/histograms (fixed log-scale
+  buckets) behind one API, exported as JSON and Prometheus text;
+- ``export``  — bounded in-memory span buffer with JSONL and Chrome
+  ``trace_event`` dumps, plus the optional per-span device-trace hook.
+
+Everything here is stdlib-only (the fleet gateway imports it) and safe to
+call on hot paths: an unsampled span is one small object and two
+contextvar operations; a disabled tracer is a shared no-op.
+"""
+
+from routest_tpu.obs.export import (SpanBuffer, to_chrome_trace,  # noqa: F401
+                                    to_jsonl)
+from routest_tpu.obs.registry import (DEFAULT_TIME_BUCKETS,  # noqa: F401
+                                      MetricsRegistry, get_registry)
+from routest_tpu.obs.trace import (CURRENT, REQUEST_ID_RE,  # noqa: F401
+                                   Span, SpanContext, Tracer,
+                                   configure_tracer, current_context,
+                                   format_traceparent, get_tracer,
+                                   mint_request_id, parse_traceparent,
+                                   trace_span)

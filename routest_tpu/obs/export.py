@@ -1,0 +1,123 @@
+"""Span sinks: bounded in-memory buffer, JSONL, Chrome trace_event JSON,
+and the per-span device-trace hook.
+
+The buffer is the debug surface behind ``/api/trace``: newest-last,
+bounded (old spans fall off — this is a flight recorder, not storage).
+``to_chrome_trace`` renders spans as complete ("X") trace events loadable
+directly in ``chrome://tracing`` / Perfetto, one row per thread, with the
+trace/span ids in ``args`` so a row correlates back to log lines by
+request id.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+from typing import Iterable, List, Optional
+
+
+class SpanBuffer:
+    """Thread-safe bounded ring of finished span records (plain dicts)."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self._deque: collections.deque = collections.deque(
+            maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def add(self, rec: dict) -> None:
+        with self._lock:
+            if len(self._deque) == self._deque.maxlen:
+                self.dropped += 1
+            self._deque.append(rec)
+
+    def snapshot(self, trace_id: Optional[str] = None,
+                 limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            spans = list(self._deque)
+        if trace_id is not None:
+            spans = [s for s in spans if s.get("trace_id") == trace_id]
+        if limit is not None and limit >= 0:
+            spans = spans[-limit:]
+        return spans
+
+    def clear(self) -> None:
+        with self._lock:
+            self._deque.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._deque)
+
+
+def to_jsonl(spans: Iterable[dict]) -> str:
+    return "".join(json.dumps(s, default=str) + "\n" for s in spans)
+
+
+def to_chrome_trace(spans: Iterable[dict]) -> dict:
+    """Chrome trace_event JSON (the Trace Event Format's "X" complete
+    events): ts/dur in microseconds, pid = this process, tid = the
+    recording thread, ids and attrs under args."""
+    pid = os.getpid()
+    events = []
+    for s in spans:
+        events.append({
+            "name": s.get("name", "?"),
+            "ph": "X",
+            "ts": float(s.get("start_unix", 0.0)) * 1e6,
+            "dur": float(s.get("duration_ms") or 0.0) * 1e3,
+            "pid": pid,
+            "tid": s.get("thread", 0),
+            "cat": s.get("status", "ok"),
+            "args": {
+                "trace_id": s.get("trace_id"),
+                "span_id": s.get("span_id"),
+                "parent_id": s.get("parent_id"),
+                **(s.get("attrs") or {}),
+            },
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ── device-trace attachment ──────────────────────────────────────────
+
+_device_trace_lock = threading.Lock()
+_device_traces_taken = 0
+
+
+def maybe_device_trace(span):
+    """Context manager: a TensorBoard xplane device trace for THIS span,
+    when (a) the span is sampled, (b) ``RTPU_OBS_DEVICE_TRACE_DIR`` (or
+    ObsConfig.device_trace_dir) names a directory, and (c) the per-process
+    budget (``RTPU_OBS_DEVICE_TRACE_MAX``, default 1 — xplane captures
+    are heavyweight) has not run out. The capture directory is stamped
+    with the trace and span ids, so ``chrome://tracing`` rows, log lines,
+    and the xplane profile all correlate through one trace id. Returns a
+    null context otherwise."""
+    import contextlib
+
+    if span is None or not getattr(span, "sampled", False):
+        return contextlib.nullcontext()
+    # Fast path first: this runs on every sampled flush, and building a
+    # full ObsConfig (an os.environ copy) per flush is measurable — one
+    # env lookup decides the common no-capture case.
+    if not os.environ.get("RTPU_OBS_DEVICE_TRACE_DIR"):
+        return contextlib.nullcontext()
+    from routest_tpu.core.config import load_obs_config
+
+    obs = load_obs_config()
+    if not obs.device_trace_dir:
+        return contextlib.nullcontext()
+    global _device_traces_taken
+    with _device_trace_lock:
+        if _device_traces_taken >= obs.device_trace_max:
+            return contextlib.nullcontext()
+        _device_traces_taken += 1
+    log_dir = os.path.join(obs.device_trace_dir,
+                           f"xplane_{span.trace_id}_{span.span_id}")
+    span.set_attr("device_trace_dir", log_dir)
+    from routest_tpu.utils.profiling import device_trace
+
+    return device_trace(log_dir)

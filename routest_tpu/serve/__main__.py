@@ -3,7 +3,9 @@
 Equivalent of the reference's ``app.py`` dev entry (Flask dev server on
 :5000); honors the same PORT env var. If no model artifact exists yet, a
 quick synthetic training run materializes one so the service comes up
-fully functional out of the box.
+fully functional out of the box. Boot status goes through the
+structured ``JsonLogger`` like every other event in the stack — the
+bare-print era is closed by ``tests/test_no_bare_print.py``.
 """
 
 from __future__ import annotations
@@ -15,12 +17,16 @@ from werkzeug.serving import run_simple
 from routest_tpu.core.config import load_config
 from routest_tpu.serve.app import create_app
 from routest_tpu.train.checkpoint import default_model_path
+from routest_tpu.utils.logging import get_logger
+
+_log = get_logger("routest_tpu.serve.boot")
 
 
 def ensure_model(path: str) -> None:
     if os.path.exists(path):
         return
-    print(f"[serve] no model artifact at {path}; training a quick one …")
+    _log.info("model_bootstrap_started", path=path,
+              reason="no artifact; training a quick synthetic model")
     from routest_tpu.core.config import TrainConfig
     from routest_tpu.data.synthetic import generate_dataset, train_eval_split
     from routest_tpu.models.eta_mlp import EtaMLP
@@ -31,7 +37,8 @@ def ensure_model(path: str) -> None:
     model = EtaMLP()
     result = fit(model, train, ev, TrainConfig(epochs=15))
     save_model(path, model, result.state.params)
-    print(f"[serve] trained (eval RMSE {result.eval_rmse:.2f} min) → {path}")
+    _log.info("model_bootstrap_finished", path=path,
+              eval_rmse_min=round(result.eval_rmse, 2))
 
 
 def main() -> None:
@@ -52,7 +59,7 @@ def main() -> None:
 
     cache_dir = enable_compile_cache()
     if cache_dir:
-        print(f"[serve] persistent compile cache at {cache_dir}")
+        _log.info("compile_cache_enabled", dir=cache_dir)
     config = load_config()
     ensure_model(default_model_path(config.model))
     # Production serving shards the OD batch over every visible device
@@ -73,8 +80,8 @@ def main() -> None:
         want = mesh_pref == "1" or jax.default_backend() not in ("cpu",)
         if want and len(devices) > 1:
             runtime = MeshRuntime.create(config.mesh)
-            print(f"[serve] mesh serving over {runtime.n_data} data shards "
-                  f"({len(devices)} devices)")
+            _log.info("mesh_serving", data_shards=runtime.n_data,
+                      devices=len(devices))
     from routest_tpu.serve.ml_service import EtaService
 
     eta = EtaService(config.serve,
@@ -83,8 +90,7 @@ def main() -> None:
     if config.serve.reload_sec > 0:
         # EtaService started the watcher itself (it owns the lifecycle);
         # just surface it on the boot line.
-        print(f"[serve] model hot-reload watcher every "
-              f"{config.serve.reload_sec:g}s")
+        _log.info("hot_reload_watcher", interval_s=config.serve.reload_sec)
     app = create_app(config, eta_service=eta)
     # HTTP/1.1 keep-alive: werkzeug defaults to 1.0 (connection-per-
     # request), which taxes every call with TCP setup + a fresh handler
@@ -93,7 +99,8 @@ def main() -> None:
     from werkzeug.serving import WSGIRequestHandler
 
     WSGIRequestHandler.protocol_version = "HTTP/1.1"
-    print(f"[serve] listening on {config.serve.host}:{config.serve.port}")
+    _log.info("serve_listening", host=config.serve.host,
+              port=config.serve.port)
     run_simple(config.serve.host, config.serve.port, app, threaded=True)
 
 

@@ -570,17 +570,49 @@ def create_app(config: Optional[Config] = None,
     @app.route("/api/metrics", methods=("GET",))
     def metrics(request):
         # TPU-era observability (SURVEY.md §5.5): per-route latency
-        # percentiles + batcher gauges, additive to the reference ABI.
-        # ?format=prometheus renders the same data in the exposition
-        # format every scraper speaks.
+        # percentiles + batcher gauges, additive to the reference ABI,
+        # plus the unified process registry (batcher stage histograms,
+        # store/netbus op latencies, train metrics) — ISSUE 2's one-API
+        # view. ?format=prometheus renders the same data in the
+        # exposition format every scraper speaks.
+        from routest_tpu.obs import get_registry
+
         snapshot = {
             "http": app.request_stats.snapshot(),
             "batcher": state.eta.stats,
         }
         if request.args.get("format") == "prometheus":
-            return Response(_prometheus_text(snapshot), 200,
+            text = _prometheus_text(snapshot) + \
+                get_registry().prometheus_text()
+            return Response(text, 200,
                             mimetype="text/plain; version=0.0.4")
+        snapshot["registry"] = get_registry().snapshot()
         return snapshot, 200
+
+    @app.route("/api/trace", methods=("GET",))
+    def trace_dump(request):
+        # Span flight recorder (bounded ring; RTPU_OBS_* knobs): raw
+        # span JSON by default; ?format=chrome emits Trace Event JSON
+        # loadable in chrome://tracing / Perfetto; ?trace_id= narrows to
+        # one request's tree; ?limit=N tails the buffer.
+        from routest_tpu.obs import to_chrome_trace
+        from routest_tpu.obs.trace import get_tracer
+
+        buf = get_tracer().buffer
+        spans = buf.snapshot(trace_id=request.args.get("trace_id") or None)
+        raw_limit = request.args.get("limit", "")
+        if raw_limit.isdigit():
+            spans = spans[-int(raw_limit):]
+        import json as _json
+
+        payload = (to_chrome_trace(spans)
+                   if request.args.get("format") == "chrome"
+                   else {"count": len(spans), "dropped": buf.dropped,
+                         "spans": spans})
+        # default=str: span attrs are caller-supplied (numpy scalars,
+        # exceptions) — a dump endpoint must render them, not 500.
+        return Response(_json.dumps(payload, default=str), 200,
+                        mimetype="application/json")
 
     @app.route("/api/health", methods=("GET",))
     def health(request):

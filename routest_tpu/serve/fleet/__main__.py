@@ -7,7 +7,8 @@ than one replica and no ``REDIS_URL`` configured, a hermetic TCP broker
 (``serve/netbus.py``) is started so SSE events cross replicas — the
 same wiring ``scripts/load_test.py --workers N`` uses. SIGTERM/SIGINT
 drain gracefully: the gateway stops admitting and finishes inflight
-requests, then the workers get SIGTERM.
+requests, then the workers get SIGTERM. Lifecycle status is structured
+``JsonLogger`` events (one JSON object per line on stderr).
 """
 
 from __future__ import annotations
@@ -20,6 +21,9 @@ import threading
 from routest_tpu.core.config import load_config
 from routest_tpu.serve.fleet.gateway import Gateway
 from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
+from routest_tpu.utils.logging import get_logger
+
+_log = get_logger("routest_tpu.fleet.boot")
 
 
 def main() -> None:
@@ -35,7 +39,7 @@ def main() -> None:
 
         broker, _ = start_broker()
         env["REDIS_URL"] = f"tcp://127.0.0.1:{broker.port}"
-        print(f"[fleet] SSE broker at {env['REDIS_URL']}")
+        _log.info("sse_broker_started", url=env["REDIS_URL"])
 
     supervisor = ReplicaSupervisor(
         ports, env=env,
@@ -45,18 +49,18 @@ def main() -> None:
         backoff_cap_s=fleet.backoff_cap_s,
         quiet=False)
     supervisor.start()
-    print(f"[fleet] supervising {n} replica(s) on ports {ports}")
+    _log.info("supervising", replicas=n, ports=ports)
     if not supervisor.ready(timeout=300):
-        print("[fleet] replicas never became ready", file=sys.stderr)
+        _log.error("replicas_never_ready", ports=ports)
         supervisor.drain(timeout=10)
         sys.exit(2)
 
     gateway = Gateway([("127.0.0.1", p) for p in ports], fleet,
                       supervisor=supervisor)
     gateway.serve(fleet.gateway_host, fleet.gateway_port)
-    print(f"[fleet] gateway on "
-          f"http://{fleet.gateway_host}:{fleet.gateway_port} "
-          f"(replicas: {', '.join(f'127.0.0.1:{p}' for p in ports)})")
+    _log.info("gateway_up",
+              url=f"http://{fleet.gateway_host}:{fleet.gateway_port}",
+              replicas=[f"127.0.0.1:{p}" for p in ports])
 
     stop = threading.Event()
 
@@ -66,12 +70,12 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
     stop.wait()
-    print("[fleet] draining …")
+    _log.info("draining")
     gateway.drain(timeout=30)
     supervisor.drain(timeout=30)
     if broker is not None:
         broker.shutdown()
-    print("[fleet] bye")
+    _log.info("fleet_stopped")
 
 
 if __name__ == "__main__":

@@ -38,6 +38,10 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from routest_tpu.core.config import FleetConfig
+from routest_tpu.obs import get_registry, to_chrome_trace
+from routest_tpu.obs.trace import (REQUEST_ID_RE, get_tracer,
+                                   mint_request_id, parse_traceparent,
+                                   trace_span)
 from routest_tpu.utils.logging import get_logger
 from routest_tpu.utils.profiling import RequestStats
 
@@ -56,6 +60,14 @@ _HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
                 "transfer-encoding", "upgrade"}
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+def _tag_replica(rh: List, rid: str) -> None:
+    """Stamp which replica answered: ``X-RTPU-Replica`` (the documented
+    correlation header) plus the PR-1 ``X-Fleet-Replica`` name for
+    back-compat with existing dashboards/tests."""
+    rh.append(("X-Fleet-Replica", rid))
+    rh.append(("X-RTPU-Replica", rid))
 
 
 def _fresh_conn(host: str, port: int,
@@ -133,10 +145,29 @@ class Gateway:
         self.hedge_wins = 0
         self.draining = False
         self.started = time.time()
-        # Per-replica latency quantiles, keyed by replica id (reuses the
-        # serving layer's reservoir stats).
+        # Per-replica latency histograms, keyed by replica id (the same
+        # unified metric types the serving layer records into).
         self.stats = RequestStats()
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        # Unified-registry mirrors of the fleet aggregates, so one
+        # Prometheus scrape of the gateway sees admission + routing +
+        # hedging through the same exposition path as every other layer.
+        reg = get_registry()
+        self._m_shed = reg.counter(
+            "rtpu_gateway_sheds_total", "Requests shed by admission (429).")
+        self._m_retries = reg.counter(
+            "rtpu_gateway_retries_total",
+            "Idempotent retries after transport failure.")
+        self._m_hedges = reg.counter(
+            "rtpu_gateway_hedges_total", "Hedge copies sent.")
+        self._m_hedge_wins = reg.counter(
+            "rtpu_gateway_hedge_wins_total", "Hedge copies that won.")
+        self._m_upstream = reg.histogram(
+            "rtpu_gateway_upstream_seconds",
+            "Proxied exchange latency by replica.", ("replica",))
+        self._m_admit_wait = reg.histogram(
+            "rtpu_gateway_admit_wait_seconds",
+            "Time spent queued in admission control.")
 
     # ── admission control ─────────────────────────────────────────────
 
@@ -152,6 +183,7 @@ class Gateway:
                 return True, 0
             if self._waiters >= cfg.queue_depth:
                 self.shed_count += 1
+                self._m_shed.inc()
                 return False, 429
             self._waiters += 1
             try:
@@ -159,6 +191,7 @@ class Gateway:
                     remaining = deadline - time.time()
                     if remaining <= 0:
                         self.shed_count += 1
+                        self._m_shed.inc()
                         return False, 429
                     if self.draining:
                         return False, 503
@@ -215,6 +248,7 @@ class Gateway:
 
     def _complete(self, r: _Upstream, ok: bool, seconds: float) -> None:
         self.stats.add(r.id, seconds, error=not ok)
+        self._m_upstream.labels(replica=r.id).observe(seconds)
         with self._lock:
             r.outstanding -= 1
             if r.state == HALF_OPEN:
@@ -245,45 +279,58 @@ class Gateway:
 
     def _forward_once(self, r: _Upstream, method: str, path: str,
                       body: Optional[bytes], headers: Dict[str, str],
-                      timeout: float):
+                      timeout: float, parent=None, slot: str = "primary"):
         """→ (status, headers, body) or raises OSError/HTTPException.
-        Counts the exchange into the replica's breaker + stats."""
-        t0 = time.perf_counter()
-        conn = None
-        pooled = False
-        try:
+        Counts the exchange into the replica's breaker + stats. The
+        forward span parents under ``parent`` when given (hedge copies
+        run on worker threads, where the ambient context doesn't
+        follow), else under the ambient span; its context is what gets
+        injected as ``traceparent`` on the upstream hop."""
+        from routest_tpu.obs.trace import CURRENT
+
+        with trace_span("gateway.forward",
+                        parent=parent if parent is not None else CURRENT,
+                        replica=r.id, slot=slot) as fspan:
+            if fspan.ctx is not None:
+                headers = dict(headers)
+                get_tracer().inject(headers)
+            t0 = time.perf_counter()
+            conn = None
+            pooled = False
             try:
-                conn, pooled = r.get_conn(timeout)
-                conn.request(method, path, body=body, headers=headers)
-                resp = conn.getresponse()
+                try:
+                    conn, pooled = r.get_conn(timeout)
+                    conn.request(method, path, body=body, headers=headers)
+                    resp = conn.getresponse()
+                except (http.client.HTTPException, OSError):
+                    if conn is not None:
+                        conn.close()
+                    if not pooled:
+                        raise
+                    # Stale keep-alive, not a sick replica: one fresh try.
+                    conn = _fresh_conn(r.host, r.port, timeout)
+                    conn.request(method, path, body=body, headers=headers)
+                    resp = conn.getresponse()
+                data = resp.read()
+                resp_headers = [(k, v) for k, v in resp.getheaders()
+                                if k.lower() not in _HOP_HEADERS]
+                status = resp.status
             except (http.client.HTTPException, OSError):
                 if conn is not None:
                     conn.close()
-                if not pooled:
-                    raise
-                # Stale keep-alive, not a sick replica: one fresh try.
-                conn = _fresh_conn(r.host, r.port, timeout)
-                conn.request(method, path, body=body, headers=headers)
-                resp = conn.getresponse()
-            data = resp.read()
-            resp_headers = [(k, v) for k, v in resp.getheaders()
-                            if k.lower() not in _HOP_HEADERS]
-            status = resp.status
-        except (http.client.HTTPException, OSError):
-            if conn is not None:
+                self._complete(r, ok=False,
+                               seconds=time.perf_counter() - t0)
+                raise
+            if resp.will_close:
                 conn.close()
-            self._complete(r, ok=False,
+            else:
+                r.put_conn(conn)
+            # Breaker failure = transport error or 5xx (a 4xx is the
+            # client's fault, not the replica's).
+            self._complete(r, ok=status < 500,
                            seconds=time.perf_counter() - t0)
-            raise
-        if resp.will_close:
-            conn.close()
-        else:
-            r.put_conn(conn)
-        # Breaker failure = transport error or 5xx (a 4xx is the
-        # client's fault, not the replica's).
-        self._complete(r, ok=status < 500,
-                       seconds=time.perf_counter() - t0)
-        return status, resp_headers, data
+            fspan.set_attr("status", status)
+            return status, resp_headers, data
 
     def _hedge_delay_s(self) -> float:
         """p95 of recent proxied latencies, floored at hedge_min_ms."""
@@ -294,30 +341,75 @@ class Gateway:
 
     def handle(self, method: str, path: str, body: Optional[bytes],
                headers: Dict[str, str], deadline_ms: Optional[float]):
-        """Full gateway pipeline → (status, headers, body)."""
+        """Full gateway pipeline → (status, headers, body).
+
+        The trace is born HERE (or adopted from a well-formed client
+        ``traceparent``): one root span per proxied request, with
+        admission, per-replica forwards, retries, and hedges as
+        children, and the context injected into the upstream hop so the
+        replica's spans join the same trace. Ditto the correlation id —
+        the gateway mints ``X-Request-ID`` when the client sent none,
+        one hop earlier than the replica would, so gateway and replica
+        log lines for one request finally grep together."""
+        # Header names arrive in whatever case the client sent
+        # (urllib capitalizes, browsers lowercase): match-insensitively.
+        def _h(name: str) -> str:
+            low = name.lower()
+            return next((v for k, v in headers.items()
+                         if k.lower() == low), "")
+
+        rid = _h("X-Request-ID")
+        if not REQUEST_ID_RE.match(rid):
+            rid = mint_request_id()
+        headers = {k: v for k, v in headers.items()
+                   if k.lower() != "x-request-id"}
+        headers["X-Request-ID"] = rid
         cfg = self.config
         budget_ms = deadline_ms if deadline_ms else cfg.deadline_ms
         deadline = time.time() + budget_ms / 1000.0
-        admitted, status = self._admit(deadline)
-        if not admitted:
-            if status == 429:
-                return 429, [("Retry-After", "1"),
-                             ("Content-Type", "application/json")], \
-                    json.dumps({"error": "fleet saturated; retry later"
-                                }).encode()
-            return 503, [("Content-Type", "application/json")], \
-                json.dumps({"error": "gateway draining"}).encode()
-        try:
-            return self._routed(method, path, body, headers, deadline)
-        finally:
-            self._release()
+        client_ctx = parse_traceparent(_h("traceparent"))
+        with trace_span("gateway.request", parent=client_ctx,
+                        method=method, path=path.split("?", 1)[0],
+                        request_id=rid) as root:
+            t_admit = time.perf_counter()
+            admitted, status = self._admit(deadline)
+            self._m_admit_wait.observe(time.perf_counter() - t_admit)
+            if not admitted:
+                root.set_attr("status", status)
+                if status == 429:
+                    rh = [("Retry-After", "1"),
+                          ("Content-Type", "application/json")]
+                    out = json.dumps({"error": "fleet saturated; retry "
+                                               "later"}).encode()
+                else:
+                    rh = [("Content-Type", "application/json")]
+                    out = json.dumps({"error": "gateway draining"}).encode()
+                return status, self._stamp(rh, rid, root), out
+            try:
+                status, rh, data = self._routed(method, path, body,
+                                                headers, deadline)
+                root.set_attr("status", status)
+                return status, self._stamp(rh, rid, root), data
+            finally:
+                self._release()
+
+    @staticmethod
+    def _stamp(rh: List, rid: str, root) -> List:
+        """Correlation headers every gateway response carries: the
+        request id (minted or echoed) and — when tracing is on — the
+        trace id, so a slow client call pairs with its exported spans."""
+        rh = [(k, v) for k, v in rh if k.lower() != "x-request-id"]
+        rh.append(("X-Request-ID", rid))
+        if root.trace_id is not None:
+            rh.append(("X-Trace-Id", root.trace_id))
+        return rh
 
     def _routed(self, method, path, body, headers, deadline):
         bare = path.split("?", 1)[0]
         idempotent = method in ("GET", "HEAD") or bare in _IDEMPOTENT_POST
         fwd_headers = {k: v for k, v in headers.items()
                        if k.lower() not in _HOP_HEADERS
-                       and k.lower() != "host"}
+                       and k.lower() not in ("host", "traceparent")}
         timeout = max(0.2, deadline - time.time())
 
         primary = self._pick()
@@ -339,7 +431,7 @@ class Gateway:
             try:
                 status, rh, data = self._forward_once(
                     primary, method, path, body, fwd_headers, timeout)
-                rh.append(("X-Fleet-Replica", primary.id))
+                _tag_replica(rh, primary.id)
                 return status, rh, data
             except (http.client.HTTPException, OSError):
                 if not idempotent:
@@ -353,11 +445,12 @@ class Gateway:
                 json.dumps({"error": "no healthy replica"}).encode()
         with self._lock:
             self.retries += 1
+        self._m_retries.inc()
         try:
             status, rh, data = self._forward_once(
                 retry, method, path, body, fwd_headers,
-                max(0.2, deadline - time.time()))
-            rh.append(("X-Fleet-Replica", retry.id))
+                max(0.2, deadline - time.time()), slot="retry")
+            _tag_replica(rh, retry.id)
             return status, rh, data
         except (http.client.HTTPException, OSError):
             return 502, [("Content-Type", "application/json")], \
@@ -371,11 +464,18 @@ class Gateway:
         None to signal "connection-level failure, let caller retry"."""
         box: List = []          # (source, result-or-None)
         done = threading.Event()
+        # Hedge copies run on worker threads; contextvars don't follow,
+        # so capture the ambient (root) span context and parent both
+        # forwards under it explicitly.
+        from routest_tpu.obs.trace import current_context
+
+        parent_ctx = current_context()
 
         def run(r, slot):
             try:
                 res = self._forward_once(r, method, path, body,
-                                         dict(headers), timeout)
+                                         dict(headers), timeout,
+                                         parent=parent_ctx, slot=slot)
             except (http.client.HTTPException, OSError):
                 res = None
             box.append((slot, r, res))
@@ -391,6 +491,7 @@ class Gateway:
             if hedge_r is not None:
                 with self._lock:
                     self.hedges += 1
+                self._m_hedges.inc()
                 threading.Thread(target=run, args=(hedge_r, "hedge"),
                                  daemon=True).start()
         # Wait for the first result; if it's a transport failure, wait
@@ -407,8 +508,9 @@ class Gateway:
                 if slot == "hedge":
                     with self._lock:
                         self.hedge_wins += 1
+                    self._m_hedge_wins.inc()
                 status, rh, data = res
-                rh.append(("X-Fleet-Replica", r.id))
+                _tag_replica(rh, r.id)
                 return status, rh, data
         if len(box) >= expected:
             return None          # every copy died at transport level
@@ -453,6 +555,26 @@ class Gateway:
             fleet["restarts"] = sum(i["restarts"] for i in sup.values())
         return {"fleet": fleet, "replicas": replicas}
 
+    def replica_metrics(self) -> dict:
+        """Per-replica ``/api/metrics`` JSON (batcher stage histograms
+        included), fetched on demand for ``/api/metrics?replicas=1`` —
+        the fleet tier's view into worker-side registries without a
+        second scrape config. Unreachable replicas report the error
+        instead of failing the whole endpoint."""
+        out = {}
+        for r in self.replicas:
+            try:
+                conn = _fresh_conn(r.host, r.port, timeout=2.0)
+                try:
+                    conn.request("GET", "/api/metrics")
+                    resp = conn.getresponse()
+                    out[r.id] = json.loads(resp.read())
+                finally:
+                    conn.close()
+            except (http.client.HTTPException, OSError, ValueError) as e:
+                out[r.id] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
     # ── serving ───────────────────────────────────────────────────────
 
     def serve(self, host: str, port: int):
@@ -485,6 +607,8 @@ class Gateway:
                 bare = path.split("?", 1)[0]
                 if bare == "/api/metrics":
                     return self._metrics()
+                if bare == "/api/trace":
+                    return self._trace()
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
                 deadline_ms = None
@@ -504,12 +628,41 @@ class Gateway:
             def _metrics(self):
                 snap = gw.snapshot()
                 if "format=prometheus" in self.path:
-                    data = _prometheus_fleet_text(snap).encode()
+                    # Fleet families + the unified registry (admission
+                    # waits, per-replica latency histograms, hedge
+                    # counters) in one scrape.
+                    data = (_prometheus_fleet_text(snap)
+                            + get_registry().prometheus_text()).encode()
                     ctype = "text/plain; version=0.0.4"
                 else:
+                    snap["registry"] = get_registry().snapshot()
+                    if "replicas=1" in self.path:
+                        snap["replica_metrics"] = gw.replica_metrics()
                     data = json.dumps(snap).encode()
                     ctype = "application/json"
                 self._respond(200, [("Content-Type", ctype)], data)
+
+            def _trace(self):
+                """Span flight-recorder dump (same contract as the
+                replica's ``/api/trace``): JSON spans, or Chrome
+                trace_event JSON with ``?format=chrome``."""
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                buf = get_tracer().buffer
+                spans = buf.snapshot(
+                    trace_id=(q.get("trace_id") or [None])[0])
+                limit = (q.get("limit") or [None])[0]
+                if limit and limit.isdigit():
+                    spans = spans[-int(limit):]
+                if (q.get("format") or [None])[0] == "chrome":
+                    payload = to_chrome_trace(spans)
+                else:
+                    payload = {"count": len(spans),
+                               "dropped": buf.dropped, "spans": spans}
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
 
             def _stream(self, path):
                 """SSE pass-through: pick a replica, pipe bytes until

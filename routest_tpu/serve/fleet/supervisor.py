@@ -21,9 +21,13 @@ import time
 import urllib.request
 from typing import Callable, Dict, List, Optional, Sequence
 
+from routest_tpu.obs import get_registry
 from routest_tpu.utils.logging import get_logger
 
 _log = get_logger("routest_tpu.fleet.supervisor")
+_m_restarts = get_registry().counter(
+    "rtpu_supervisor_restarts_total",
+    "Worker restarts (crash or failed liveness).", ("replica",))
 
 
 def default_worker_command(port: int) -> List[str]:
@@ -176,6 +180,7 @@ class ReplicaSupervisor:
             r.consecutive_crashes = 0
         r.consecutive_crashes += 1
         r.restarts += 1
+        _m_restarts.labels(replica=f"r{r.index}").inc()
         r.next_start_at = time.time() + self._backoff_s(r)
 
     def _monitor(self) -> None:

@@ -27,6 +27,9 @@ from routest_tpu.core.config import ServeConfig
 from routest_tpu.core.mesh import MeshRuntime, pad_rows
 from routest_tpu.data.features import encode_requests
 from routest_tpu.models.eta_mlp import EtaMLP, Params
+from routest_tpu.obs import get_registry
+from routest_tpu.obs.export import maybe_device_trace
+from routest_tpu.obs.trace import trace_span
 from routest_tpu.train.checkpoint import default_model_path, load_model
 
 
@@ -118,6 +121,27 @@ class DynamicBatcher:
         self._queued_rows = 0
         self._flushing = False
         self.stats = {"flushes": 0, "rows": 0, "max_batch_seen": 0}
+        # Unified-registry view of the batching stages (ISSUE 2): until
+        # now queue wait vs. assembly vs. device compute were
+        # indistinguishable from outside — these histograms + the stage
+        # spans in submit()/_flush() are what the next perf PRs read.
+        reg = get_registry()
+        self._m_queue_wait = reg.histogram(
+            "rtpu_batcher_queue_wait_seconds",
+            "Submit-to-result wait inside the dynamic batcher.")
+        self._m_flush = reg.histogram(
+            "rtpu_batcher_flush_seconds",
+            "One drain: assembly + pad + device compute.")
+        self._m_compute = reg.histogram(
+            "rtpu_batcher_device_compute_seconds",
+            "Device scoring call per flush, by pad bucket.", ("bucket",))
+        self._m_fill = reg.histogram(
+            "rtpu_batcher_fill_ratio", "Real rows / padded bucket rows.",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
+        self._m_rows = reg.counter(
+            "rtpu_batcher_rows_total", "Rows scored through the batcher.")
+        self._m_flushes = reg.counter(
+            "rtpu_batcher_flushes_total", "Batcher drains executed.")
 
     def _bucket(self, n: int) -> int:
         for b in self._buckets:
@@ -128,31 +152,37 @@ class DynamicBatcher:
 
     def submit(self, rows: np.ndarray) -> np.ndarray:
         pending = _Pending(rows)
-        with self._lock:
-            self._queue.append(pending)
-            self._queued_rows += len(rows)
-            should_flush = (self._queued_rows >= self._max_batch
-                            and not self._flushing)
-        # A flush exception here may belong to OTHER requests' rows (the
-        # capped drain can exclude ours); our own failure arrives via
-        # pending.error below, so never re-raise from the shared flush.
-        if should_flush:
-            try:
-                self._flush()
-            except Exception:
-                pass
-        deadline = time.monotonic() + self._max_wait
-        while True:
-            # Oldest-waiter timeout: whoever wakes first drains the queue.
-            # After the deadline, keep a 1 ms wait in the loop so a flush
-            # in flight on another thread isn't hot-spun against.
-            remaining = deadline - time.monotonic()
-            if pending.event.wait(timeout=max(remaining, 0.001)):
-                break
-            try:
-                self._flush()
-            except Exception:
-                pass
+        t_submit = time.perf_counter()
+        with trace_span("batcher.queue_wait", rows=len(rows)) as qs:
+            with self._lock:
+                self._queue.append(pending)
+                self._queued_rows += len(rows)
+                should_flush = (self._queued_rows >= self._max_batch
+                                and not self._flushing)
+            # A flush exception here may belong to OTHER requests' rows
+            # (the capped drain can exclude ours); our own failure
+            # arrives via pending.error below, so never re-raise from
+            # the shared flush.
+            if should_flush:
+                try:
+                    self._flush()
+                except Exception:
+                    pass
+            deadline = time.monotonic() + self._max_wait
+            while True:
+                # Oldest-waiter timeout: whoever wakes first drains the
+                # queue. After the deadline, keep a 1 ms wait in the loop
+                # so a flush in flight on another thread isn't hot-spun
+                # against.
+                remaining = deadline - time.monotonic()
+                if pending.event.wait(timeout=max(remaining, 0.001)):
+                    break
+                try:
+                    self._flush()
+                except Exception:
+                    pass
+            qs.set_attr("flushed_inline", should_flush)
+        self._m_queue_wait.observe(time.perf_counter() - t_submit)
         if pending.error is not None:
             # A dead device must surface as an error on EVERY waiter, not
             # only the thread that happened to run the flush — silent NaN
@@ -181,9 +211,30 @@ class DynamicBatcher:
                 del self._queue[:cnt]
                 self._queued_rows -= taken
             try:
-                rows = np.concatenate([p.rows for p in batch], axis=0)
-                n = len(rows)
-                preds = np.asarray(self._score(pad_rows(rows, self._bucket(n))))[:n]
+                t_flush = time.perf_counter()
+                with trace_span("batcher.flush", requests=cnt) as fs:
+                    rows = np.concatenate([p.rows for p in batch], axis=0)
+                    n = len(rows)
+                    bucket = self._bucket(n)
+                    fs.set_attr("rows", n)
+                    fs.set_attr("bucket", bucket)
+                    with trace_span("batcher.pad", rows=n, bucket=bucket,
+                                    pad_rows=bucket - n):
+                        padded = pad_rows(rows, bucket)
+                    t_dev = time.perf_counter()
+                    with trace_span("batcher.device_compute", rows=n,
+                                    bucket=bucket) as ds:
+                        # xplane capture budget permitting, a sampled
+                        # flush also records the device trace that
+                        # explains it (one trace id across both).
+                        with maybe_device_trace(ds):
+                            preds = np.asarray(self._score(padded))[:n]
+                    self._m_compute.labels(bucket=bucket).observe(
+                        time.perf_counter() - t_dev)
+                self._m_flush.observe(time.perf_counter() - t_flush)
+                self._m_fill.observe(n / bucket if bucket else 1.0)
+                self._m_rows.inc(n)
+                self._m_flushes.inc()
                 self.stats["flushes"] += 1
                 self.stats["rows"] += n
                 self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], n)

@@ -285,9 +285,20 @@ class NetBus:
         raise ConnectionError("unreachable")  # pragma: no cover
 
     def publish(self, channel: str, data: dict) -> int:
-        resp = self._command({"op": "publish", "channel": channel,
-                              "data": data}, retry_after_ack_loss=False)
-        return int(resp.get("receivers", 0))
+        from routest_tpu.obs import get_registry
+        from routest_tpu.obs.trace import trace_span
+
+        t0 = time.monotonic()
+        with trace_span("netbus.publish", channel=channel) as sp:
+            resp = self._command({"op": "publish", "channel": channel,
+                                  "data": data}, retry_after_ack_loss=False)
+            receivers = int(resp.get("receivers", 0))
+            sp.set_attr("receivers", receivers)
+        get_registry().histogram(
+            "rtpu_netbus_publish_seconds",
+            "Broker publish round-trip latency.").observe(
+                time.monotonic() - t0)
+        return receivers
 
     def subscribe(self, channel: str,
                   last_event_id: Optional[int] = None) -> "_NetSubscription":
@@ -395,13 +406,15 @@ class _NetSubscription:
 def main() -> None:
     import argparse
 
+    from routest_tpu.utils.logging import get_logger
+
     parser = argparse.ArgumentParser(description="routest_tpu SSE broker")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     args = parser.parse_args()
     broker = Broker(args.host, args.port)
-    print(f"[netbus] broker listening on tcp://{args.host}:{broker.port}",
-          flush=True)
+    get_logger("routest_tpu.netbus").info(
+        "broker_listening", url=f"tcp://{args.host}:{broker.port}")
     broker.serve_forever()
 
 

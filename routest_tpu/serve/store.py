@@ -20,8 +20,12 @@ from __future__ import annotations
 
 import datetime as dt
 import threading
+import time
 import uuid
 from typing import Dict, List, Optional, Protocol
+
+from routest_tpu.obs import get_registry
+from routest_tpu.obs.trace import trace_span
 
 
 class Store(Protocol):
@@ -195,7 +199,55 @@ class PostgRESTStore:
         return "postgrest"
 
 
+class TracedStore:
+    """Store decorator: every operation becomes a child span of the
+    ambient request trace plus one observation in the process registry's
+    ``rtpu_store_op_seconds{op,backend}`` histogram — persistence
+    latency was previously invisible inside handler time. Pure
+    pass-through otherwise (same Protocol, same exceptions)."""
+
+    def __init__(self, inner: Store) -> None:
+        self._inner = inner
+        self._hist = get_registry().histogram(
+            "rtpu_store_op_seconds", "Store operation latency.",
+            ("op", "backend"))
+
+    def _call(self, op: str, fn, *args):
+        t0 = time.perf_counter()
+        with trace_span(f"store.{op}", backend=self._inner.kind):
+            try:
+                return fn(*args)
+            finally:
+                self._hist.labels(op=op, backend=self._inner.kind).observe(
+                    time.perf_counter() - t0)
+
+    def insert_request(self, row: Dict) -> str:
+        return self._call("insert_request", self._inner.insert_request, row)
+
+    def insert_result(self, row: Dict) -> None:
+        return self._call("insert_result", self._inner.insert_result, row)
+
+    def list_history(self, limit: int,
+                     engine: Optional[str] = None) -> List[Dict]:
+        return self._call("list_history", self._inner.list_history,
+                          limit, engine)
+
+    def get_request(self, req_id: str) -> Optional[Dict]:
+        return self._call("get_request", self._inner.get_request, req_id)
+
+    def delete_request(self, req_id: str) -> bool:
+        return self._call("delete_request", self._inner.delete_request,
+                          req_id)
+
+    def ping(self) -> bool:
+        return self._call("ping", self._inner.ping)
+
+    @property
+    def kind(self) -> str:
+        return self._inner.kind
+
+
 def make_store(supabase_url: Optional[str], service_key: Optional[str]) -> Store:
     if supabase_url and service_key:
-        return PostgRESTStore(supabase_url, service_key)
-    return InMemoryStore()
+        return TracedStore(PostgRESTStore(supabase_url, service_key))
+    return TracedStore(InMemoryStore())

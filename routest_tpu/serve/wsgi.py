@@ -13,20 +13,23 @@ import json
 import os
 import re
 import time
-import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from werkzeug.exceptions import RequestEntityTooLarge
 from werkzeug.wrappers import Request, Response
 
+from routest_tpu.obs.trace import (REQUEST_ID_RE, mint_request_id,
+                                   parse_traceparent, trace_span)
 from routest_tpu.utils.logging import reset_request_id, set_request_id
 from routest_tpu.utils.profiling import RequestStats
 
 _PARAM_RE = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
 # A caller-supplied correlation id is echoed only if it is shaped like
 # one (bounded, log-safe charset); anything else gets a fresh id rather
-# than injecting arbitrary bytes into every structured log line.
-_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+# than injecting arbitrary bytes into every structured log line. The
+# shape lives in obs.trace so the gateway applies the identical rule one
+# hop earlier.
+_REQUEST_ID_RE = REQUEST_ID_RE
 
 # Origins the reference allows (Flaskr/__init__.py CORS config), split
 # by trust (ADVICE r5): the localhost dev origins plus the configured
@@ -92,14 +95,28 @@ class App:
         # no request tracing at all, bare prints only).
         rid = request.headers.get("X-Request-ID", "")
         if not _REQUEST_ID_RE.match(rid):
-            rid = uuid.uuid4().hex[:16]
+            rid = mint_request_id()
         token = set_request_id(rid)
-        try:
-            response = self._dispatch(request)
-        except Exception as e:  # pragma: no cover - last-resort handler
-            response = json_response({"error": f"internal error: {e}"}, 500)
-        finally:
-            reset_request_id(token)
+        # Trace context: adopt the caller's ``traceparent`` (the gateway
+        # injects one per forward, so replica spans nest under the
+        # gateway's routing span in one trace); a missing/malformed
+        # header starts a new root HERE — parent=None, never the
+        # ambient context, which on a reused server thread could belong
+        # to a previous request.
+        remote_ctx = parse_traceparent(request.headers.get("traceparent"))
+        with trace_span("replica.request", parent=remote_ctx,
+                        method=request.method, path=request.path,
+                        request_id=rid) as span:
+            try:
+                response = self._dispatch(request)
+            except Exception as e:  # pragma: no cover - last-resort handler
+                response = json_response({"error": f"internal error: {e}"},
+                                         500)
+            finally:
+                reset_request_id(token)
+            span.set_attr("status", response.status_code)
+            if span.trace_id is not None:
+                response.headers["X-Trace-Id"] = span.trace_id
         response.headers["X-Request-ID"] = rid
         self._apply_cors(request, response)
         return response(environ, start_response)
@@ -119,14 +136,18 @@ class App:
         t0 = time.perf_counter()
         response: Optional[Response] = None
         try:
-            result = fn(request, **kwargs)
-            if isinstance(result, Response):
-                response = result
-            elif isinstance(result, tuple):
-                payload, status = result
-                response = json_response(payload, status)
-            else:
-                response = json_response(result)
+            with trace_span("replica.handler",
+                            route=f"{request.method} {template}") as hs:
+                result = fn(request, **kwargs)
+                if isinstance(result, Response):
+                    response = result
+                elif isinstance(result, tuple):
+                    payload, status = result
+                    response = json_response(payload, status)
+                else:
+                    response = json_response(result)
+                hs.set_attr("status", response.status_code)
+                hs.set_attr("streamed", response.is_streamed)
             return response
         except RequestEntityTooLarge:
             # Caught HERE so the finally sees a real response: a 413 is

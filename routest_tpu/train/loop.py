@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Callable, Dict, Iterator, NamedTuple, Optional, Tuple
 
 import jax
@@ -24,6 +25,10 @@ from routest_tpu.core.config import TrainConfig
 from routest_tpu.core.mesh import MeshRuntime, pad_rows, pad_to_multiple
 from routest_tpu.models.eta_mlp import EtaMLP, Params, fit_normalizer
 from routest_tpu.data.features import batch_from_mapping
+from routest_tpu.obs import get_registry
+from routest_tpu.utils.logging import get_logger
+
+_log = get_logger("routest_tpu.train")
 
 
 class TrainState(NamedTuple):
@@ -214,7 +219,8 @@ def fit(
             if runtime is not None:
                 state = TrainState(*runtime.replicate(tuple(state)))
             if log_every:
-                print(f"resumed from {latest} (epoch {start_epoch})")
+                _log.info("train_resumed", checkpoint=latest,
+                          epoch=start_epoch)
 
     step_fn = make_train_step(model, optimizer, runtime)
     n_shards = runtime.n_data if runtime else 1
@@ -232,7 +238,18 @@ def fit(
 
     losses = []
     saved_epoch = start_epoch  # nothing new to persist until we train
+    # Train observability rides the same process-wide registry as
+    # serving: per-epoch step time + loss are scrapeable/exportable
+    # identically whether this runs in a notebook or under the server's
+    # ensure-model bootstrap.
+    reg = get_registry()
+    m_epoch_s = reg.histogram("rtpu_train_epoch_seconds",
+                              "Wall time per training epoch.")
+    m_loss = reg.gauge("rtpu_train_loss", "Last epoch's training loss.")
+    m_epochs = reg.counter("rtpu_train_epochs_total",
+                           "Training epochs completed.")
     for epoch in range(start_epoch, end_epoch):
+        t_epoch = time.perf_counter()
         # per-epoch rng: deterministic shuffles that are stable across a
         # resume (epoch k shuffles identically whether or not we restarted)
         rng = np.random.default_rng(cfg.seed + 1 + epoch)
@@ -241,8 +258,14 @@ def fit(
                 batch = Batch(*runtime.shard_batch(tuple(batch)))
             state, loss = step_fn(state, batch)
         losses.append(float(loss))
+        epoch_s = time.perf_counter() - t_epoch
+        m_epoch_s.observe(epoch_s)
+        m_loss.set(losses[-1])
+        m_epochs.inc()
         if log_every and (epoch + 1) % log_every == 0:
-            print(f"epoch {epoch + 1}/{cfg.epochs} loss={losses[-1]:.4f}")
+            _log.info("train_epoch", epoch=epoch + 1, epochs=cfg.epochs,
+                      loss=round(losses[-1], 4),
+                      epoch_seconds=round(epoch_s, 3))
         if (cfg.checkpoint_dir and cfg.checkpoint_every_epochs
                 and (epoch + 1) % cfg.checkpoint_every_epochs == 0):
             from routest_tpu.train import checkpoint as ckpt

@@ -3,66 +3,45 @@
 The reference's only timing is wall-clock deltas inside health probes
 (``Flaskr/routes.py:285,300,331`` — SURVEY.md §5.1). This module adds:
 
-- ``RequestStats``: lock-protected per-route latency accumulators
-  (count, errors, mean, p50/p95/p99 from a bounded reservoir) that the
-  serving layer samples into and ``/api/metrics`` reports;
+- ``RequestStats``: per-route latency view kept for the serving layer's
+  existing ``/api/metrics`` JSON shape, now backed by the unified
+  metric types in ``routest_tpu/obs/registry.py`` (a log-bucket
+  histogram + error counter per route) instead of a private reservoir —
+  one implementation of "how do we measure a latency" process-wide;
 - ``device_trace``: context manager around ``jax.profiler`` writing a
-  TensorBoard-loadable trace of device execution.
+  TensorBoard-loadable trace of device execution (attachable to a
+  sampled request span via ``obs.export.maybe_device_trace``).
 """
 
 from __future__ import annotations
 
 import contextlib
-import random
-import threading
 import time
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, Optional
 
-
-class _RouteStats:
-    __slots__ = ("count", "errors", "total_s", "reservoir", "_rng")
-    RESERVOIR = 512
-
-    def __init__(self) -> None:
-        self.count = 0
-        self.errors = 0
-        self.total_s = 0.0
-        self.reservoir: List[float] = []
-        self._rng = random.Random(0)
-
-    def add(self, seconds: float, error: bool) -> None:
-        self.count += 1
-        self.errors += int(error)
-        self.total_s += seconds
-        if len(self.reservoir) < self.RESERVOIR:
-            self.reservoir.append(seconds)
-        else:  # reservoir sampling keeps percentiles unbiased over time
-            j = self._rng.randrange(self.count)
-            if j < self.RESERVOIR:
-                self.reservoir[j] = seconds
-
-    def summary(self) -> Dict:
-        if not self.count:
-            return {"count": 0}
-        ordered = sorted(self.reservoir)
-
-        def pct(p: float) -> float:
-            return ordered[min(len(ordered) - 1, int(p * len(ordered)))]
-
-        return {
-            "count": self.count,
-            "errors": self.errors,
-            "mean_ms": round(1000.0 * self.total_s / self.count, 3),
-            "p50_ms": round(1000.0 * pct(0.50), 3),
-            "p95_ms": round(1000.0 * pct(0.95), 3),
-            "p99_ms": round(1000.0 * pct(0.99), 3),
-        }
+from routest_tpu.obs.registry import MetricsRegistry
 
 
 class RequestStats:
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._routes: Dict[str, _RouteStats] = {}
+    """Per-route latency/error accumulators with the historical snapshot
+    shape (count, errors, mean_ms, p50/p95/p99_ms). Each instance owns a
+    private :class:`MetricsRegistry`, so per-``App`` isolation holds
+    (test apps must not see each other's counts); pass ``registry`` to
+    aggregate several components into one.
+
+    Percentiles are interpolated from the fixed log-scale buckets —
+    coarser than the old 512-sample reservoir per route, but mergeable
+    across processes and strictly bounded in memory.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        self._hist = self.registry.histogram(
+            "request_duration_seconds", "Per-route request latency.",
+            ("route",))
+        self._errors = self.registry.counter(
+            "request_errors_total", "Per-route server errors (>=500).",
+            ("route",))
         self.started = time.time()
 
     @contextlib.contextmanager
@@ -78,17 +57,30 @@ class RequestStats:
             self.add(route, time.perf_counter() - t0, error)
 
     def add(self, route: str, seconds: float, error: bool = False) -> None:
-        with self._lock:
-            if route not in self._routes:
-                self._routes[route] = _RouteStats()
-            self._routes[route].add(seconds, error)
+        self._hist.labels(route=route).observe(seconds)
+        if error:
+            self._errors.labels(route=route).inc()
 
     def snapshot(self) -> Dict:
-        with self._lock:
-            return {
-                "uptime_s": round(time.time() - self.started, 1),
-                "routes": {r: s.summary() for r, s in self._routes.items()},
+        routes: Dict[str, Dict] = {}
+        errors = {key[0]: c.value for key, c in self._errors.items()}
+        for key, h in self._hist.items():
+            route = key[0]
+            if not h.count:
+                routes[route] = {"count": 0}
+                continue
+            routes[route] = {
+                "count": h.count,
+                "errors": int(errors.get(route, 0)),
+                "mean_ms": round(1000.0 * h.sum / h.count, 3),
+                "p50_ms": round(1000.0 * h.quantile(0.50), 3),
+                "p95_ms": round(1000.0 * h.quantile(0.95), 3),
+                "p99_ms": round(1000.0 * h.quantile(0.99), 3),
             }
+        return {
+            "uptime_s": round(time.time() - self.started, 1),
+            "routes": routes,
+        }
 
 
 @contextlib.contextmanager

@@ -1,0 +1,187 @@
+"""Observability overhead: tracing off vs sampled vs always-on.
+
+The ISSUE-2 acceptance bar is that always-on tracing costs ≤5% on the
+``load_test`` predict_eta p95. This script measures it honestly: three
+identical server subprocesses (the same spawn-and-wait pattern as
+``scripts/load_test.py``), differing ONLY in ``RTPU_OBS_*``:
+
+- ``off``       — ``RTPU_OBS_TRACE=0`` (shared no-op spans);
+- ``sampled``   — ``RTPU_OBS_SAMPLE=0.1`` (production default posture);
+- ``always_on`` — ``RTPU_OBS_SAMPLE=1.0`` (every request recorded).
+
+Each mode runs the load_test single-row phase (the per-request-overhead-
+dominated endpoint: tiny payloads, so any tracing cost is maximally
+visible) plus a batch phase, and the report lands in
+``artifacts/obs_overhead.json``. On a 1-core host client and server
+time-share, so run-to-run noise of a few percent is expected — the
+artifact records all three absolute numbers, not just the ratio.
+
+Usage: python scripts/bench_obs_overhead.py [--threads 8] [--requests 40]
+       [--out artifacts/obs_overhead.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_load_test():
+    spec = importlib.util.spec_from_file_location(
+        "rtpu_load_test", os.path.join(REPO, "scripts", "load_test.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_server(env_overrides: dict) -> tuple:
+    port = _free_port()
+    env = dict(os.environ)
+    env.update({"PORT": str(port), "ROUTEST_FORCE_CPU": "1"})
+    env.update(env_overrides)
+    proc = subprocess.Popen([sys.executable, "-m", "routest_tpu.serve"],
+                            env=env, cwd=REPO)
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _wait_ready(lt, proc, base: str, timeout: float = 300.0) -> None:
+    deadline = time.time() + timeout
+    while True:
+        try:
+            if lt._get(base, "/api/ping", timeout=2).get("ok"):
+                return
+        except Exception:
+            pass
+        if proc.poll() is not None:
+            raise RuntimeError("server process died during boot")
+        if time.time() > deadline:
+            proc.kill()
+            raise RuntimeError("server never became ready")
+        time.sleep(0.5)
+
+
+MODES = (
+    ("off", {"RTPU_OBS_TRACE": "0"}),
+    ("sampled", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "0.1"}),
+    ("always_on", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "1.0"}),
+)
+
+
+def run_mode(lt, env_overrides: dict, threads: int, requests: int,
+             batch_size: int, repeats: int) -> dict:
+    proc, base = _spawn_server(env_overrides)
+    try:
+        _wait_ready(lt, proc, base)
+        # one untimed warmup sweep so every mode starts with hot buckets
+        warm = lt.PersistentPoster(base)
+        try:
+            for _ in range(3):
+                warm.post("/api/predict_eta",
+                          {"summary": {"distance": 10_000}})
+        finally:
+            warm.close()
+        # Best-of-N measured phases: on a 1-core host client and server
+        # time-share, so a single run's p95 carries scheduler noise that
+        # would swamp a few-percent tracing delta. The minimum is the
+        # achievable latency; noise only inflates it.
+        best, errors = None, 0
+        for _ in range(max(1, repeats)):
+            report, errs = lt.run_load([base], threads, requests)
+            errors += len(errs)
+            eta = report.get("predict_eta", {})
+            if best is None or (eta.get("p95_ms") or 1e9) < \
+                    (best["predict_eta"].get("p95_ms") or 1e9):
+                best = {"predict_eta": eta, "rps": report.get("rps")}
+        out = {**best, "errors": errors, "runs": max(1, repeats)}
+        if batch_size > 0:
+            batch_best = None
+            for _ in range(max(1, repeats)):
+                batch, berr = lt.run_batch_load([base], 2, 8, batch_size)
+                out["errors"] += len(berr)
+                if batch_best is None or (batch.get("preds_per_s") or 0) > \
+                        (batch_best.get("preds_per_s") or 0):
+                    batch_best = {k: batch.get(k) for k in
+                                  ("preds_per_s", "p50_ms", "p95_ms")}
+            out["predict_eta_batch"] = batch_best
+        return out
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--threads", type=int, default=8)
+    parser.add_argument("--requests", type=int, default=40,
+                        help="single-row requests per client thread")
+    parser.add_argument("--batch-size", type=int, default=2048,
+                        help="rows per predict_eta_batch request "
+                             "(0 skips the batch phase)")
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="measured phases per mode; best-of-N "
+                             "(noise only inflates latency)")
+    parser.add_argument("--out", default=os.path.join(
+        REPO, "artifacts", "obs_overhead.json"))
+    args = parser.parse_args()
+
+    lt = _load_load_test()
+    results = {}
+    for name, env_overrides in MODES:
+        print(f"[obs_overhead] mode={name} …", file=sys.stderr)
+        results[name] = run_mode(lt, env_overrides, args.threads,
+                                 args.requests, args.batch_size,
+                                 args.repeats)
+        print(f"[obs_overhead] {name}: "
+              f"{json.dumps(results[name].get('predict_eta', {}))}",
+              file=sys.stderr)
+
+    def p95(mode: str):
+        return results[mode].get("predict_eta", {}).get("p95_ms")
+
+    report = {
+        "modes": results,
+        "threads": args.threads,
+        "requests_per_thread": args.requests,
+        "cpu_count": os.cpu_count(),
+    }
+    if p95("off") and p95("always_on"):
+        overhead = (p95("always_on") - p95("off")) / p95("off") * 100.0
+        report["p95_overhead_always_on_pct"] = round(overhead, 2)
+        report["within_5pct_budget"] = bool(overhead <= 5.0)
+    if p95("off") and p95("sampled"):
+        report["p95_overhead_sampled_pct"] = round(
+            (p95("sampled") - p95("off")) / p95("off") * 100.0, 2)
+    bo = results.get("off", {}).get("predict_eta_batch", {})
+    ba = results.get("always_on", {}).get("predict_eta_batch", {})
+    if bo.get("preds_per_s") and ba.get("preds_per_s"):
+        report["batch_preds_per_s_delta_pct"] = round(
+            (ba["preds_per_s"] - bo["preds_per_s"])
+            / bo["preds_per_s"] * 100.0, 2)
+
+    print(json.dumps(report, indent=2))
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"[obs_overhead] report → {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

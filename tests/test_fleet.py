@@ -62,6 +62,8 @@ class _StubHandler(http.server.BaseHTTPRequestHandler):
             time.sleep(srv.delay_s)
         with srv.counter_lock:
             srv.hits += 1
+            srv.seen_headers.append({k.lower(): v
+                                     for k, v in self.headers.items()})
         if srv.fail_with:
             self._send(srv.fail_with, {"error": "stub failure"})
         else:
@@ -75,6 +77,7 @@ def _start_stub(delay_s=0.0):
     srv.delay_s = delay_s
     srv.fail_with = None
     srv.hits = 0
+    srv.seen_headers = []
     srv.counter_lock = threading.Lock()
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv
@@ -306,6 +309,62 @@ def test_hedged_requests_cut_slow_replica_tail():
         # schedule (≈ hedge delay + fast replica), not the 0.7 s sleep.
         assert min(lat) < 0.3
         assert sum(lat) < 6 * 0.7
+    finally:
+        gw.drain(timeout=5)
+
+
+# ── gateway: trace/correlation propagation (ISSUE 2) ─────────────────
+
+def test_gateway_mints_request_id_and_propagates_trace_context():
+    """Cross-process propagation, over real HTTP: the gateway mints
+    X-Request-ID when the client sent none (one hop earlier than the
+    replica used to), forwards it + a ``traceparent`` to the upstream,
+    and stamps X-RTPU-Replica + the ids on the response."""
+    stub = _start_stub()
+    gw, base = _gateway([("127.0.0.1", stub.server_port)])
+    try:
+        status, _, headers = _post(base, "/api/predict_eta", {"x": 1})
+        assert status == 200
+        rid = headers.get("X-Request-ID")
+        assert rid and len(rid) == 16           # minted, well-formed
+        assert headers.get("X-RTPU-Replica") == "r0"
+        assert headers.get("X-Fleet-Replica") == "r0"  # PR-1 back-compat
+        seen = stub.seen_headers[-1]
+        assert seen.get("x-request-id") == rid  # same id, one hop down
+        tp = seen.get("traceparent", "")
+        from routest_tpu.obs.trace import parse_traceparent
+
+        ctx = parse_traceparent(tp)
+        assert ctx is not None, tp
+        assert headers.get("X-Trace-Id") in (None, ctx.trace_id)
+    finally:
+        gw.drain(timeout=5)
+
+
+def test_gateway_honors_client_request_id_and_trace():
+    stub = _start_stub()
+    gw, base = _gateway([("127.0.0.1", stub.server_port)])
+    trace_id = "ab" * 16
+    try:
+        status, _, headers = _post(
+            base, "/api/predict_eta", {"x": 1},
+            headers={"X-Request-ID": "my-rid.1",
+                     "traceparent": f"00-{trace_id}-{'2' * 16}-01"})
+        assert status == 200
+        assert headers.get("X-Request-ID") == "my-rid.1"
+        seen = stub.seen_headers[-1]
+        assert seen.get("x-request-id") == "my-rid.1"
+        # the upstream hop carries the CLIENT's trace id with the
+        # gateway's own (fresh) span id — adopted, not parroted
+        tp = seen.get("traceparent", "")
+        assert tp.startswith(f"00-{trace_id}-")
+        assert f"-{'2' * 16}-" not in tp
+        # malformed client ids are replaced, not echoed
+        status, _, headers = _post(
+            base, "/api/predict_eta", {"x": 1},
+            headers={"X-Request-ID": "bad id!"})
+        assert headers.get("X-Request-ID") != "bad id!"
+        assert stub.seen_headers[-1].get("x-request-id") != "bad id!"
     finally:
         gw.drain(timeout=5)
 

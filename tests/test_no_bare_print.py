@@ -1,0 +1,49 @@
+"""Static sweep: no bare ``print()`` calls inside ``routest_tpu/``.
+
+Half the stack used to bypass the structured ``JsonLogger`` with ad-hoc
+status prints (serve/fleet entry points, the netbus broker banner, the
+train loop's epoch lines). Those are structured events now, and this
+test keeps the invariant from regressing: the ONLY permitted ``print``
+call is the logger's own emitter (``utils/logging.py``), which is how
+JSON lines physically reach stderr.
+
+AST-based, not grep-based: strings, comments, and identifiers that
+merely contain "print" (``graph_fingerprint``) must not trip it.
+"""
+
+import ast
+import os
+
+import routest_tpu
+
+PKG_ROOT = os.path.dirname(os.path.abspath(routest_tpu.__file__))
+
+# The logger's emitter is the one sanctioned print call site.
+ALLOWED = {os.path.join("utils", "logging.py")}
+
+
+def _print_calls(path):
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            yield node.lineno
+
+
+def test_no_bare_print_in_package():
+    offenders = []
+    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, PKG_ROOT)
+            if rel in ALLOWED:
+                continue
+            offenders.extend(f"{rel}:{line}" for line in _print_calls(path))
+    assert not offenders, (
+        "bare print() found (use utils.logging.JsonLogger): "
+        + ", ".join(offenders))

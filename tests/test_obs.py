@@ -1,0 +1,386 @@
+"""Observability spine (routest_tpu/obs): traceparent round-trips,
+registry exposition, batcher stage-span nesting under concurrency, and
+the hermetic gateway→replica→batcher single-trace end-to-end (ISSUE 2's
+acceptance bar)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from routest_tpu.core.config import Config, FleetConfig, ServeConfig
+from routest_tpu.core.dtypes import F32_POLICY
+from routest_tpu.models.eta_mlp import EtaMLP
+from routest_tpu.obs import (MetricsRegistry, SpanBuffer, get_registry,
+                             to_chrome_trace)
+from routest_tpu.obs.trace import (Tracer, configure_tracer,
+                                   current_context, format_traceparent,
+                                   get_tracer, parse_traceparent,
+                                   trace_span)
+from routest_tpu.serve.app import create_app
+from routest_tpu.serve.ml_service import DynamicBatcher, EtaService
+from routest_tpu.train.checkpoint import save_model
+
+
+@pytest.fixture()
+def tracer():
+    """Fresh always-sampling tracer installed as the process tracer, so
+    each test reads its own buffer; restored afterwards."""
+    old = get_tracer()
+    t = configure_tracer(Tracer(enabled=True, sample_rate=1.0,
+                                buffer_size=4096))
+    yield t
+    configure_tracer(old)
+
+
+@pytest.fixture(scope="module")
+def model_artifact(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("obs-model") / "eta.msgpack")
+    model = EtaMLP(hidden=(16, 16), policy=F32_POLICY)
+    save_model(path, model, model.init(jax.random.PRNGKey(0)))
+    return path
+
+
+# ── traceparent parse/inject round-trip ──────────────────────────────
+
+def test_traceparent_roundtrip(tracer):
+    with tracer.span("root") as root:
+        header = format_traceparent(root.ctx)
+        headers = {}
+        tracer.inject(headers)
+        assert headers["traceparent"] == header
+    ctx = parse_traceparent(header)
+    assert ctx.trace_id == root.trace_id
+    assert ctx.span_id == root.span_id
+    assert ctx.sampled is True
+
+
+def test_traceparent_unsampled_flag_roundtrip():
+    t = Tracer(enabled=True, sample_rate=0.0)
+    with t.span("root") as root:
+        header = format_traceparent(root.ctx)
+    assert header.endswith("-00")
+    ctx = parse_traceparent(header)
+    assert ctx.sampled is False
+    # and nothing was recorded
+    assert len(t.buffer) == 0
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "junk", "00-zz-11-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",     # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "ff-" + "a" * 32 + "-" + "1" * 16 + "-01",     # reserved version
+    "00-" + "a" * 31 + "-" + "1" * 16 + "-01",     # short trace id
+    "00-" + "a" * 32 + "-" + "1" * 16 + "-0x",     # bad flags
+    "00-" + "a" * 32 + "-" + "1" * 16,             # missing flags
+])
+def test_traceparent_malformed_falls_back_to_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_child_spans_share_trace_and_parent(tracer):
+    with tracer.span("a") as a:
+        with tracer.span("b") as b:
+            assert current_context().span_id == b.span_id
+        assert current_context().span_id == a.span_id
+    assert current_context() is None
+    spans = {s["name"]: s for s in tracer.buffer.snapshot()}
+    assert spans["b"]["trace_id"] == spans["a"]["trace_id"]
+    assert spans["b"]["parent_id"] == spans["a"]["span_id"]
+    assert spans["a"]["parent_id"] is None
+
+
+def test_disabled_tracer_is_noop_and_cheap():
+    t = Tracer(enabled=False)
+    with t.span("x") as sp:
+        assert sp.trace_id is None
+        assert current_context() is None
+        sp.set_attr("k", "v")  # must not explode
+    assert len(t.buffer) == 0
+
+
+def test_error_spans_record_status(tracer):
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("nope")
+    (rec,) = tracer.buffer.snapshot()
+    assert rec["status"] == "error"
+    assert "ValueError" in rec["attrs"]["error"]
+
+
+def test_span_buffer_bounded():
+    buf = SpanBuffer(capacity=4)
+    for i in range(10):
+        buf.add({"name": f"s{i}", "trace_id": "t"})
+    assert len(buf) == 4
+    assert buf.dropped == 6
+    assert [s["name"] for s in buf.snapshot()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_jsonl_export_knob(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    t = Tracer(enabled=True, sample_rate=1.0, export_path=path)
+    with t.span("exported", k=1):
+        pass
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[0]["name"] == "exported" and lines[0]["attrs"]["k"] == 1
+
+
+def test_chrome_trace_export_shape(tracer):
+    with tracer.span("outer"):
+        with tracer.span("inner"):
+            time.sleep(0.002)
+    doc = to_chrome_trace(tracer.buffer.snapshot())
+    assert {e["ph"] for e in doc["traceEvents"]} == {"X"}
+    inner = next(e for e in doc["traceEvents"] if e["name"] == "inner")
+    assert inner["dur"] >= 1000  # microseconds
+    assert inner["args"]["trace_id"]
+
+
+# ── registry ─────────────────────────────────────────────────────────
+
+def test_registry_prometheus_exposition_cumulative_and_escaped():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_seconds", "help text", ("route",),
+                      buckets=(0.01, 0.1, 1.0))
+    child = h.labels(route='a"b\\c\nd')
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        child.observe(v)
+    text = reg.prometheus_text()
+    assert "# HELP t_seconds help text" in text
+    assert "# TYPE t_seconds histogram" in text
+    label = 'route="a\\"b\\\\c\\nd"'
+    # bucket counts are CUMULATIVE and +Inf equals _count
+    assert f't_seconds_bucket{{{label},le="0.01"}} 2' in text
+    assert f't_seconds_bucket{{{label},le="0.1"}} 3' in text
+    assert f't_seconds_bucket{{{label},le="1.0"}} 4' in text
+    assert f't_seconds_bucket{{{label},le="+Inf"}} 5' in text
+    assert f't_seconds_count{{{label}}} 5' in text
+    # no raw newline escaped label values may split a sample line
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert line.count(" ") >= 1 and not line.startswith("le=")
+
+
+def test_registry_counter_gauge_and_type_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "jobs", ("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    assert reg.counter("jobs_total", labelnames=("kind",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("jobs_total")  # same name, different type
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)  # counters only go up
+    g = reg.gauge("temp")
+    g.set(3.5)
+    g.dec(0.5)
+    snap = reg.snapshot()
+    assert snap["jobs_total"]["series"][0]["value"] == 3.0
+    assert snap["temp"]["series"][0]["value"] == 3.0
+
+
+def test_histogram_quantiles_interpolate():
+    reg = MetricsRegistry()
+    h = reg.histogram("q_seconds", buckets=(0.1, 1.0, 10.0)).labels()
+    for _ in range(100):
+        h.observe(0.5)
+    # all mass in (0.1, 1.0]: interpolated quantiles stay inside it
+    assert 0.1 < h.quantile(0.5) <= 1.0
+    assert 0.1 < h.quantile(0.99) <= 1.0
+    h.observe(float("nan"))  # ignored, not poisoning sum
+    assert h.count == 100
+
+
+def test_request_stats_snapshot_shape_preserved():
+    from routest_tpu.utils.profiling import RequestStats
+
+    rs = RequestStats()
+    rs.add("GET /x", 0.010)
+    rs.add("GET /x", 0.020, error=True)
+    snap = rs.snapshot()
+    row = snap["routes"]["GET /x"]
+    assert row["count"] == 2 and row["errors"] == 1
+    assert row["mean_ms"] == 15.0
+    for k in ("p50_ms", "p95_ms", "p99_ms"):
+        assert row[k] > 0
+    assert snap["uptime_s"] >= 0
+
+
+# ── batcher stage spans under concurrency ────────────────────────────
+
+def test_batcher_stage_spans_nest_under_concurrency(tracer):
+    def slow_score(x):
+        time.sleep(0.003)
+        return np.asarray(x)[:, 0]
+
+    batcher = DynamicBatcher(slow_score, buckets=(8, 64), max_batch=64,
+                             max_wait_ms=5.0)
+    n_threads = 8
+    errs = []
+
+    def worker(i):
+        try:
+            with tracer.span(f"req{i}"):
+                out = batcher.submit(np.full((4, 3), i, np.float32))
+                assert len(out) == 4
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    spans = tracer.buffer.snapshot()
+    by_id = {s["span_id"]: s for s in spans}
+    waits = [s for s in spans if s["name"] == "batcher.queue_wait"]
+    flushes = [s for s in spans if s["name"] == "batcher.flush"]
+    computes = [s for s in spans if s["name"] == "batcher.device_compute"]
+    pads = [s for s in spans if s["name"] == "batcher.pad"]
+    # every request waited; every flush computed; padding is per flush
+    assert len(waits) == n_threads
+    assert flushes and len(computes) == len(flushes) == len(pads)
+    # nesting: compute/pad under a flush, flush under SOME request's
+    # queue_wait (the thread that triggered the drain), queue_wait under
+    # that request's root — and never across traces
+    for s in computes + pads:
+        parent = by_id[s["parent_id"]]
+        assert parent["name"] == "batcher.flush"
+        assert parent["trace_id"] == s["trace_id"]
+    for f in flushes:
+        parent = by_id[f["parent_id"]]
+        assert parent["name"] == "batcher.queue_wait"
+        assert parent["trace_id"] == f["trace_id"]
+    for w in waits:
+        root = by_id[w["parent_id"]]
+        assert root["name"].startswith("req")
+        assert root["trace_id"] == w["trace_id"]
+    # registry histograms moved too (stage attribution without spans)
+    snap = get_registry().snapshot()
+    assert snap["rtpu_batcher_queue_wait_seconds"]["series"][0]["count"] > 0
+    compute_series = snap["rtpu_batcher_device_compute_seconds"]["series"]
+    assert any(s["labels"]["bucket"] in ("8", "64") for s in compute_series)
+
+
+# ── hermetic end-to-end: one trace across gateway→replica→batcher ────
+
+def _serve_wsgi(app):
+    from werkzeug.serving import make_server
+
+    srv = make_server("127.0.0.1", 0, app, threaded=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+@pytest.fixture(scope="module")
+def fleet_stack(model_artifact):
+    """Real WSGI app over real HTTP behind the real gateway, all
+    in-process (the shared span buffer stands in for a trace
+    collector). Bucket warm-up is skipped — these tests assert span
+    topology, not latency."""
+    import os
+
+    from routest_tpu.serve.fleet.gateway import Gateway
+
+    old_warm = os.environ.get("ROUTEST_WARM_BUCKETS")
+    os.environ["ROUTEST_WARM_BUCKETS"] = "0"
+    try:
+        eta = EtaService(ServeConfig(), model_path=model_artifact)
+        app = create_app(Config(), eta_service=eta)
+    finally:
+        if old_warm is None:
+            os.environ.pop("ROUTEST_WARM_BUCKETS", None)
+        else:
+            os.environ["ROUTEST_WARM_BUCKETS"] = old_warm
+    srv = _serve_wsgi(app)
+    gw = Gateway([("127.0.0.1", srv.server_port)], FleetConfig(hedge=False))
+    httpd = gw.serve("127.0.0.1", 0)
+    yield gw, f"http://127.0.0.1:{httpd.server_address[1]}"
+    gw.drain(timeout=5)
+    srv.shutdown()
+
+
+def test_single_trace_spans_gateway_replica_batcher(tracer, fleet_stack):
+    """ISSUE 2 acceptance: drive the fleet path and assert ONE trace id
+    covers gateway routing, replica WSGI + handler, and batcher
+    queue/compute spans."""
+    _, base = fleet_stack
+    body = json.dumps({"summary": {"distance": 8000},
+                       "weather": "Sunny", "traffic": "Low"}).encode()
+    req = urllib.request.Request(
+        f"{base}/api/predict_eta", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+        trace_id = resp.headers["X-Trace-Id"]
+        rid = resp.headers["X-Request-ID"]
+        assert resp.headers["X-RTPU-Replica"] == "r0"
+    assert trace_id and rid
+
+    spans = tracer.buffer.snapshot(trace_id=trace_id)
+    names = {s["name"] for s in spans}
+    assert {"gateway.request", "gateway.forward", "replica.request",
+            "replica.handler", "batcher.queue_wait",
+            "batcher.flush", "batcher.device_compute"} <= names, names
+    by_id = {s["span_id"]: s for s in spans}
+    # the replica's server span must parent under the gateway's forward
+    # span — that's the cross-process handoff working
+    replica_root = next(s for s in spans if s["name"] == "replica.request")
+    assert by_id[replica_root["parent_id"]]["name"] == "gateway.forward"
+    assert replica_root["attrs"]["request_id"] == rid
+    gw_root = next(s for s in spans if s["name"] == "gateway.request")
+    assert gw_root["parent_id"] is None
+    assert gw_root["attrs"]["request_id"] == rid
+
+    # the debug endpoints serve the same trace from both tiers
+    with urllib.request.urlopen(
+            f"{base}/api/trace?trace_id={trace_id}", timeout=10) as r:
+        dump = json.loads(r.read())
+    assert {s["name"] for s in dump["spans"]} >= {"gateway.request",
+                                                  "replica.request"}
+    with urllib.request.urlopen(
+            f"{base}/api/metrics?format=prometheus", timeout=10) as r:
+        text = r.read().decode()
+    assert "rtpu_gateway_upstream_seconds_bucket" in text
+    assert "rtpu_batcher_device_compute_seconds_bucket" in text
+
+
+def test_client_traceparent_is_adopted_by_gateway(tracer, fleet_stack):
+    _, base = fleet_stack
+    client_trace = "f" * 32
+    req = urllib.request.Request(
+        f"{base}/api/predict_eta",
+        data=b'{"summary": {"distance": 1000}}',
+        headers={"Content-Type": "application/json",
+                 "traceparent": f"00-{client_trace}-{'1' * 16}-01",
+                 "X-Request-ID": "client-rid-1"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.headers["X-Trace-Id"] == client_trace
+        assert resp.headers["X-Request-ID"] == "client-rid-1"
+    spans = tracer.buffer.snapshot(trace_id=client_trace)
+    names = {s["name"] for s in spans}
+    assert "gateway.request" in names and "replica.request" in names
+
+
+def test_gateway_metrics_embed_replica_registry(fleet_stack):
+    """The fleet tier can serve worker-side registry metrics (batcher
+    stage histograms included) without a second scrape config."""
+    _, base = fleet_stack
+    with urllib.request.urlopen(f"{base}/api/metrics?replicas=1",
+                                timeout=30) as r:
+        snap = json.loads(r.read())
+    assert "registry" in snap  # gateway's own registry families
+    worker = snap["replica_metrics"]["r0"]
+    assert "rtpu_batcher_queue_wait_seconds" in worker.get("registry", {})
