@@ -148,6 +148,19 @@ class ObsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Fault injection (``routest_tpu/chaos``): a seeded, deterministic
+    chaos layer wrapping every IO boundary. Disabled unless
+    ``RTPU_CHAOS_SPEC`` names at least one fault point (and not
+    force-disabled with ``RTPU_CHAOS=0``). ``seed`` makes the failure
+    sequence replayable — same (spec, seed) → same faults, in order."""
+
+    enabled: bool = False
+    seed: int = 0
+    spec: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
@@ -155,6 +168,7 @@ class Config:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -243,7 +257,22 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         unhealthy_after=_int("RTPU_FLEET_UNHEALTHY_AFTER", 3),
     )
     return Config(mesh=mesh, model=model, train=train, serve=serve,
-                  fleet=fleet, obs=obs)
+                  fleet=fleet, obs=obs, chaos=load_chaos_config(env))
+
+
+def load_chaos_config(env: Optional[Mapping[str, str]] = None) -> ChaosConfig:
+    """Just the chaos knobs (read lazily by ``routest_tpu.chaos`` at
+    first ``inject`` without paying for a full Config build). A
+    malformed seed disables injection rather than aborting boot — chaos
+    must never be the thing that takes the server down at startup."""
+    env = dict(env if env is not None else os.environ)
+    spec = env.get("RTPU_CHAOS_SPEC", "")
+    try:
+        seed = int(env.get("RTPU_CHAOS_SEED") or 0)
+    except ValueError:
+        return ChaosConfig(enabled=False, seed=0, spec=spec)
+    enabled = bool(spec.strip()) and env.get("RTPU_CHAOS", "1") != "0"
+    return ChaosConfig(enabled=enabled, seed=seed, spec=spec)
 
 
 def load_obs_config(env: Optional[Mapping[str, str]] = None) -> ObsConfig:

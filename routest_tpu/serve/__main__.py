@@ -12,8 +12,6 @@ from __future__ import annotations
 
 import os
 
-from werkzeug.serving import run_simple
-
 from routest_tpu.core.config import load_config
 from routest_tpu.serve.app import create_app
 from routest_tpu.train.checkpoint import default_model_path
@@ -98,10 +96,16 @@ def main() -> None:
     # under concurrent load.
     from werkzeug.serving import WSGIRequestHandler
 
+    from routest_tpu.serve.wsgi import run_with_graceful_shutdown
+
     WSGIRequestHandler.protocol_version = "HTTP/1.1"
     _log.info("serve_listening", host=config.serve.host,
               port=config.serve.port)
-    run_simple(config.serve.host, config.serve.port, app, threaded=True)
+    # SIGTERM/SIGINT drain: stop accepting, finish in-flight handlers,
+    # then exit — the single-replica analog of the fleet's drain path
+    # (a supervisor TERM must not kill a worker mid-request).
+    run_with_graceful_shutdown(app, config.serve.host, config.serve.port)
+    _log.info("serve_stopped")
 
 
 if __name__ == "__main__":

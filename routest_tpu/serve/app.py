@@ -30,8 +30,9 @@ from routest_tpu.serve import sim
 from routest_tpu.serve import auth as auth_mod
 from routest_tpu.serve.auth import AuthService, mount_auth
 from routest_tpu.serve.bus import make_bus, sse_stream
+from routest_tpu.serve.deadline import DeadlineExceeded
 from routest_tpu.serve.ml_service import EtaService
-from routest_tpu.serve.store import make_store
+from routest_tpu.serve.store import StoreUnavailable, make_store
 from routest_tpu.serve.wsgi import App, get_json
 from routest_tpu.utils.logging import get_logger
 
@@ -150,6 +151,11 @@ def create_app(config: Optional[Config] = None,
             if req_id:
                 result.setdefault("properties", {})["request_id"] = req_id
                 result["properties"]["saved"] = True
+                # Write-behind: the rows are journaled, not yet durable
+                # at the backend — surface that honestly (the id is
+                # still valid; the journal replays on recovery).
+                if getattr(state.store, "degraded", False):
+                    result["properties"]["degraded"] = True
         except Exception as e:
             _log.error("persist_failed", error=str(e),
                        store=state.store.kind)
@@ -198,6 +204,8 @@ def create_app(config: Optional[Config] = None,
                                   .get("driver_age", 30) or 30)
                             for i, _ in ok],
                     )
+                except DeadlineExceeded:
+                    raise  # 504: the whole batch's budget is gone
                 except Exception as e:
                     _log.error("batch_eta_failed", error=str(e))
                     minutes = None
@@ -328,6 +336,8 @@ def create_app(config: Optional[Config] = None,
             minutes, iso, bands = state.eta.predict_eta_batch(
                 weather=weather, traffic=traffic, distance_m=distance,
                 pickup_time=pickup, driver_age=age, return_quantiles=True)
+        except DeadlineExceeded:
+            raise  # → 504 via the WSGI layer, not a 503 "model outage"
         except Exception as e:
             _log.error("predict_batch_failed", error=str(e))
             minutes = None
@@ -448,6 +458,11 @@ def create_app(config: Optional[Config] = None,
             return {"error": "engine must be 'ml' or 'default'"}, 400
         try:
             rows = state.store.list_history(limit, engine=engine)
+        except StoreUnavailable:
+            # Degraded-mode read: the store's circuit breaker is open —
+            # fail FAST with an explicit marker instead of stacking
+            # timeouts against a dead backend (docs/ROBUSTNESS.md).
+            return {"items": [], "degraded": True}, 200
         except Exception as e:
             return {"error": f"history fetch failed: {e}"}, 500
 
@@ -476,6 +491,9 @@ def create_app(config: Optional[Config] = None,
     def history_detail(request, req_id):
         try:
             row = state.store.get_request(req_id)
+        except StoreUnavailable:
+            return {"error": "store degraded; retry later",
+                    "degraded": True}, 503
         except Exception as e:
             return {"error": f"history fetch failed: {e}"}, 500
         if row is None:
@@ -504,6 +522,9 @@ def create_app(config: Optional[Config] = None,
             return auth_mod.UNAUTHENTICATED
         try:
             deleted = state.store.delete_request(req_id)
+        except StoreUnavailable:
+            return {"error": "store degraded; retry later",
+                    "degraded": True}, 503
         except Exception as e:
             return {"error": f"delete failed: {e}"}, 500
         if not deleted:
@@ -626,6 +647,15 @@ def create_app(config: Optional[Config] = None,
         store_res = {"status": "ok" if store_ok else "error",
                      "latency_ms": int((time.time() - t0) * 1000),
                      "backend": state.store.kind}
+        # Degraded-mode visibility: breaker state + journal depth when
+        # the store is wrapped in the resilience layer (always, via
+        # make_store). A store with journaled writes is "degraded", not
+        # "ok" — readers must know history may lag.
+        resilience = getattr(state.store, "resilience", None)
+        if resilience is not None:
+            store_res["resilience"] = resilience()
+            if store_ok and getattr(state.store, "degraded", False):
+                store_res["status"] = "degraded"
         # The routing engine is in-process now: report it with a trivial
         # self-check instead of probing ORS over the internet.
         engine_res = {"status": "ok" if state.eta is not None else "error",
@@ -762,8 +792,11 @@ def _device_memory(jax) -> dict:
                 entry["bytes_limit"] = int(limit)
                 entry["utilization"] = round(used / limit, 4)
             out[str(d)] = entry
-    except Exception:
-        pass
+    except Exception as e:
+        # Gauge-only: health must never fail over missing memory stats
+        # (CPU backends, tunnel transports) — but the miss is loggable.
+        _log.debug("device_memory_unavailable",
+                   error=f"{type(e).__name__}: {e}")
     return out
 
 
@@ -788,8 +821,9 @@ def _tpu_roofline(jax) -> dict:
         if peak_tflops is not None:
             out["peak_tflops_bf16"] = peak_tflops
             out["peak_hbm_gbps"] = peak_hbm
-    except Exception:
-        pass
+    except Exception as e:
+        _log.debug("chip_peaks_unavailable",
+                   error=f"{type(e).__name__}: {e}")
     try:
         import json as _json
 
@@ -811,8 +845,10 @@ def _tpu_roofline(jax) -> dict:
             _roofline_cache["mtime"] = mtime
         if _roofline_cache["value"]:
             out["last_bench"] = _roofline_cache["value"]
-    except Exception:
-        pass
+    except Exception as e:
+        # Missing/malformed bench artifact: gauge absent, health up.
+        _log.debug("bench_roofline_unavailable",
+                   error=f"{type(e).__name__}: {e}")
     return out
 
 

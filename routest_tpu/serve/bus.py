@@ -192,19 +192,39 @@ class _RedisSubscription:
 def make_bus(redis_url: Optional[str]):
     """Bus from the REDIS_URL scheme: ``redis(s)://`` → RedisBus,
     ``tcp://`` → the hermetic cross-process broker (``serve/netbus.py``),
-    unset/unreachable → in-memory (single-process)."""
+    unset/unreachable → in-memory (single-process). The serving path's
+    NetBus gets subscriber auto-reconnect (``RTPU_NETBUS_RECONNECT_S``,
+    default 30 s of broker downtime before an SSE stream gives up) and
+    the bounded publish replay buffer — SSE survives a broker restart."""
+    import os
+
+    from routest_tpu.utils.logging import get_logger
+
     if redis_url:
         try:
             if redis_url.startswith("tcp://"):
                 from routest_tpu.serve.netbus import NetBus
 
-                bus = NetBus(redis_url)
+                try:
+                    reconnect_s = float(
+                        os.environ.get("RTPU_NETBUS_RECONNECT_S") or 30.0)
+                except ValueError:
+                    reconnect_s = 30.0
+                bus = NetBus(redis_url, reconnect_s=reconnect_s)
             else:
                 bus = RedisBus(redis_url)
             if bus.ping():
                 return bus
-        except Exception:
-            pass
+            get_logger("routest_tpu.serve.bus").warning(
+                "bus_unreachable", url=redis_url,
+                fallback="in-memory (single-process SSE only)")
+        except Exception as e:
+            # Visible degrade: the configured cross-process bus is gone;
+            # in-memory keeps SSE working within this process only.
+            get_logger("routest_tpu.serve.bus").warning(
+                "bus_unavailable", url=redis_url,
+                error=f"{type(e).__name__}: {e}",
+                fallback="in-memory (single-process SSE only)")
     return InMemoryBus()
 
 

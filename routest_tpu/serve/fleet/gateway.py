@@ -279,26 +279,43 @@ class Gateway:
 
     def _forward_once(self, r: _Upstream, method: str, path: str,
                       body: Optional[bytes], headers: Dict[str, str],
-                      timeout: float, parent=None, slot: str = "primary"):
+                      timeout: float, parent=None, slot: str = "primary",
+                      deadline: Optional[float] = None):
         """→ (status, headers, body) or raises OSError/HTTPException.
         Counts the exchange into the replica's breaker + stats. The
         forward span parents under ``parent`` when given (hedge copies
         run on worker threads, where the ambient context doesn't
         follow), else under the ambient span; its context is what gets
-        injected as ``traceparent`` on the upstream hop."""
+        injected as ``traceparent`` on the upstream hop.
+
+        ``deadline`` (wall-clock) is re-stamped as the REMAINING budget
+        in ``X-Deadline-Ms`` at send time — each hop (retry and hedge
+        included) carries what is actually left, not what the client
+        originally asked for, so the replica can refuse doomed work."""
+        from routest_tpu.chaos import inject as chaos_inject
         from routest_tpu.obs.trace import CURRENT
 
         with trace_span("gateway.forward",
                         parent=parent if parent is not None else CURRENT,
                         replica=r.id, slot=slot) as fspan:
+            headers = dict(headers)
+            if deadline is not None:
+                remaining_ms = max(1, int((deadline - time.time()) * 1000))
+                headers["X-Deadline-Ms"] = str(remaining_ms)
+                fspan.set_attr("deadline_ms", remaining_ms)
             if fspan.ctx is not None:
-                headers = dict(headers)
                 get_tracer().inject(headers)
             t0 = time.perf_counter()
             conn = None
             pooled = False
             try:
                 try:
+                    # Chaos fault points: generic + per-replica (so a
+                    # spec can slow or drop exactly one replica's hops).
+                    # A drop raises ConnectionError → the normal
+                    # transport-failure path: breaker charge, retry.
+                    chaos_inject("gateway.forward")
+                    chaos_inject(f"gateway.forward.{r.id}")
                     conn, pooled = r.get_conn(timeout)
                     conn.request(method, path, body=body, headers=headers)
                     resp = conn.getresponse()
@@ -407,9 +424,13 @@ class Gateway:
     def _routed(self, method, path, body, headers, deadline):
         bare = path.split("?", 1)[0]
         idempotent = method in ("GET", "HEAD") or bare in _IDEMPOTENT_POST
+        # The client's X-Deadline-Ms is consumed here (it defined
+        # ``deadline``); each upstream hop gets a fresh header carrying
+        # the REMAINING budget, stamped in _forward_once at send time.
         fwd_headers = {k: v for k, v in headers.items()
                        if k.lower() not in _HOP_HEADERS
-                       and k.lower() not in ("host", "traceparent")}
+                       and k.lower() not in ("host", "traceparent",
+                                             "x-deadline-ms")}
         timeout = max(0.2, deadline - time.time())
 
         primary = self._pick()
@@ -424,13 +445,14 @@ class Gateway:
                           or len(body) <= self.config.hedge_max_body_bytes))
         if hedgeable:
             result = self._forward_hedged(primary, method, path, body,
-                                          fwd_headers, timeout)
+                                          fwd_headers, timeout, deadline)
             if result is not None:
                 return result
         else:
             try:
                 status, rh, data = self._forward_once(
-                    primary, method, path, body, fwd_headers, timeout)
+                    primary, method, path, body, fwd_headers, timeout,
+                    deadline=deadline)
                 _tag_replica(rh, primary.id)
                 return status, rh, data
             except (http.client.HTTPException, OSError):
@@ -449,7 +471,8 @@ class Gateway:
         try:
             status, rh, data = self._forward_once(
                 retry, method, path, body, fwd_headers,
-                max(0.2, deadline - time.time()), slot="retry")
+                max(0.2, deadline - time.time()), slot="retry",
+                deadline=deadline)
             _tag_replica(rh, retry.id)
             return status, rh, data
         except (http.client.HTTPException, OSError):
@@ -457,7 +480,7 @@ class Gateway:
                 json.dumps({"error": "upstream connection failed"}).encode()
 
     def _forward_hedged(self, primary, method, path, body, headers,
-                        timeout):
+                        timeout, fwd_deadline=None):
         """Primary in a worker thread; if it is still in flight after
         the p95-based delay, race a hedge on another replica. Returns
         the first SUCCESSFUL result, else the primary's failure — or
@@ -475,7 +498,8 @@ class Gateway:
             try:
                 res = self._forward_once(r, method, path, body,
                                          dict(headers), timeout,
-                                         parent=parent_ctx, slot=slot)
+                                         parent=parent_ctx, slot=slot,
+                                         deadline=fwd_deadline)
             except (http.client.HTTPException, OSError):
                 res = None
             box.append((slot, r, res))

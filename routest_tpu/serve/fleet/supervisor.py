@@ -158,6 +158,32 @@ class ReplicaSupervisor:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
 
+    def kill_replica(self, index: int) -> bool:
+        """Chaos hook — the ``replica.kill`` fault point. Hard-kills one
+        worker process (SIGKILL: a crash, not a drain); the monitor
+        notices the exit and walks the normal backoff-restart path.
+        Returns True when a live process was killed. A process kill
+        cannot be a probability draw inside the victim, so the harness
+        actuates it here and the chaos ledger records it."""
+        try:
+            r = self._replicas[index]
+        except IndexError:
+            return False
+        with self._lock:
+            proc = r.proc
+            if proc is None or proc.poll() is not None:
+                return False
+        try:
+            proc.kill()
+        except OSError:
+            return False
+        from routest_tpu.chaos import get_chaos
+
+        get_chaos().record("replica.kill", "kill")
+        _log.warning("replica_chaos_killed", index=index, port=r.port,
+                     pid=proc.pid)
+        return True
+
     # ── monitoring ─────────────────────────────────────────────────────
 
     def _probe(self, port: int) -> bool:

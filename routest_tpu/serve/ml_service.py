@@ -30,6 +30,7 @@ from routest_tpu.models.eta_mlp import EtaMLP, Params
 from routest_tpu.obs import get_registry
 from routest_tpu.obs.export import maybe_device_trace
 from routest_tpu.obs.trace import trace_span
+from routest_tpu.serve.deadline import DeadlineExceeded
 from routest_tpu.train.checkpoint import default_model_path, load_model
 
 
@@ -83,13 +84,17 @@ def _band_label(level: float) -> str:
 
 
 class _Pending:
-    __slots__ = ("rows", "event", "result", "error")
+    __slots__ = ("rows", "event", "result", "error", "deadline")
 
-    def __init__(self, rows: np.ndarray) -> None:
+    def __init__(self, rows: np.ndarray,
+                 deadline: Optional[float] = None) -> None:
         self.rows = rows
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # Absolute time.monotonic() deadline captured from the ambient
+        # request context at submit; None = no budget.
+        self.deadline = deadline
 
 
 class DynamicBatcher:
@@ -102,8 +107,13 @@ class DynamicBatcher:
     """
 
     def __init__(self, score_fn, buckets: Sequence[int], max_batch: int,
-                 max_wait_ms: float, align: int = 1) -> None:
+                 max_wait_ms: float, align: int = 1,
+                 hard_cap_s: float = 60.0) -> None:
         self._score = score_fn
+        # Waiter give-up bound: a submit with no request deadline still
+        # cannot wait past this — a wedged flush thread (device hang)
+        # must surface as DeadlineExceeded, not pin the waiter forever.
+        self._hard_cap_s = hard_cap_s
         # ``align`` = mesh data-shard count: every device batch must divide
         # evenly across the data axis, so bucket sizes round up to multiples.
         self._align = max(1, align)
@@ -142,6 +152,12 @@ class DynamicBatcher:
             "rtpu_batcher_rows_total", "Rows scored through the batcher.")
         self._m_flushes = reg.counter(
             "rtpu_batcher_flushes_total", "Batcher drains executed.")
+        self._m_expired = reg.counter(
+            "rtpu_batcher_expired_total",
+            "Requests whose deadline expired inside the batcher: "
+            "dropped at drain time (stage=drain) or abandoned by their "
+            "waiter (stage=wait). Expired rows never reach the device.",
+            ("stage",))
 
     def _bucket(self, n: int) -> int:
         for b in self._buckets:
@@ -151,8 +167,18 @@ class DynamicBatcher:
         return ((n + self._align - 1) // self._align) * self._align
 
     def submit(self, rows: np.ndarray) -> np.ndarray:
-        pending = _Pending(rows)
+        from routest_tpu.serve.deadline import current_deadline
+
+        pending = _Pending(rows, deadline=current_deadline())
         t_submit = time.perf_counter()
+        t_mono = time.monotonic()
+        # Waiter give-up point: the request's own deadline when it has
+        # one, else the batcher's hard cap. Without this, a wedged
+        # flush thread (device hang) pinned every waiter in a 1 ms spin
+        # forever.
+        give_up_at = t_mono + self._hard_cap_s
+        if pending.deadline is not None:
+            give_up_at = min(give_up_at, pending.deadline)
         with trace_span("batcher.queue_wait", rows=len(rows)) as qs:
             with self._lock:
                 self._queue.append(pending)
@@ -164,23 +190,42 @@ class DynamicBatcher:
             # arrives via pending.error below, so never re-raise from
             # the shared flush.
             if should_flush:
-                try:
-                    self._flush()
-                except Exception:
-                    pass
+                self._flush_quietly()
             deadline = time.monotonic() + self._max_wait
+            spin = 0.001
             while True:
                 # Oldest-waiter timeout: whoever wakes first drains the
-                # queue. After the deadline, keep a 1 ms wait in the loop
-                # so a flush in flight on another thread isn't hot-spun
-                # against.
-                remaining = deadline - time.monotonic()
-                if pending.event.wait(timeout=max(remaining, 0.001)):
+                # queue. After the deadline, short waits keep a flush in
+                # flight on another thread from being hot-spun against —
+                # escalating (1 → 50 ms) so a wedged flush costs wakeups,
+                # not a pinned core — and ``give_up_at`` bounds the whole
+                # wait: past it the entry is withdrawn and the waiter
+                # raises DeadlineExceeded.
+                now = time.monotonic()
+                if now >= give_up_at and not pending.event.is_set():
+                    with self._lock:
+                        if pending in self._queue:
+                            self._queue.remove(pending)
+                            self._queued_rows -= len(pending.rows)
+                    if not pending.event.is_set():
+                        qs.set_attr("expired", True)
+                        self._m_expired.labels(stage="wait").inc()
+                        self._m_queue_wait.observe(
+                            time.perf_counter() - t_submit)
+                        raise DeadlineExceeded(
+                            f"batcher wait exceeded "
+                            f"{(now - t_mono) * 1000:.0f} ms budget")
+                remaining = deadline - now
+                if remaining <= 0:
+                    remaining = spin
+                    spin = min(spin * 2, 0.05)
+                wait = max(min(remaining, give_up_at - now + 0.001), 0.001)
+                if pending.event.wait(timeout=wait):
                     break
-                try:
-                    self._flush()
-                except Exception:
-                    pass
+                if time.monotonic() >= give_up_at:
+                    continue  # give up (loop top) rather than start a
+                              # flush this waiter can no longer wait for
+                self._flush_quietly()
             qs.set_attr("flushed_inline", should_flush)
         self._m_queue_wait.observe(time.perf_counter() - t_submit)
         if pending.error is not None:
@@ -191,25 +236,65 @@ class DynamicBatcher:
         assert pending.result is not None
         return pending.result
 
+    def _flush_quietly(self) -> None:
+        """Run a flush whose exceptions belong to the affected waiters
+        (delivered via their ``pending.error``), not to this caller."""
+        try:
+            self._flush()
+        except Exception as e:
+            from routest_tpu.utils.logging import get_logger
+
+            get_logger("routest_tpu.serve").debug(
+                "batcher_flush_failed", error=f"{type(e).__name__}: {e}")
+
     def _flush(self) -> None:
+        from routest_tpu.chaos import inject as chaos_inject
+
         while True:
+            expired: List[_Pending] = []
             with self._lock:
                 if self._flushing or not self._queue:
                     return
-                self._flushing = True
-                # Drain at most max_batch rows (whole requests): with
-                # submissions pre-chunked to the largest bucket, every
-                # flush shape stays bucketed — unbounded drains compiled
-                # a fresh XLA executable per novel concatenated size.
-                taken = cnt = 0
+                # Deadline drop at drain time: an entry whose budget
+                # expired while queued is withdrawn BEFORE batch
+                # assembly — the device batch must never contain rows
+                # nobody is waiting for (its waiter gets 504 below).
+                now = time.monotonic()
+                keep = []
                 for p in self._queue:
-                    if cnt and taken + len(p.rows) > self._drain_cap:
-                        break
-                    taken += len(p.rows)
-                    cnt += 1
-                batch = self._queue[:cnt]      # O(k) slice, not O(n) pops
-                del self._queue[:cnt]
-                self._queued_rows -= taken
+                    if p.deadline is not None and now >= p.deadline:
+                        expired.append(p)
+                        self._queued_rows -= len(p.rows)
+                    else:
+                        keep.append(p)
+                if expired:
+                    self._queue[:] = keep
+                if not self._queue:
+                    batch: List[_Pending] = []
+                    taken = cnt = 0
+                else:
+                    self._flushing = True
+                    # Drain at most max_batch rows (whole requests): with
+                    # submissions pre-chunked to the largest bucket, every
+                    # flush shape stays bucketed — unbounded drains
+                    # compiled a fresh XLA executable per novel
+                    # concatenated size.
+                    taken = cnt = 0
+                    for p in self._queue:
+                        if cnt and taken + len(p.rows) > self._drain_cap:
+                            break
+                        taken += len(p.rows)
+                        cnt += 1
+                    batch = self._queue[:cnt]  # O(k) slice, not O(n) pops
+                    del self._queue[:cnt]
+                    self._queued_rows -= taken
+            for p in expired:
+                p.error = DeadlineExceeded("expired in batch queue")
+                p.event.set()
+            if expired:
+                self._m_expired.labels(stage="drain").inc(len(expired))
+            if not batch:
+                return
             try:
                 t_flush = time.perf_counter()
                 with trace_span("batcher.flush", requests=cnt) as fs:
@@ -224,6 +309,10 @@ class DynamicBatcher:
                     t_dev = time.perf_counter()
                     with trace_span("batcher.device_compute", rows=n,
                                     bucket=bucket) as ds:
+                        # Chaos fault point: an injected error here is
+                        # indistinguishable from a dead device — every
+                        # waiter in this batch must surface it.
+                        chaos_inject("device.compute")
                         # xplane capture budget permitting, a sampled
                         # flush also records the device trace that
                         # explains it (one trace id across both).
@@ -716,6 +805,8 @@ class EtaService:
         )
         try:
             preds = self._predict_rows(serving, rows)
+        except DeadlineExceeded:
+            raise  # 504, not "model unavailable": the budget ran out
         except Exception:
             return None, None
         if preds is None:
@@ -751,6 +842,8 @@ class EtaService:
                 weather=[weather], traffic=[traffic], distance_m=[distance_m],
                 pickup_time=pickup_dt, driver_age=[driver_age],
                 return_quantiles=True)
+        except DeadlineExceeded:
+            raise  # budget expiry must surface as 504, not a null field
         except Exception:
             # Same degrade-gracefully contract as predict_eta_minutes: a
             # scoring failure is (None, None), never an exception — the

@@ -25,6 +25,7 @@ security model (bind 127.0.0.1 unless told otherwise).
 
 from __future__ import annotations
 
+import collections
 import json
 import select
 import socket
@@ -79,6 +80,15 @@ class _BrokerHandler(socketserver.StreamRequestHandler):
                             else None
                     except (ValueError, OverflowError):
                         lei = None
+                    # Event-id epochs: ids restart when a broker does, so
+                    # a resume id from a PREVIOUS incarnation would
+                    # silently skip everything already republished into
+                    # this one. A client that proves it watched a
+                    # different epoch gets the full ring instead.
+                    client_epoch = msg.get("epoch")
+                    if (lei is not None and client_epoch is not None
+                            and client_epoch != server.epoch):
+                        lei = 0
                     # Register-then-ack, both under the write lock: the
                     # ack must imply "registered" (a caller may publish
                     # immediately after subscribe() returns), while the
@@ -92,7 +102,9 @@ class _BrokerHandler(socketserver.StreamRequestHandler):
                     # pushed live, never both or neither.
                     with self._wlock:
                         replay = server.add_subscriber(subscribed, self, lei)
-                        self.wfile.write(b'{"ok": true}\n')
+                        self.wfile.write(json.dumps(
+                            {"ok": True, "epoch": server.epoch}
+                        ).encode() + b"\n")
                         for line in replay:
                             self.wfile.write(line)
                         self.wfile.flush()
@@ -130,6 +142,11 @@ class Broker(socketserver.ThreadingTCPServer):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         super().__init__((host, port), _BrokerHandler)
+        # Epoch: identifies this broker incarnation in subscribe acks;
+        # event ids are only comparable within one epoch.
+        import uuid as _uuid
+
+        self.epoch = _uuid.uuid4().hex[:12]
         self._subs: Dict[str, Set[_BrokerHandler]] = {}
         self._subs_lock = threading.Lock()
         self._next_id: Dict[str, int] = {}
@@ -226,16 +243,38 @@ class NetBus:
     stalled subscriber may cost up to ``_SEND_TIMEOUT_S`` before being
     dropped, so publish acks can lag several seconds without the publish
     having failed.
+
+    Degraded mode (a broker restart must not lose the tracker feed):
+
+    - a publish that dies at transport level is BUFFERED in a bounded
+      replay ring and re-published by a background reconnect thread
+      (capped-backoff ping loop) once the broker answers again —
+      callers see ``0 receivers``, never an exception;
+    - ``reconnect_s > 0`` makes subscriptions self-healing: a dropped
+      subscription re-subscribes with its ``last_event_id`` (resuming
+      from the broker's replay ring when it survived, or live when the
+      broker restarted fresh) for up to ``reconnect_s`` seconds of
+      broker downtime before reporting ``closed``. The default (0)
+      keeps the historical contract — closed means closed, the SSE
+      stream ends, the browser reconnects — which several tests and
+      the slow-consumer drop policy rely on; ``make_bus`` opts the
+      serving path in via ``RTPU_NETBUS_RECONNECT_S``.
     """
 
     def __init__(self, url: str, timeout: float = 2.0,
-                 ack_timeout: float = 10.0) -> None:
+                 ack_timeout: float = 10.0, reconnect_s: float = 0.0,
+                 replay_limit: int = 256) -> None:
         self._addr = _parse(url)
         self._timeout = timeout
         self._ack_timeout = ack_timeout
+        self._reconnect_s = reconnect_s
         self._lock = threading.Lock()  # one command in flight on the conn
         self._conn: Optional[socket.socket] = None
         self._rfile = None
+        self._replay_limit = max(1, replay_limit)
+        self._replay: collections.deque = collections.deque()
+        self._replay_lock = threading.Lock()
+        self._reconnect_thread: Optional[threading.Thread] = None
 
     def _connect(self):
         conn = socket.create_connection(self._addr, timeout=self._timeout)
@@ -285,13 +324,24 @@ class NetBus:
         raise ConnectionError("unreachable")  # pragma: no cover
 
     def publish(self, channel: str, data: dict) -> int:
+        from routest_tpu.chaos import inject as chaos_inject
         from routest_tpu.obs import get_registry
         from routest_tpu.obs.trace import trace_span
 
         t0 = time.monotonic()
         with trace_span("netbus.publish", channel=channel) as sp:
-            resp = self._command({"op": "publish", "channel": channel,
-                                  "data": data}, retry_after_ack_loss=False)
+            try:
+                chaos_inject("netbus.publish")
+                resp = self._command({"op": "publish", "channel": channel,
+                                      "data": data},
+                                     retry_after_ack_loss=False)
+            except (ConnectionError, OSError) as e:
+                # Broker down: buffer for replay instead of failing the
+                # tracker POST — degraded, not down. Receivers=0 is
+                # honest (nobody got it yet).
+                self._buffer_publish(channel, data, e)
+                sp.set_attr("buffered", True)
+                return 0
             receivers = int(resp.get("receivers", 0))
             sp.set_attr("receivers", receivers)
         get_registry().histogram(
@@ -300,18 +350,121 @@ class NetBus:
                 time.monotonic() - t0)
         return receivers
 
+    # ── degraded mode: publish replay + background reconnect ──────────
+
+    def _buffer_publish(self, channel: str, data: dict,
+                        error: BaseException) -> None:
+        from routest_tpu.obs import get_registry
+        from routest_tpu.utils.logging import get_logger
+
+        with self._replay_lock:
+            dropped = 0
+            while len(self._replay) >= self._replay_limit:
+                self._replay.popleft()   # bounded: oldest events lost
+                dropped += 1
+            self._replay.append((channel, data))
+            depth = len(self._replay)
+        reg = get_registry()
+        reg.counter("rtpu_netbus_buffered_total",
+                    "Publishes buffered while the broker was down.").inc()
+        if dropped:
+            reg.counter(
+                "rtpu_netbus_replay_dropped_total",
+                "Buffered publishes lost to the bound.").inc(dropped)
+        get_logger("routest_tpu.netbus").warning(
+            "netbus_publish_buffered", channel=channel, depth=depth,
+            error=f"{type(error).__name__}: {error}")
+        self._ensure_reconnect_thread()
+
+    def _ensure_reconnect_thread(self) -> None:
+        with self._replay_lock:
+            if (self._reconnect_thread is not None
+                    and self._reconnect_thread.is_alive()):
+                return
+            t = threading.Thread(target=self._reconnect_loop,
+                                 name="netbus-reconnect", daemon=True)
+            self._reconnect_thread = t
+        t.start()
+
+    def _reconnect_loop(self) -> None:
+        """Capped-backoff ping loop; on recovery, re-publish the buffer
+        FIFO. Exits when the buffer is drained (restarted on the next
+        buffered publish)."""
+        from routest_tpu.obs import get_registry
+        from routest_tpu.utils.logging import get_logger
+
+        log = get_logger("routest_tpu.netbus")
+        backoff = 0.05
+        while True:
+            with self._replay_lock:
+                if not self._replay:
+                    self._reconnect_thread = None
+                    return
+            if not self.ping():
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            backoff = 0.05
+            replayed = 0
+            while True:
+                with self._replay_lock:
+                    if not self._replay:
+                        break
+                    channel, data = self._replay[0]
+                try:
+                    self._command({"op": "publish", "channel": channel,
+                                   "data": data},
+                                  retry_after_ack_loss=False)
+                except (ConnectionError, OSError):
+                    break  # broker died again; keep the entry, back off
+                with self._replay_lock:
+                    if self._replay and self._replay[0] == (channel, data):
+                        self._replay.popleft()
+                replayed += 1
+            if replayed:
+                get_registry().counter(
+                    "rtpu_netbus_replayed_total",
+                    "Buffered publishes replayed after reconnect."
+                ).inc(replayed)
+                log.info("netbus_replayed", replayed=replayed,
+                         remaining=len(self._replay))
+
+    @property
+    def replay_depth(self) -> int:
+        with self._replay_lock:
+            return len(self._replay)
+
     def subscribe(self, channel: str,
-                  last_event_id: Optional[int] = None) -> "_NetSubscription":
+                  last_event_id: Optional[int] = None):
+        from routest_tpu.chaos import inject as chaos_inject
+
+        chaos_inject("netbus.subscribe")
+        sub = self._raw_subscribe(channel, last_event_id)
+        if self._reconnect_s > 0:
+            return _ReconnectingSubscription(self, channel, sub,
+                                             self._reconnect_s)
+        return sub
+
+    def _raw_subscribe(self, channel: str,
+                       last_event_id: Optional[int] = None,
+                       epoch: Optional[str] = None) -> "_NetSubscription":
         conn = socket.create_connection(self._addr, timeout=self._timeout)
         req = {"op": "subscribe", "channel": channel}
         if last_event_id is not None:
             req["last_event_id"] = int(last_event_id)
+        if epoch is not None:
+            req["epoch"] = epoch
         conn.sendall(json.dumps(req).encode() + b"\n")
         sub = _NetSubscription(conn)
         ack = sub._read_line(timeout=self._timeout)
-        if ack is None or not json.loads(ack).get("ok"):
+        if ack is None:
             conn.close()
             raise ConnectionError(f"subscribe to {channel!r} refused")
+        ack_obj = json.loads(ack)
+        if not ack_obj.get("ok"):
+            conn.close()
+            raise ConnectionError(f"subscribe to {channel!r} refused")
+        sub.epoch = ack_obj.get("epoch")
         return sub
 
     def ping(self) -> bool:
@@ -342,6 +495,7 @@ class _NetSubscription:
         self._buf = bytearray()
         self.closed = False  # broker gone / dropped us — stream should end
         self.last_id: Optional[int] = None  # last delivered event id
+        self.epoch: Optional[str] = None  # broker incarnation (from ack)
 
     def _read_line(self, timeout: float) -> Optional[bytes]:
         deadline = time.monotonic() + max(timeout, 0.001)
@@ -397,6 +551,102 @@ class _NetSubscription:
             pass
 
     def __enter__(self) -> "_NetSubscription":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _ReconnectingSubscription:
+    """Self-healing subscription: when the inner one dies (broker
+    restart, slow-consumer drop), re-subscribe with the last delivered
+    event id — resuming missed events from the broker's replay ring
+    when it survived, or picking up live (plus the publisher-side
+    replay buffer) when it restarted fresh. Gives up — ``closed`` goes
+    True, the SSE stream ends, the browser takes over — after
+    ``window_s`` seconds of continuous downtime."""
+
+    def __init__(self, bus: "NetBus", channel: str,
+                 sub: _NetSubscription, window_s: float) -> None:
+        self._bus = bus
+        self._channel = channel
+        self._sub = sub
+        self._window_s = window_s
+        self._down_since: Optional[float] = None
+        self.closed = False
+
+    @property
+    def last_id(self) -> Optional[int]:
+        return self._sub.last_id
+
+    def get(self, timeout: Optional[float] = None) -> Optional[dict]:
+        deadline = time.monotonic() + (timeout if timeout and timeout > 0
+                                       else 0.01)
+        while True:
+            if self.closed:
+                return None
+            if self._sub.closed:
+                # Retry cadence while the broker is down: one attempt,
+                # then ≤0.5 s pause slices — a restarted broker is
+                # noticed quickly without hot-spinning a dead port.
+                self._try_reconnect()
+                if self._sub.closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    time.sleep(min(remaining, 0.5))
+                    continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            data = self._sub.get(remaining)
+            if data is not None:
+                self._down_since = None
+                return data
+            if not self._sub.closed:
+                return None  # genuinely quiet: let the caller keepalive
+
+    def _try_reconnect(self) -> None:
+        from routest_tpu.obs import get_registry
+        from routest_tpu.utils.logging import get_logger
+
+        now = time.monotonic()
+        if self._down_since is None:
+            self._down_since = now
+            get_logger("routest_tpu.netbus").warning(
+                "netbus_subscription_lost", channel=self._channel,
+                last_id=self._sub.last_id)
+        try:
+            # Resume from the last delivered id, proving which epoch it
+            # belongs to — a restarted broker (new epoch) replays its
+            # whole ring instead of honoring a stale id (lei or 0: a
+            # subscriber that saw nothing yet resumes from the start).
+            fresh = self._bus._raw_subscribe(
+                self._channel, last_event_id=self._sub.last_id or 0,
+                epoch=self._sub.epoch)
+        except (ConnectionError, OSError, ValueError):
+            if now - self._down_since >= self._window_s:
+                self.closed = True
+                get_logger("routest_tpu.netbus").error(
+                    "netbus_subscription_abandoned", channel=self._channel,
+                    downtime_s=round(now - self._down_since, 1))
+            return
+        if fresh.epoch == self._sub.epoch:
+            fresh.last_id = self._sub.last_id  # same epoch: ids continue
+        self._sub.close()
+        self._sub = fresh
+        self._down_since = None
+        get_registry().counter(
+            "rtpu_netbus_reconnects_total",
+            "Subscriptions transparently re-established.").inc()
+        get_logger("routest_tpu.netbus").info(
+            "netbus_subscription_reconnected", channel=self._channel)
+
+    def close(self) -> None:
+        self.closed = True
+        self._sub.close()
+
+    def __enter__(self) -> "_ReconnectingSubscription":
         return self
 
     def __exit__(self, *exc) -> None:

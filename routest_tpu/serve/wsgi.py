@@ -12,14 +12,20 @@ from __future__ import annotations
 import json
 import os
 import re
+import signal
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from werkzeug.exceptions import RequestEntityTooLarge
 from werkzeug.wrappers import Request, Response
 
+from routest_tpu.obs import get_registry
 from routest_tpu.obs.trace import (REQUEST_ID_RE, mint_request_id,
                                    parse_traceparent, trace_span)
+from routest_tpu.serve.deadline import (DEADLINE_HEADER, DeadlineExceeded,
+                                        bind_deadline, parse_deadline_ms,
+                                        reset_deadline)
 from routest_tpu.utils.logging import reset_request_id, set_request_id
 from routest_tpu.utils.profiling import RequestStats
 
@@ -58,6 +64,22 @@ class App:
     def __init__(self) -> None:
         self._routes: List[Tuple[str, str, re.Pattern, Callable]] = []
         self.request_stats = RequestStats()
+        # Graceful-drain bookkeeping: handlers currently executing (the
+        # SIGTERM path waits for this to hit zero before exiting).
+        # Streaming responses (SSE) are long-lived connections, not
+        # units of work — their body iteration happens after __call__
+        # returns and is NOT counted.
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._m_expired = get_registry().counter(
+            "rtpu_replica_expired_total",
+            "Requests rejected with 504: deadline already expired at "
+            "the replica edge.")
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
 
     def route(self, path: str, methods: Tuple[str, ...] = ("GET",)):
         pattern = re.compile(
@@ -104,22 +126,46 @@ class App:
         # ambient context, which on a reused server thread could belong
         # to a previous request.
         remote_ctx = parse_traceparent(request.headers.get("traceparent"))
-        with trace_span("replica.request", parent=remote_ctx,
-                        method=request.method, path=request.path,
-                        request_id=rid) as span:
-            try:
-                response = self._dispatch(request)
-            except Exception as e:  # pragma: no cover - last-resort handler
-                response = json_response({"error": f"internal error: {e}"},
-                                         500)
-            finally:
-                reset_request_id(token)
-            span.set_attr("status", response.status_code)
-            if span.trace_id is not None:
-                response.headers["X-Trace-Id"] = span.trace_id
-        response.headers["X-Request-ID"] = rid
-        self._apply_cors(request, response)
-        return response(environ, start_response)
+        # Deadline propagation: the gateway stamps the REMAINING budget
+        # on every hop. An already-expired request is rejected with 504
+        # here, before model/store/device work — computing an answer
+        # nobody is waiting for is the tail-latency failure mode the
+        # whole budget chain exists to prevent.
+        raw_deadline = request.headers.get(DEADLINE_HEADER)
+        deadline_ms = parse_deadline_ms(raw_deadline) if raw_deadline else None
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            with trace_span("replica.request", parent=remote_ctx,
+                            method=request.method, path=request.path,
+                            request_id=rid) as span:
+                dl_token = None
+                try:
+                    if deadline_ms is not None and deadline_ms <= 0:
+                        self._m_expired.inc()
+                        response = json_response(
+                            {"error": "deadline exceeded",
+                             "deadline_ms": deadline_ms}, 504)
+                    else:
+                        if deadline_ms is not None:
+                            dl_token = bind_deadline(deadline_ms)
+                        response = self._dispatch(request)
+                except Exception as e:  # pragma: no cover - last resort
+                    response = json_response(
+                        {"error": f"internal error: {e}"}, 500)
+                finally:
+                    if dl_token is not None:
+                        reset_deadline(dl_token)
+                    reset_request_id(token)
+                span.set_attr("status", response.status_code)
+                if span.trace_id is not None:
+                    response.headers["X-Trace-Id"] = span.trace_id
+            response.headers["X-Request-ID"] = rid
+            self._apply_cors(request, response)
+            return response(environ, start_response)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
 
     def _dispatch(self, request: Request) -> Response:
         if request.method == "OPTIONS":
@@ -156,6 +202,14 @@ class App:
             response = json_response(
                 {"error": "request body too large "
                           f"(max {_max_body_bytes() >> 20} MB)"}, 413)
+            return response
+        except DeadlineExceeded:
+            # The budget ran out mid-handler (typically: the batcher
+            # dropped this request's rows at drain time). 504, same
+            # contract as the edge rejection — and counted as an error
+            # in route stats via the finally (504 >= 500).
+            self._m_expired.inc()
+            response = json_response({"error": "deadline exceeded"}, 504)
             return response
         finally:
             # Unhandled exceptions (→ 500 in __call__) must count too.
@@ -238,3 +292,60 @@ def get_json(request: Request, silent: bool = True) -> Optional[dict]:
         parsed = None
     request._rtpu_json = parsed
     return parsed
+
+
+def run_with_graceful_shutdown(app: App, host: str, port: int,
+                               drain_timeout_s: float = 30.0,
+                               ready_event: Optional[threading.Event] = None):
+    """Serve ``app`` until SIGTERM/SIGINT, then drain gracefully.
+
+    The fleet path already drains (supervisor TERMs workers, gateway
+    finishes inflight); this is the same contract for the single-replica
+    ``python -m routest_tpu.serve`` entry, which previously died
+    mid-request under SIGTERM. Sequence: stop accepting (listener
+    closes), wait up to ``drain_timeout_s`` for in-flight handlers to
+    finish (streamed SSE bodies are long-lived connections and are NOT
+    waited for), then return. Must run on the main thread (POSIX signal
+    handler registration). Returns the count of handlers still running
+    at exit (0 = clean drain).
+    """
+    from werkzeug.serving import make_server
+
+    from routest_tpu.utils.logging import get_logger
+
+    log = get_logger("routest_tpu.serve.boot")
+    server = make_server(host, port, app, threaded=True)
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    previous = {sig: signal.signal(sig, _on_signal)
+                for sig in (signal.SIGTERM, signal.SIGINT)}
+    # shutdown() must come from a different thread than serve_forever().
+    def _stopper():
+        stop.wait()
+        server.shutdown()
+
+    threading.Thread(target=_stopper, daemon=True,
+                     name="serve-sigterm-drain").start()
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        server.serve_forever()
+    finally:
+        stop.set()  # serve_forever can also end via server errors
+        server.server_close()
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
+    log.info("drain_started", inflight=app.inflight,
+             timeout_s=drain_timeout_s)
+    deadline = time.monotonic() + drain_timeout_s
+    while app.inflight > 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    leftover = app.inflight
+    if leftover:
+        log.warning("drain_timeout", inflight=leftover)
+    else:
+        log.info("drain_finished")
+    return leftover

@@ -57,8 +57,12 @@ class _Handler(BaseHTTPRequestHandler):
         st = self._state
         with st.lock:
             if table == "route_requests":
-                rid = str(uuid.uuid4())
-                stored = {"id": rid, "request_time": _now(), **row}
+                # PostgREST honors a client-supplied PK when the column
+                # has a uuid default (Supabase's schema does) — the
+                # resilience layer mints ids up front so journaled
+                # writes keep their FKs.
+                rid = str(row.get("id") or uuid.uuid4())
+                stored = {"request_time": _now(), **row, "id": rid}
                 st.requests[rid] = stored
                 self._json(201, [stored])
             elif table == "route_results":

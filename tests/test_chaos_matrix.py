@@ -1,0 +1,36 @@
+"""Full chaos-matrix run (slow): real fleet, real fault injection.
+
+Tier-1 covers every mechanism hermetically (tests/test_chaos.py,
+tests/test_deadline.py); this exercises the composed system through
+``scripts/bench_chaos.py --quick`` and asserts the artifact's scenario
+invariants — most importantly zero lost writes after the store-outage
+journal replay.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_chaos_matrix_quick(tmp_path):
+    out = tmp_path / "chaos_matrix.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_chaos.py"),
+         "--quick", "--out", str(out),
+         "--scenarios", "store_outage", "deadline_storm", "replica_crash",
+         "netbus_kill"],
+        cwd=REPO, timeout=1500, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    record = json.loads(out.read_text())
+    scen = record["scenarios"]
+    assert scen["store_outage"]["lost_writes_after_replay"] == 0
+    assert scen["store_outage"]["journal_replay_success"]
+    assert scen["deadline_storm"]["pass"]
+    assert scen["replica_crash"]["replica_recovered"]
+    assert scen["netbus_kill"]["events_lost"] == 0

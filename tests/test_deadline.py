@@ -1,0 +1,405 @@
+"""End-to-end deadline propagation: gateway re-stamps the remaining
+budget per hop, the replica WSGI edge rejects expired requests with
+504 before any model work, and the batcher drops expired entries at
+drain time (their rows provably never reach device compute) and bounds
+how long a waiter can spin against a wedged flush.
+
+Hermetic: bare WSGI apps, stub replicas, no jax model load.
+"""
+
+import http.server
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from routest_tpu.core.config import FleetConfig
+from routest_tpu.serve.deadline import (DeadlineExceeded, bind_deadline,
+                                        remaining_ms, reset_deadline)
+from routest_tpu.serve.fleet.gateway import Gateway
+from routest_tpu.serve.ml_service import DynamicBatcher, _Pending
+from routest_tpu.serve.wsgi import App
+
+
+# ── WSGI edge ─────────────────────────────────────────────────────────
+
+def _mini_app():
+    app = App()
+
+    @app.route("/api/echo_budget", methods=("POST",))
+    def echo(request):
+        return {"remaining_ms": remaining_ms()}, 200
+
+    @app.route("/api/doomed", methods=("POST",))
+    def doomed(request):
+        raise DeadlineExceeded("budget gone mid-handler")
+
+    return app
+
+
+def test_wsgi_rejects_expired_deadline_with_504_before_handler():
+    client = Client(_mini_app())
+    for value in ("0", "-15"):
+        resp = client.post("/api/echo_budget",
+                           headers={"X-Deadline-Ms": value})
+        assert resp.status_code == 504
+        assert "deadline" in resp.get_json()["error"]
+
+
+def test_wsgi_binds_remaining_budget_for_handlers():
+    client = Client(_mini_app())
+    resp = client.post("/api/echo_budget",
+                       headers={"X-Deadline-Ms": "5000"})
+    assert resp.status_code == 200
+    rem = resp.get_json()["remaining_ms"]
+    assert rem is not None and 0 < rem <= 5000
+    # no header → no ambient deadline
+    resp = client.post("/api/echo_budget")
+    assert resp.get_json()["remaining_ms"] is None
+
+
+def test_wsgi_malformed_deadline_header_is_ignored():
+    client = Client(_mini_app())
+    for value in ("banana", "inf", "nan", ""):
+        resp = client.post("/api/echo_budget",
+                           headers={"X-Deadline-Ms": value})
+        assert resp.status_code == 200, value
+
+
+def test_deadline_exceeded_from_handler_maps_to_504():
+    client = Client(_mini_app())
+    resp = client.post("/api/doomed")
+    assert resp.status_code == 504
+    assert resp.get_json()["error"] == "deadline exceeded"
+
+
+# ── batcher: drain-time drop + waiter hard cap ────────────────────────
+
+def _recording_score(calls):
+    def score(x):
+        calls.append(x.shape)
+        return x.sum(axis=1)
+
+    return score
+
+
+def test_flush_excludes_expired_rows_from_device_batch():
+    """The acceptance invariant: expired requests provably never reach
+    device compute — the flush batch excludes their rows."""
+    calls = []
+    b = DynamicBatcher(_recording_score(calls), buckets=(8,), max_batch=8,
+                       max_wait_ms=50.0)
+    dead = _Pending(np.ones((2, 4), np.float32),
+                    deadline=time.monotonic() - 0.001)  # already expired
+    with b._lock:
+        b._queue.append(dead)
+        b._queued_rows += 2
+    out = b.submit(np.ones((3, 4), np.float32))  # live entry drives flush
+    assert len(out) == 3
+    assert calls == [(8, 4)]  # ONE flush, padded from 3 live rows only
+    assert isinstance(dead.error, DeadlineExceeded)
+    assert dead.event.is_set()
+
+
+def test_expired_only_queue_drains_to_nothing():
+    calls = []
+    b = DynamicBatcher(_recording_score(calls), buckets=(8,), max_batch=8,
+                       max_wait_ms=50.0)
+    dead = _Pending(np.ones((1, 4), np.float32),
+                    deadline=time.monotonic() - 0.001)
+    with b._lock:
+        b._queue.append(dead)
+        b._queued_rows += 1
+    b._flush()
+    assert calls == []  # no device call for a batch nobody waits on
+    assert isinstance(dead.error, DeadlineExceeded)
+    with b._lock:
+        assert not b._queue and b._queued_rows == 0
+
+
+def test_submit_with_ambient_deadline_gives_up_at_budget():
+    # No flush ever completes (score blocked): the waiter must raise at
+    # its own deadline, not wait max_wait (10 s here) or spin forever.
+    release = threading.Event()
+
+    def blocked_score(x):
+        release.wait(20.0)
+        return x.sum(axis=1)
+
+    b = DynamicBatcher(blocked_score, buckets=(8,), max_batch=8,
+                       max_wait_ms=10_000.0)
+    err, elapsed = {}, {}
+
+    def submit_with_budget():
+        token = bind_deadline(250.0)
+        t0 = time.perf_counter()
+        try:
+            b.submit(np.ones((1, 4), np.float32))
+        except DeadlineExceeded as e:
+            err["e"] = e
+        finally:
+            elapsed["s"] = time.perf_counter() - t0
+            reset_deadline(token)
+
+    t = threading.Thread(target=submit_with_budget)
+    t.start()
+    t.join(timeout=10.0)
+    release.set()
+    assert not t.is_alive(), "waiter never gave up"
+    assert "e" in err
+    assert 0.2 <= elapsed["s"] < 2.0
+
+
+def test_wedged_flush_cannot_pin_other_waiters_past_deadline():
+    """Satellite regression: a flush thread blocked on the device holds
+    ``_flushing``; a second submit with a budget used to 1 ms-spin
+    against it forever. Now it withdraws its entry and raises at its
+    deadline."""
+    release = threading.Event()
+    entered = threading.Event()
+
+    def wedged_score(x):
+        entered.set()
+        release.wait(30.0)
+        return x.sum(axis=1)
+
+    b = DynamicBatcher(wedged_score, buckets=(8,), max_batch=4,
+                       max_wait_ms=5.0)
+    t1 = threading.Thread(
+        target=lambda: b.submit(np.ones((4, 4), np.float32)), daemon=True)
+    t1.start()  # 4 rows == max_batch → inline flush → wedged in score
+    assert entered.wait(5.0)
+
+    state = {}
+
+    def victim():
+        token = bind_deadline(300.0)
+        try:
+            b.submit(np.ones((1, 4), np.float32))
+            state["out"] = "returned"
+        except DeadlineExceeded:
+            state["out"] = "expired"
+        finally:
+            reset_deadline(token)
+
+    t2 = threading.Thread(target=victim)
+    t2.start()
+    t2.join(timeout=5.0)
+    assert not t2.is_alive(), "victim pinned by wedged flush"
+    assert state["out"] == "expired"
+    # its entry was withdrawn: the wedged flush will not compute it
+    with b._lock:
+        assert b._queued_rows == 0 and not b._queue
+    release.set()
+    t1.join(timeout=10.0)
+    assert not t1.is_alive()
+
+
+def test_no_deadline_waiter_still_bounded_by_hard_cap():
+    release = threading.Event()
+
+    def blocked_score(x):
+        release.wait(20.0)
+        return x.sum(axis=1)
+
+    b = DynamicBatcher(blocked_score, buckets=(8,), max_batch=8,
+                       max_wait_ms=10_000.0, hard_cap_s=0.3)
+    with pytest.raises(DeadlineExceeded):
+        b.submit(np.ones((1, 4), np.float32))
+    release.set()
+
+
+# ── gateway: remaining budget re-stamped per hop ──────────────────────
+
+class _StubHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        srv = self.server
+        if srv.delay_s:
+            time.sleep(srv.delay_s)
+        with srv.lock:
+            srv.seen.append({k.lower(): v for k, v in self.headers.items()})
+        data = json.dumps({"ok": True}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    do_GET = do_POST
+
+
+def _start_stub(delay_s=0.0):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    srv.daemon_threads = True
+    srv.delay_s = delay_s
+    srv.seen = []
+    srv.lock = threading.Lock()
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _post(base, path, payload, headers=None, timeout=15.0):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _gateway(targets, **cfg):
+    gw = Gateway(targets, FleetConfig(**{"hedge": False, **cfg}))
+    httpd = gw.serve("127.0.0.1", 0)
+    return gw, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_gateway_forwards_remaining_budget_header():
+    stub = _start_stub()
+    _, base = _gateway([("127.0.0.1", stub.server_port)])
+    status, _, _ = _post(base, "/api/predict_eta", {"x": 1},
+                         headers={"X-Deadline-Ms": "5000"})
+    assert status == 200
+    h = stub.seen[-1]
+    fwd = float(h["x-deadline-ms"])
+    assert 0 < fwd <= 5000
+    # default budget applies when the client sends none
+    _post(base, "/api/predict_eta", {"x": 1})
+    assert float(stub.seen[-1]["x-deadline-ms"]) <= 30_000
+
+
+def test_gateway_budget_shrinks_across_queue_wait():
+    # max_inflight=1: a slow request occupies the slot; the queued one's
+    # forwarded budget must be visibly smaller than what it arrived with.
+    stub = _start_stub(delay_s=0.4)
+    _, base = _gateway([("127.0.0.1", stub.server_port)],
+                       max_inflight=1, queue_depth=4)
+    t = threading.Thread(
+        target=lambda: _post(base, "/api/predict_eta", {"first": 1}))
+    t.start()
+    time.sleep(0.1)  # let the occupier admit
+    status, _, _ = _post(base, "/api/predict_eta", {"second": 1},
+                         headers={"X-Deadline-Ms": "5000"})
+    t.join(timeout=10)
+    assert status == 200
+    fwd = float(stub.seen[-1]["x-deadline-ms"])
+    assert fwd < 4800, f"budget did not shrink across queue wait: {fwd}"
+
+
+def test_gateway_retry_carries_remaining_budget():
+    # primary = dead port → transport failure → retry hop must still
+    # carry a (smaller) budget header
+    import socket as socket_mod
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    stub = _start_stub()
+    gw, base = _gateway([("127.0.0.1", dead_port),
+                         ("127.0.0.1", stub.server_port)])
+    ok = 0
+    for _ in range(4):  # routing is least-outstanding: hit both
+        status, _, _ = _post(base, "/api/predict_eta", {"x": 1},
+                             headers={"X-Deadline-Ms": "3000"})
+        ok += status == 200
+    assert ok == 4  # dead replica absorbed by retry
+    for h in stub.seen:
+        assert 0 < float(h["x-deadline-ms"]) <= 3000
+
+
+def test_gateway_strips_client_deadline_from_forwarded_headers():
+    # exactly ONE x-deadline-ms reaches the replica (the re-stamped
+    # one), not the client's original riding alongside
+    stub = _start_stub()
+    _, base = _gateway([("127.0.0.1", stub.server_port)])
+    _post(base, "/api/predict_eta", {"x": 1},
+          headers={"X-Deadline-Ms": "7000"})
+    h = stub.seen[-1]
+    assert float(h["x-deadline-ms"]) <= 7000
+
+
+# ── end to end: replica edge + batcher drop over real HTTP ────────────
+
+def test_replica_504_on_expiry_through_real_server():
+    """gateway→replica→batcher expiry, replica side over real HTTP: a
+    request whose budget cannot be met (flush wedged past its deadline)
+    gets 504, and its rows never reach the device."""
+    from werkzeug.serving import make_server
+
+    release = threading.Event()
+    calls = []
+
+    def wedged_score(x):
+        calls.append(x.shape)
+        release.wait(20.0)
+        return x.sum(axis=1)
+
+    b = DynamicBatcher(wedged_score, buckets=(8,), max_batch=4,
+                       max_wait_ms=5.0)
+    app = App()
+
+    @app.route("/api/predict", methods=("POST",))
+    def predict(request):
+        out = b.submit(np.ones((1, 4), np.float32))
+        return {"n": len(out)}, 200
+
+    srv = make_server("127.0.0.1", 0, app, threaded=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        # occupier wedges the flush (no deadline: rides it out)
+        occupier = threading.Thread(
+            target=lambda: _post(base, "/api/predict",
+                                 {"big": list(range(4))}, timeout=30.0))
+        occupier.start()
+        deadline = time.time() + 5
+        while not calls and time.time() < deadline:
+            time.sleep(0.01)
+        assert calls, "occupier flush never started"
+        # victim: pre-expired at the edge → 504 before the handler
+        status, body, _ = _post(base, "/api/predict", {},
+                                headers={"X-Deadline-Ms": "0"})
+        assert status == 504
+        # victim 2: expires waiting behind the wedged flush → 504
+        status, body, _ = _post(base, "/api/predict", {},
+                                headers={"X-Deadline-Ms": "300"})
+        assert status == 504
+        assert "deadline" in body["error"]
+        assert len(calls) == 1  # victim rows never computed
+        release.set()
+        occupier.join(timeout=10)
+    finally:
+        release.set()
+        srv.shutdown()
+
+
+def test_expired_counter_increments():
+    from routest_tpu.obs import get_registry
+
+    counter = get_registry().counter(
+        "rtpu_batcher_expired_total", "", ("stage",))
+    before = counter.labels(stage="drain").value
+    calls = []
+    b = DynamicBatcher(_recording_score(calls), buckets=(8,), max_batch=8,
+                       max_wait_ms=50.0)
+    dead = _Pending(np.ones((1, 4), np.float32),
+                    deadline=time.monotonic() - 0.001)
+    with b._lock:
+        b._queue.append(dead)
+        b._queued_rows += 1
+    b._flush()
+    assert counter.labels(stage="drain").value == before + 1

@@ -1,0 +1,92 @@
+"""SIGTERM drain for the single-replica serving entry point.
+
+The fleet path drained since PR 1; ``python -m routest_tpu.serve``
+just died mid-request. The drain loop now lives in
+``serve.wsgi.run_with_graceful_shutdown`` — exercised here with a tiny
+WSGI app in a real subprocess (jax-free, so the boot is fast) sent a
+real SIGTERM mid-request: the in-flight request must complete, new
+connections must be refused, and the process must exit 0.
+"""
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+_DRIVER = """
+import sys, time
+from routest_tpu.serve.wsgi import App, run_with_graceful_shutdown
+
+app = App()
+
+@app.route("/slow", methods=("GET",))
+def slow(request):
+    time.sleep(1.0)
+    return {"ok": True}, 200
+
+@app.route("/ping", methods=("GET",))
+def ping(request):
+    return {"ok": True}, 200
+
+leftover = run_with_graceful_shutdown(app, "127.0.0.1", int(sys.argv[1]),
+                                      drain_timeout_s=15.0)
+sys.exit(1 if leftover else 0)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_sigterm_finishes_inflight_then_exits_clean():
+    port = _free_port()
+    proc = subprocess.Popen([sys.executable, "-c", _DRIVER, str(port)],
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/ping", timeout=1) as r:
+                    if json.loads(r.read()).get("ok"):
+                        break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            pytest.fail("driver server never became ready")
+
+        result = {}
+
+        def slow_call():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/slow", timeout=30) as r:
+                    result["status"] = r.status
+                    result["body"] = json.loads(r.read())
+            except Exception as e:  # noqa: BLE001 - recorded for assert
+                result["error"] = repr(e)
+
+        t = threading.Thread(target=slow_call)
+        t.start()
+        time.sleep(0.3)  # request is in flight
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=30)
+        assert result.get("status") == 200, result
+        assert result["body"] == {"ok": True}
+        assert proc.wait(timeout=30) == 0  # clean drain, not a kill
+        # listener is gone
+        with pytest.raises((urllib.error.URLError, OSError)):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/ping",
+                                   timeout=1)
+    finally:
+        if proc.poll() is None:
+            proc.kill()

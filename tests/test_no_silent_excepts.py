@@ -1,0 +1,70 @@
+"""Static sweep: no silent broad exception swallows under ``serve/``.
+
+The store used to eat outages with ``except Exception: return False``
+and the bus fell back to in-memory with ``except Exception: pass`` —
+invisible degradation that PR 3's chaos work made observable. This
+sweep keeps the invariant: an ``except`` handler that catches
+``Exception``/``BaseException`` (or is bare) may not have a body of
+just ``pass`` — it must log a structured event, count a metric, or
+re-raise. Narrow handlers (``except OSError: pass`` on a close() path)
+stay legal: swallowing a specific, expected cleanup error is policy,
+swallowing EVERYTHING silently is a bug factory.
+
+AST-based, like ``test_no_bare_print.py``: comments and strings that
+merely mention excepts must not trip it.
+"""
+
+import ast
+import os
+
+import routest_tpu.serve
+
+SERVE_ROOT = os.path.dirname(os.path.abspath(routest_tpu.serve.__file__))
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _type_names(node):
+    """Exception-type expression → set of dotted-name leaves; None type
+    (bare except) → {"<bare>"}."""
+    if node is None:
+        return {"<bare>"}
+    if isinstance(node, ast.Tuple):
+        out = set()
+        for elt in node.elts:
+            out |= _type_names(elt)
+        return out
+    if isinstance(node, ast.Name):
+        return {node.id}
+    if isinstance(node, ast.Attribute):
+        return {node.attr}
+    return {"<expr>"}
+
+
+def _offenders(path):
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        body_is_pass = all(isinstance(stmt, ast.Pass) for stmt in node.body)
+        if not body_is_pass:
+            continue
+        names = _type_names(node.type)
+        if names & BROAD or "<bare>" in names:
+            yield node.lineno
+
+
+def test_no_silent_broad_excepts_under_serve():
+    offenders = []
+    for dirpath, dirnames, filenames in os.walk(SERVE_ROOT):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, SERVE_ROOT)
+            offenders.extend(f"{rel}:{line}" for line in _offenders(path))
+    assert not offenders, (
+        "silent broad except (log a JsonLogger event, count a metric, "
+        "or narrow the type): " + ", ".join(offenders))
