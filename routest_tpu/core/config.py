@@ -75,6 +75,25 @@ class ServeConfig:
     # Model hot-reload: poll the artifact every N seconds and swap a
     # changed file in without a restart. 0 (default) disables.
     reload_sec: float = 0.0
+    # Serving fast lane (docs/PERFORMANCE.md): a content-addressed
+    # prediction cache + singleflight in front of the batcher, and an
+    # adaptive flush window inside it. All RTPU_FASTLANE_* env-tunable.
+    # The cache is semantically invisible — the model is a pure function
+    # of the encoded feature row, entries are keyed by (row bytes, model
+    # generation), and a hot-reload bumps the generation — so it
+    # defaults ON. ``fastlane_max_rows`` bounds the per-request row
+    # count that consults the cache: giant all-unique batches would pay
+    # hashing overhead and thrash the LRU for nothing.
+    fastlane_cache: bool = True
+    fastlane_cache_size: int = 8192
+    fastlane_cache_ttl_s: float = 300.0
+    fastlane_singleflight: bool = True
+    fastlane_max_rows: int = 1024
+    # Adaptive batching: shrink the flush window toward min_wait_ms when
+    # the arrival rate is low (latency mode), grow it toward max_wait_ms
+    # when high (throughput mode). Off = the fixed max_wait_ms window.
+    adaptive_wait: bool = True
+    min_wait_ms: float = 0.0
     # External services — all optional; absent ⇒ hermetic in-memory fakes.
     supabase_url: Optional[str] = None
     supabase_service_key: Optional[str] = None
@@ -224,6 +243,14 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         max_batch=_int("RTPU_MAX_BATCH", 4096),
         max_wait_ms=_float("RTPU_MAX_WAIT_MS", 2.0),
         reload_sec=_float_tolerant("ROUTEST_RELOAD_SEC", 0.0),
+        fastlane_cache=env.get("RTPU_FASTLANE_CACHE", "1") != "0",
+        fastlane_cache_size=_int("RTPU_FASTLANE_CACHE_SIZE", 8192),
+        fastlane_cache_ttl_s=_float("RTPU_FASTLANE_CACHE_TTL_S", 300.0),
+        fastlane_singleflight=env.get(
+            "RTPU_FASTLANE_SINGLEFLIGHT", "1") != "0",
+        fastlane_max_rows=_int("RTPU_FASTLANE_MAX_ROWS", 1024),
+        adaptive_wait=env.get("RTPU_FASTLANE_ADAPTIVE", "1") != "0",
+        min_wait_ms=_float("RTPU_FASTLANE_MIN_WAIT_MS", 0.0),
         supabase_url=env.get("SUPABASE_URL"),
         supabase_service_key=env.get("SUPABASE_SERVICE_ROLE_KEY"),
         redis_url=env.get("REDIS_URL"),

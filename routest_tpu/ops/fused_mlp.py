@@ -72,6 +72,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from routest_tpu.data.features import N_FEATURES
 
+# jax renamed TPUCompilerParams → CompilerParams across 0.4.x/0.5.x;
+# support both so the kernel (and its tier-1 parity tests) track the
+# installed version instead of pinning one.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 # Lane layout of the in-kernel expanded feature vector (width = LANES).
 # Chosen so every region starts where VPU masks are cheap; the 32-wide
 # weekday slot (7 real + 25 zero weight rows) keeps hour at a lane
@@ -258,7 +264,7 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *, n_q: int = 0,
         out_specs=pl.BlockSpec((tile, n_out), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b_pad, n_out), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel",)),
         cost_estimate=pl.CostEstimate(
             flops=flops, bytes_accessed=bytes_accessed,

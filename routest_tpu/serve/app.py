@@ -16,6 +16,7 @@ Every endpoint of the reference Flask service (SURVEY.md Appendix A,
 from __future__ import annotations
 
 import datetime as dt
+import math
 import os
 import time
 from typing import Optional
@@ -210,8 +211,6 @@ def create_app(config: Optional[Config] = None,
                     _log.error("batch_eta_failed", error=str(e))
                     minutes = None
                 if minutes is not None:
-                    import math
-
                     for (i, r), m, ts in zip(ok, minutes, iso):
                         if math.isfinite(m):
                             r["properties"]["eta_minutes_ml"] = round(
@@ -343,8 +342,6 @@ def create_app(config: Optional[Config] = None,
             minutes = None
         if minutes is None:
             return {"error": "model unavailable"}, 503
-        import math
-
         # Non-finite rows serialize as null in BOTH columns (NaN is
         # invalid JSON; its timestamp is NaT) — the batch-shaped analog
         # of the single-row (None, None) contract.

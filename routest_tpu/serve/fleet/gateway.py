@@ -61,6 +61,18 @@ _HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
+# Pre-encoded bodies for the fixed error responses: the admission/shed
+# path exists to be CHEAP under overload, so it must not pay a fresh
+# json.dumps per rejection.
+_CT_JSON = ("Content-Type", "application/json")
+_BODY_SATURATED = json.dumps(
+    {"error": "fleet saturated; retry later"}).encode()
+_BODY_DRAINING = json.dumps({"error": "gateway draining"}).encode()
+_BODY_NO_REPLICA = json.dumps({"error": "no healthy replica"}).encode()
+_BODY_UPSTREAM_FAILED = json.dumps(
+    {"error": "upstream connection failed"}).encode()
+_BODY_UPSTREAM_TIMEOUT = json.dumps({"error": "upstream timeout"}).encode()
+
 
 def _tag_replica(rh: List, rid: str) -> None:
     """Stamp which replica answered: ``X-RTPU-Replica`` (the documented
@@ -369,13 +381,12 @@ class Gateway:
         one hop earlier than the replica would, so gateway and replica
         log lines for one request finally grep together."""
         # Header names arrive in whatever case the client sent
-        # (urllib capitalizes, browsers lowercase): match-insensitively.
-        def _h(name: str) -> str:
-            low = name.lower()
-            return next((v for k, v in headers.items()
-                         if k.lower() == low), "")
-
-        rid = _h("X-Request-ID")
+        # (urllib capitalizes, browsers lowercase). ONE lowercase pass
+        # serves every lookup below — the old per-header linear scans
+        # re-walked the whole mapping for each name, which the hot
+        # /api/predict_eta* path paid twice per request.
+        low = {k.lower(): v for k, v in headers.items()}
+        rid = low.get("x-request-id", "")
         if not REQUEST_ID_RE.match(rid):
             rid = mint_request_id()
         headers = {k: v for k, v in headers.items()
@@ -384,7 +395,7 @@ class Gateway:
         cfg = self.config
         budget_ms = deadline_ms if deadline_ms else cfg.deadline_ms
         deadline = time.time() + budget_ms / 1000.0
-        client_ctx = parse_traceparent(_h("traceparent"))
+        client_ctx = parse_traceparent(low.get("traceparent", ""))
         with trace_span("gateway.request", parent=client_ctx,
                         method=method, path=path.split("?", 1)[0],
                         request_id=rid) as root:
@@ -394,13 +405,11 @@ class Gateway:
             if not admitted:
                 root.set_attr("status", status)
                 if status == 429:
-                    rh = [("Retry-After", "1"),
-                          ("Content-Type", "application/json")]
-                    out = json.dumps({"error": "fleet saturated; retry "
-                                               "later"}).encode()
+                    rh = [("Retry-After", "1"), _CT_JSON]
+                    out = _BODY_SATURATED
                 else:
-                    rh = [("Content-Type", "application/json")]
-                    out = json.dumps({"error": "gateway draining"}).encode()
+                    rh = [_CT_JSON]
+                    out = _BODY_DRAINING
                 return status, self._stamp(rh, rid, root), out
             try:
                 status, rh, data = self._routed(method, path, body,
@@ -435,8 +444,7 @@ class Gateway:
 
         primary = self._pick()
         if primary is None:
-            return 503, [("Content-Type", "application/json")], \
-                json.dumps({"error": "no healthy replica"}).encode()
+            return 503, [_CT_JSON], _BODY_NO_REPLICA
 
         hedgeable = (self.config.hedge and idempotent
                      and len(self.replicas) > 1
@@ -457,14 +465,11 @@ class Gateway:
                 return status, rh, data
             except (http.client.HTTPException, OSError):
                 if not idempotent:
-                    return 502, [("Content-Type", "application/json")], \
-                        json.dumps({"error": "upstream connection failed"
-                                    }).encode()
+                    return 502, [_CT_JSON], _BODY_UPSTREAM_FAILED
             # idempotent fall-through: retry once on another replica
         retry = self._pick(exclude=(primary.id,)) or self._pick()
         if retry is None:
-            return 503, [("Content-Type", "application/json")], \
-                json.dumps({"error": "no healthy replica"}).encode()
+            return 503, [_CT_JSON], _BODY_NO_REPLICA
         with self._lock:
             self.retries += 1
         self._m_retries.inc()
@@ -476,8 +481,7 @@ class Gateway:
             _tag_replica(rh, retry.id)
             return status, rh, data
         except (http.client.HTTPException, OSError):
-            return 502, [("Content-Type", "application/json")], \
-                json.dumps({"error": "upstream connection failed"}).encode()
+            return 502, [_CT_JSON], _BODY_UPSTREAM_FAILED
 
     def _forward_hedged(self, primary, method, path, body, headers,
                         timeout, fwd_deadline=None):
@@ -538,8 +542,7 @@ class Gateway:
                 return status, rh, data
         if len(box) >= expected:
             return None          # every copy died at transport level
-        return 504, [("Content-Type", "application/json")], \
-            json.dumps({"error": "upstream timeout"}).encode()
+        return 504, [_CT_JSON], _BODY_UPSTREAM_TIMEOUT
 
     # ── metrics ───────────────────────────────────────────────────────
 

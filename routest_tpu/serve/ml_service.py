@@ -15,6 +15,8 @@ surfaces 503).
 from __future__ import annotations
 
 import datetime as dt
+import itertools
+import math
 import os
 import threading
 import time
@@ -40,17 +42,30 @@ class _ServingState:
     per request and use only the snapshot, so a hot-reload (which swaps
     the single attribute) can never hand a request the OLD batcher's
     output shape with the NEW model's quantile metadata (a torn read
-    that would mis-index or mis-label the row)."""
+    that would mis-index or mis-label the row).
 
-    __slots__ = ("model", "batcher", "quantiles")
+    ``generation`` is a process-unique id for this serving state (one
+    ``next()`` of the module counter per successful bring-up). The
+    fast-lane prediction cache keys on it, so a hot-reload makes every
+    cached prediction of the OLD model unreachable the instant the
+    snapshot flips — cache coherency falls out of the same one-flip
+    design that prevents torn reads (docs/PERFORMANCE.md)."""
 
-    def __init__(self, model, batcher, quantiles) -> None:
+    __slots__ = ("model", "batcher", "quantiles", "generation")
+
+    def __init__(self, model, batcher, quantiles,
+                 generation: int = -1) -> None:
         self.model = model
         self.batcher = batcher
         self.quantiles = tuple(quantiles or ())
+        self.generation = generation
 
 
 _EMPTY_SERVING = _ServingState(None, None, ())
+
+# Model-generation counter: every serving state that goes live anywhere
+# in the process (startup, hot-reload replacement) draws a fresh id.
+_GENERATION = itertools.count()
 
 
 class _InReload(threading.local):
@@ -84,17 +99,93 @@ def _band_label(level: float) -> str:
 
 
 class _Pending:
-    __slots__ = ("rows", "event", "result", "error", "deadline")
+    """One waiter. Rows live in ONE of two places: the batcher's staging
+    slab (``slab=True``, located by ``offset``) — the zero-copy fast
+    path — or the waiter's own array (``rows``), the fallback for
+    oversized submissions and slab overflow."""
 
-    def __init__(self, rows: np.ndarray,
-                 deadline: Optional[float] = None) -> None:
-        self.rows = rows
+    __slots__ = ("rows", "slab", "offset", "n", "event", "result", "error",
+                 "deadline")
+
+    def __init__(self, rows: Optional[np.ndarray] = None,
+                 deadline: Optional[float] = None, *,
+                 n: Optional[int] = None, offset: int = 0) -> None:
+        self.rows = rows          # fallback path only (slab entries: None)
+        self.slab = rows is None
+        self.offset = offset      # row offset inside the staging slab
+        self.n = len(rows) if rows is not None else int(n or 0)
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         # Absolute time.monotonic() deadline captured from the ambient
         # request context at submit; None = no budget.
         self.deadline = deadline
+
+
+class _WindowController:
+    """Adaptive flush window (Clipper-style AIMD goal, EWMA-rate form):
+    pick the wait the CURRENT arrival rate justifies instead of a fixed
+    one. At low rates, waiting buys nothing — no peer will arrive inside
+    any reasonable window — so the window collapses to ``min_wait``
+    (latency mode). At high rates the window grows toward ``max_wait``,
+    sized to fill the largest bucket the rate can fill within the cap
+    (throughput mode; in practice ``max_batch`` triggers first and the
+    window is only the backstop). The rate estimate is a time-constant
+    EWMA of rows/s over submit arrivals — bursty thread schedules decay
+    smoothly instead of whipsawing the window."""
+
+    __slots__ = ("buckets", "max_wait", "min_wait", "tau", "rate", "_last")
+
+    def __init__(self, buckets: Sequence[int], max_wait_s: float,
+                 min_wait_s: float = 0.0, tau_s: float = 0.5) -> None:
+        self.buckets = tuple(buckets)
+        self.max_wait = max_wait_s
+        self.min_wait = min(min_wait_s, max_wait_s)
+        self.tau = tau_s
+        self.rate = 0.0           # rows/s, EWMA
+        self._last: Optional[float] = None
+
+    def observe(self, n_rows: int, now: float) -> None:
+        if self._last is None:
+            self._last = now
+            self.rate = 0.0
+            return
+        dt = max(now - self._last, 1e-6)
+        self._last = now
+        # Time-constant EWMA: weight of the new sample grows with the
+        # gap, so a long idle stretch decays the rate toward the new
+        # (low) instantaneous value instead of remembering a burst.
+        w = 1.0 - math.exp(-dt / self.tau)
+        self.rate += w * (n_rows / dt - self.rate)
+
+    def window_s(self, flush_s: float = 0.0) -> float:
+        """The wait the current rate justifies, in seconds.
+
+        ``flush_s`` is the batcher's EWMA flush duration: once arrivals
+        come faster than flushes complete (``rate × flush_s ≥ 1``),
+        waiting ~one flush duration coalesces at zero marginal latency
+        — the flush slot is busy for that long anyway, and flushing
+        every lone row instead just multiplies per-dispatch overhead
+        (measured: 26% throughput LOSS on the all-unique closed-loop
+        workload without this floor)."""
+        if self.max_wait <= 0:
+            return self.min_wait
+        fillable = self.rate * self.max_wait
+        busy = self.rate * max(flush_s, 0.0) >= 1.0
+        # Latency mode: traffic so light that neither the cap window nor
+        # an in-progress flush would supply a peer to batch with —
+        # waiting is pure added latency.
+        if fillable < self.buckets[0] and not busy:
+            return self.min_wait
+        # Throughput mode: wait long enough to fill the largest bucket
+        # the rate can fill inside the cap, floored at one flush
+        # duration when the batcher is saturated.
+        bucket = max((b for b in self.buckets if b <= fillable),
+                     default=self.buckets[0])
+        want = bucket / self.rate if self.rate > 0 else self.max_wait
+        if busy:
+            want = max(want, flush_s)
+        return min(self.max_wait, max(want, self.min_wait))
 
 
 class DynamicBatcher:
@@ -108,7 +199,8 @@ class DynamicBatcher:
 
     def __init__(self, score_fn, buckets: Sequence[int], max_batch: int,
                  max_wait_ms: float, align: int = 1,
-                 hard_cap_s: float = 60.0) -> None:
+                 hard_cap_s: float = 60.0, adaptive: bool = False,
+                 min_wait_ms: float = 0.0) -> None:
         self._score = score_fn
         # Waiter give-up bound: a submit with no request deadline still
         # cannot wait past this — a wedged flush thread (device hang)
@@ -130,7 +222,27 @@ class DynamicBatcher:
         self._queue: List[_Pending] = []
         self._queued_rows = 0
         self._flushing = False
-        self.stats = {"flushes": 0, "rows": 0, "max_batch_seen": 0}
+        # Zero-copy staging: submits write rows straight into a
+        # preallocated slab (capacity = the largest bucket); a flush
+        # detaches the slab, pads IN PLACE, and hands a view to the
+        # device — no per-flush np.concatenate, no pad allocation.
+        # Allocated lazily at first submit (feature width unknown until
+        # then); ``_spare`` recycles the one detached slab a flush can
+        # have in flight at a time.
+        self._slab: Optional[np.ndarray] = None
+        self._spare: Optional[np.ndarray] = None
+        self._staged = 0
+        # Adaptive flush window (off by default: direct constructions —
+        # tests, embedders — keep the fixed-window contract; EtaService
+        # wires it from ServeConfig.adaptive_wait).
+        self._ctrl = (_WindowController(self._buckets, self._max_wait,
+                                        min_wait_ms / 1000.0)
+                      if adaptive else None)
+        # EWMA flush duration feeding the adaptive controller's
+        # saturation floor (rate × flush ≥ 1 → waiting is free).
+        self._flush_ewma_s = 0.0
+        self.stats = {"flushes": 0, "rows": 0, "max_batch_seen": 0,
+                      "zero_copy_flushes": 0}
         # Unified-registry view of the batching stages (ISSUE 2): until
         # now queue wait vs. assembly vs. device compute were
         # indistinguishable from outside — these histograms + the stage
@@ -158,6 +270,15 @@ class DynamicBatcher:
             "dropped at drain time (stage=drain) or abandoned by their "
             "waiter (stage=wait). Expired rows never reach the device.",
             ("stage",))
+        self._m_window = reg.gauge(
+            "rtpu_batcher_wait_window_ms",
+            "Flush window currently in force (adaptive controller or "
+            "the fixed max_wait_ms).")
+        self._m_window.set(max_wait_ms)
+        self._m_zero_copy = reg.counter(
+            "rtpu_batcher_zero_copy_flushes_total",
+            "Flushes assembled in place from the staging slab "
+            "(no concatenate/pad allocation).")
 
     def _bucket(self, n: int) -> int:
         for b in self._buckets:
@@ -166,32 +287,97 @@ class DynamicBatcher:
         # oversized: exact shape, rounded up to the shard multiple
         return ((n + self._align - 1) // self._align) * self._align
 
+    def _stage_locked(self, rows: np.ndarray, deadline) -> _Pending:
+        """Lock held: place the rows. Fast path writes them straight
+        into the staging slab (ONE copy, into memory the device batch
+        will be a view of); fallback (oversized rows, slab full under a
+        flush in flight, unexpected shape) keeps the waiter's own array
+        for the concatenate path."""
+        n = len(rows)
+        cap = self._buckets[-1]
+        if getattr(rows, "ndim", 0) == 2 and n <= cap - self._staged:
+            if self._slab is None:
+                self._slab = np.empty((cap, rows.shape[1]), np.float32)
+            if self._slab.shape[1] == rows.shape[1]:
+                offset = self._staged
+                self._slab[offset:offset + n] = rows
+                self._staged += n
+                return _Pending(deadline=deadline, n=n, offset=offset)
+        return _Pending(rows, deadline=deadline)
+
+    def _repack_locked(self, src: np.ndarray) -> None:
+        """Lock held: re-pack every queued slab entry into a dense
+        prefix of the CURRENT slab, reading each entry's rows from
+        ``src`` (the old slab after a drain detached it, or the current
+        one after a mid-queue withdrawal left a hole). Queue order ==
+        offset order, so one forward pass suffices; same-buffer moves
+        are always downward (numpy buffers overlapping assignments)."""
+        dst = 0
+        for p in self._queue:
+            if not p.slab:
+                continue
+            if src is not self._slab or p.offset != dst:
+                self._slab[dst:dst + p.n] = src[p.offset:p.offset + p.n]
+                p.offset = dst
+            dst += p.n
+        self._staged = dst
+
+    def _withdraw_locked(self, pending: _Pending) -> bool:
+        """Lock held: remove a still-queued entry (deadline give-up)."""
+        if pending not in self._queue:
+            return False
+        self._queue.remove(pending)
+        self._queued_rows -= pending.n
+        if pending.slab and self._slab is not None:
+            self._repack_locked(self._slab)
+        return True
+
     def submit(self, rows: np.ndarray) -> np.ndarray:
         from routest_tpu.serve.deadline import current_deadline
 
-        pending = _Pending(rows, deadline=current_deadline())
         t_submit = time.perf_counter()
         t_mono = time.monotonic()
+        req_deadline = current_deadline()
         # Waiter give-up point: the request's own deadline when it has
         # one, else the batcher's hard cap. Without this, a wedged
         # flush thread (device hang) pinned every waiter in a 1 ms spin
         # forever.
         give_up_at = t_mono + self._hard_cap_s
-        if pending.deadline is not None:
-            give_up_at = min(give_up_at, pending.deadline)
+        if req_deadline is not None:
+            give_up_at = min(give_up_at, req_deadline)
         with trace_span("batcher.queue_wait", rows=len(rows)) as qs:
             with self._lock:
+                pending = self._stage_locked(rows, req_deadline)
                 self._queue.append(pending)
-                self._queued_rows += len(rows)
+                self._queued_rows += pending.n
+                if self._ctrl is not None:
+                    self._ctrl.observe(pending.n, t_mono)
+                    wait_s = self._ctrl.window_s(self._flush_ewma_s)
+                    if wait_s <= 0.0 and (self._flushing
+                                          or len(self._queue) > 1):
+                        # Queue-depth feedback: latency mode only when
+                        # the batcher is IDLE. With a flush in flight
+                        # (or peers queued) an immediate drain would
+                        # fragment batches into lone-row flushes —
+                        # floor the wait at one flush duration so we
+                        # drain alongside our peers instead.
+                        wait_s = min(max(self._flush_ewma_s, 0.0005),
+                                     self._max_wait)
+                    self._m_window.set(wait_s * 1000.0)
+                else:
+                    wait_s = self._max_wait
                 should_flush = (self._queued_rows >= self._max_batch
                                 and not self._flushing)
             # A flush exception here may belong to OTHER requests' rows
             # (the capped drain can exclude ours); our own failure
             # arrives via pending.error below, so never re-raise from
             # the shared flush.
-            if should_flush:
+            # A zero adaptive window is latency mode: drain NOW instead
+            # of sleeping one spin tick first — at low arrival rates the
+            # batch is this request alone either way.
+            if should_flush or wait_s <= 0.0:
                 self._flush_quietly()
-            deadline = time.monotonic() + self._max_wait
+            deadline = time.monotonic() + wait_s
             spin = 0.001
             while True:
                 # Oldest-waiter timeout: whoever wakes first drains the
@@ -204,9 +390,7 @@ class DynamicBatcher:
                 now = time.monotonic()
                 if now >= give_up_at and not pending.event.is_set():
                     with self._lock:
-                        if pending in self._queue:
-                            self._queue.remove(pending)
-                            self._queued_rows -= len(pending.rows)
+                        self._withdraw_locked(pending)
                     if not pending.event.is_set():
                         qs.set_attr("expired", True)
                         self._m_expired.labels(stage="wait").inc()
@@ -252,6 +436,7 @@ class DynamicBatcher:
 
         while True:
             expired: List[_Pending] = []
+            batch_slab: Optional[np.ndarray] = None
             with self._lock:
                 if self._flushing or not self._queue:
                     return
@@ -264,11 +449,13 @@ class DynamicBatcher:
                 for p in self._queue:
                     if p.deadline is not None and now >= p.deadline:
                         expired.append(p)
-                        self._queued_rows -= len(p.rows)
+                        self._queued_rows -= p.n
                     else:
                         keep.append(p)
                 if expired:
                     self._queue[:] = keep
+                    if any(p.slab for p in expired) and self._slab is not None:
+                        self._repack_locked(self._slab)
                 if not self._queue:
                     batch: List[_Pending] = []
                     taken = cnt = 0
@@ -281,13 +468,35 @@ class DynamicBatcher:
                     # concatenated size.
                     taken = cnt = 0
                     for p in self._queue:
-                        if cnt and taken + len(p.rows) > self._drain_cap:
+                        if cnt and taken + p.n > self._drain_cap:
                             break
-                        taken += len(p.rows)
+                        taken += p.n
                         cnt += 1
                     batch = self._queue[:cnt]  # O(k) slice, not O(n) pops
                     del self._queue[:cnt]
                     self._queued_rows -= taken
+                    if batch and all(p.slab for p in batch):
+                        # Zero-copy drain: the batch IS the slab's
+                        # [0:taken] prefix (offsets are assigned in
+                        # queue order). Detach it, install the spare,
+                        # and move any leftover staged rows across so
+                        # queued entries always reference the live slab.
+                        batch_slab = self._slab
+                        self._slab = (self._spare if self._spare is not None
+                                      else np.empty_like(batch_slab))
+                        self._spare = None
+                        self._repack_locked(batch_slab)
+                    elif batch:
+                        # Mixed batch (slab-overflow fallback entries
+                        # interleaved): materialize the slab rows and
+                        # take the concatenate path; leftovers re-pack.
+                        for p in batch:
+                            if p.slab:
+                                p.rows = self._slab[
+                                    p.offset:p.offset + p.n].copy()
+                                p.slab = False
+                        if self._slab is not None:
+                            self._repack_locked(self._slab)
             for p in expired:
                 p.error = DeadlineExceeded("expired in batch queue")
                 p.event.set()
@@ -298,14 +507,24 @@ class DynamicBatcher:
             try:
                 t_flush = time.perf_counter()
                 with trace_span("batcher.flush", requests=cnt) as fs:
-                    rows = np.concatenate([p.rows for p in batch], axis=0)
-                    n = len(rows)
+                    n = taken
                     bucket = self._bucket(n)
                     fs.set_attr("rows", n)
                     fs.set_attr("bucket", bucket)
+                    fs.set_attr("zero_copy", batch_slab is not None)
                     with trace_span("batcher.pad", rows=n, bucket=bucket,
                                     pad_rows=bucket - n):
-                        padded = pad_rows(rows, bucket)
+                        if batch_slab is not None:
+                            # Pad in place: zero the tail rows of the
+                            # detached slab and hand the device a VIEW —
+                            # no concatenate, no pad allocation.
+                            if bucket > n:
+                                batch_slab[n:bucket] = 0.0
+                            padded = batch_slab[:bucket]
+                        else:
+                            padded = pad_rows(
+                                np.concatenate([p.rows for p in batch],
+                                               axis=0), bucket)
                     t_dev = time.perf_counter()
                     with trace_span("batcher.device_compute", rows=n,
                                     bucket=bucket) as ds:
@@ -318,19 +537,30 @@ class DynamicBatcher:
                         # explains it (one trace id across both).
                         with maybe_device_trace(ds):
                             preds = np.asarray(self._score(padded))[:n]
+                    if batch_slab is not None and \
+                            np.shares_memory(preds, batch_slab):
+                        # A host score_fn may hand back a view of its
+                        # input; the slab is about to be recycled, so
+                        # waiters must own their rows.
+                        preds = preds.copy()
                     self._m_compute.labels(bucket=bucket).observe(
                         time.perf_counter() - t_dev)
-                self._m_flush.observe(time.perf_counter() - t_flush)
+                flush_dur = time.perf_counter() - t_flush
+                self._m_flush.observe(flush_dur)
+                self._flush_ewma_s += 0.3 * (flush_dur - self._flush_ewma_s)
                 self._m_fill.observe(n / bucket if bucket else 1.0)
                 self._m_rows.inc(n)
                 self._m_flushes.inc()
                 self.stats["flushes"] += 1
                 self.stats["rows"] += n
+                if batch_slab is not None:
+                    self.stats["zero_copy_flushes"] += 1
+                    self._m_zero_copy.inc()
                 self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], n)
                 offset = 0
                 for p in batch:
-                    p.result = preds[offset: offset + len(p.rows)]
-                    offset += len(p.rows)
+                    p.result = preds[offset: offset + p.n]
+                    offset += p.n
                     p.event.set()
             except Exception as e:
                 for p in batch:
@@ -340,6 +570,8 @@ class DynamicBatcher:
             finally:
                 with self._lock:
                     self._flushing = False
+                    if batch_slab is not None and self._spare is None:
+                        self._spare = batch_slab
                     more = self._queued_rows >= self._drain_cap
             if not more:
                 return
@@ -363,6 +595,19 @@ class EtaService:
         self._load(self._path)
         self._batcher: Optional[DynamicBatcher] = None
         self._serving = _EMPTY_SERVING
+        # Fast lane (serve/fastlane.py): per-row prediction cache +
+        # singleflight consulted in _predict_rows before the batcher.
+        # None when both features are configured off.
+        self._fastlane = None
+        if cfg.fastlane_cache or cfg.fastlane_singleflight:
+            from routest_tpu.serve.fastlane import FastLane
+
+            self._fastlane = FastLane(
+                capacity=cfg.fastlane_cache_size,
+                ttl_s=cfg.fastlane_cache_ttl_s,
+                cache=cfg.fastlane_cache,
+                singleflight=cfg.fastlane_singleflight,
+                max_rows=cfg.fastlane_max_rows)
         self.kernel = "xla"  # which forward path serves: xla | pallas_fused
         # Hot-reload watcher (cfg.reload_sec > 0): the SERVICE owns it,
         # so embedders constructing EtaService directly get it too —
@@ -444,7 +689,8 @@ class EtaService:
         self._score = score
         self._batcher = DynamicBatcher(
             score, cfg.batch_buckets, cfg.max_batch, cfg.max_wait_ms,
-            align=align,
+            align=align, adaptive=getattr(cfg, "adaptive_wait", False),
+            min_wait_ms=getattr(cfg, "min_wait_ms", 0.0),
         )
         # Self-check: an artifact can deserialize fine yet be unusable
         # (e.g. stale layer shapes). Run one dummy row now so breakage
@@ -466,7 +712,8 @@ class EtaService:
             self._serving = _EMPTY_SERVING
         else:
             self._serving = _ServingState(self._model, self._batcher,
-                                          self.quantiles)
+                                          self.quantiles,
+                                          generation=next(_GENERATION))
             self._warm_buckets()
 
     def _warm_buckets(self) -> None:
@@ -720,6 +967,13 @@ class EtaService:
             self.kernel = fresh.kernel
             self._error = None
             self._loaded_mtime_ns = fresh._loaded_mtime_ns
+            # Cache coherency on reload: correctness already holds (the
+            # new snapshot carries a new generation, so old keys can
+            # never match) — this drop is memory hygiene, freeing the
+            # dead generation's entries immediately instead of waiting
+            # for LRU/TTL.
+            if self._fastlane is not None:
+                self._fastlane.invalidate()
             log.info("model_reloaded", path=self._path, kernel=self.kernel)
             return True
 
@@ -763,16 +1017,27 @@ class EtaService:
     def predict_batch(self, rows: np.ndarray) -> Optional[np.ndarray]:
         return self._predict_rows(self._serving, rows)
 
-    @staticmethod
-    def _predict_rows(serving: _ServingState,
+    def _predict_rows(self, serving: _ServingState,
                       rows: np.ndarray) -> Optional[np.ndarray]:
         """Score rows against ONE serving snapshot (hot-reload-safe:
         callers must pair the result with the SAME snapshot's quantile
-        metadata)."""
+        metadata). The fast lane is consulted first: cached rows never
+        reach the batcher, novel rows coalesce with identical in-flight
+        ones, and only the remainder costs a device slot."""
         batcher = serving.batcher
         if batcher is None:
             return None
         rows = np.asarray(rows, np.float32)
+        fl = self._fastlane
+        if fl is not None and fl.accepts(len(rows)):
+            return fl.predict(
+                rows, serving.generation,
+                lambda miss: self._submit_chunked(batcher, miss))
+        return self._submit_chunked(batcher, rows)
+
+    @staticmethod
+    def _submit_chunked(batcher: DynamicBatcher,
+                        rows: np.ndarray) -> np.ndarray:
         # Chunk oversize batches to the largest compile bucket: arbitrary
         # row counts would each compile a fresh executable (a client
         # sweeping sizes = recompile storm + unbounded jit cache).
@@ -930,4 +1195,6 @@ class EtaService:
                 "kernel": self.kernel}
         if self._batcher is not None:
             base.update(self._batcher.stats)
+        if self._fastlane is not None:
+            base["fastlane"] = self._fastlane.snapshot()
         return base

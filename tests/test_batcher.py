@@ -143,3 +143,94 @@ def test_alignment_rounds_buckets_to_shard_multiples():
     out = b.submit(np.ones((70, 12), np.float32))
     assert len(out) == 70
     assert calls[-1][0] % 6 == 0 and calls[-1][0] >= 70
+
+
+def test_bucket_oversized_rounds_to_align_multiple():
+    """_bucket beyond the largest bucket: exact shape rounded UP to the
+    shard multiple, never down, and aligned buckets stay aligned."""
+    b = DynamicBatcher(_echo_score([]), buckets=(8, 64), max_batch=64,
+                       max_wait_ms=1.0, align=6)
+    assert b._buckets == [12, 66]          # 8→12, 64→66
+    assert b._bucket(1) == 12
+    assert b._bucket(12) == 12
+    assert b._bucket(13) == 66
+    assert b._bucket(66) == 66
+    # oversized: smallest multiple of align that fits
+    assert b._bucket(67) == 72
+    assert b._bucket(72) == 72
+    assert b._bucket(73) == 78
+    unaligned = DynamicBatcher(_echo_score([]), buckets=(8,), max_batch=8,
+                               max_wait_ms=1.0)
+    assert unaligned._bucket(9) == 9       # align=1: exact shape
+
+
+def test_staging_slab_zero_copy_flush_and_fallbacks():
+    """Single submits ride the slab (zero-copy flush); oversized ones
+    fall back to the concatenate path; both produce correct rows."""
+    calls = []
+    b = DynamicBatcher(_echo_score(calls), buckets=(8, 64), max_batch=64,
+                       max_wait_ms=1.0)
+    rows = np.arange(3 * 12, dtype=np.float32).reshape(3, 12)
+    np.testing.assert_allclose(b.submit(rows), rows.sum(axis=1))
+    assert b.stats["zero_copy_flushes"] == 1
+    big = np.arange(70 * 12, dtype=np.float32).reshape(70, 12)
+    np.testing.assert_allclose(b.submit(big), big.sum(axis=1))
+    assert b.stats["flushes"] == 2
+    assert b.stats["zero_copy_flushes"] == 1   # oversized: fallback path
+
+
+def test_staging_slab_concurrent_fuzz_no_row_crosstalk():
+    """Satellite acceptance: 8 threads x random row counts through the
+    slab, every waiter's answer equals the direct score_fn on its OWN
+    rows — concurrent submits never read another waiter's rows back."""
+    rng = np.random.default_rng(7)
+
+    def score(x):
+        return x.sum(axis=1)
+
+    b = DynamicBatcher(score, buckets=(4, 16, 64), max_batch=64,
+                       max_wait_ms=5.0)
+    n_threads = 8
+    iters = 25
+    failures = []
+    barrier = threading.Barrier(n_threads)
+    payloads = [[rng.uniform(-50, 50, size=(int(rng.integers(1, 9)), 12))
+                 .astype(np.float32) for _ in range(iters)]
+                for _ in range(n_threads)]
+
+    def worker(t):
+        barrier.wait()
+        for rows in payloads[t]:
+            got = b.submit(rows)
+            want = score(rows)
+            if got.shape != want.shape or not np.allclose(got, want):
+                failures.append((t, rows.shape, got, want))
+                return
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not failures, failures[:2]
+    assert b.stats["rows"] == sum(len(r) for p in payloads for r in p)
+
+
+def test_adaptive_window_latency_vs_throughput_modes():
+    """The adaptive controller: ~zero window at low arrival rates
+    (latency mode), grows toward the cap under sustained load
+    (throughput mode), and decays back when traffic stops."""
+    from routest_tpu.serve.ml_service import _WindowController
+
+    c = _WindowController((8, 64, 512), max_wait_s=0.002, min_wait_s=0.0)
+    c.observe(1, 0.0)
+    assert c.window_s() == 0.0           # one lonely row: don't wait
+    t = 0.0
+    for _ in range(500):                  # sustained 64k rows/s
+        t += 0.001
+        c.observe(64, t)
+    grown = c.window_s()
+    assert 0.0 < grown <= 0.002, grown    # throughput mode, capped
+    c.observe(1, t + 10.0)                # long idle gap: rate decays
+    assert c.window_s() == 0.0

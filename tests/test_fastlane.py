@@ -1,0 +1,262 @@
+"""Serving fast lane (serve/fastlane.py): prediction cache correctness,
+singleflight coalescing, chaos safety, and the EtaService integration
+(no stale serve across hot-reload; no poisoning on device faults)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from routest_tpu import chaos
+from routest_tpu.chaos import ChaosEngine
+from routest_tpu.core.config import ServeConfig
+from routest_tpu.core.dtypes import F32_POLICY
+from routest_tpu.models.eta_mlp import EtaMLP
+from routest_tpu.serve.fastlane import FastLane
+from routest_tpu.serve.ml_service import EtaService
+from routest_tpu.train.checkpoint import save_model
+
+
+@pytest.fixture(autouse=True)
+def _reset_chaos():
+    yield
+    chaos.configure(None)  # back to lazy env-driven (disabled in tests)
+
+
+def _rows(*vals, width=4):
+    out = np.zeros((len(vals), width), np.float32)
+    out[:, 0] = vals
+    return out
+
+
+def _doubler(calls):
+    def compute(rows):
+        calls.append(np.array(rows[:, 0]))
+        return rows[:, 0] * 2.0
+
+    return compute
+
+
+def test_cache_hit_skips_compute_and_preserves_order():
+    calls = []
+    fl = FastLane(capacity=16, ttl_s=60.0)
+    np.testing.assert_allclose(fl.predict(_rows(1, 2), 0, _doubler(calls)),
+                               [2.0, 4.0])
+    # Second request: both rows cached, different order — compute never
+    # runs again and results follow THIS request's row order.
+    np.testing.assert_allclose(fl.predict(_rows(2, 1), 0, _doubler(calls)),
+                               [4.0, 2.0])
+    assert len(calls) == 1
+
+
+def test_partial_hit_computes_only_novel_rows():
+    calls = []
+    fl = FastLane(capacity=16, ttl_s=60.0)
+    fl.predict(_rows(1), 0, _doubler(calls))
+    out = fl.predict(_rows(3, 1, 4), 0, _doubler(calls))
+    np.testing.assert_allclose(out, [6.0, 2.0, 8.0])
+    # the second compute saw exactly the two novel rows
+    np.testing.assert_allclose(calls[1], [3.0, 4.0])
+
+
+def test_generation_change_misses():
+    calls = []
+    fl = FastLane(capacity=16, ttl_s=60.0)
+    fl.predict(_rows(1), generation=0, compute=_doubler(calls))
+    fl.predict(_rows(1), generation=1, compute=_doubler(calls))
+    assert len(calls) == 2  # same bytes, new model: MUST recompute
+
+
+def test_ttl_expiry_recomputes():
+    calls = []
+    fl = FastLane(capacity=16, ttl_s=0.02)
+    fl.predict(_rows(1), 0, _doubler(calls))
+    time.sleep(0.05)
+    fl.predict(_rows(1), 0, _doubler(calls))
+    assert len(calls) == 2
+
+
+def test_lru_eviction_bounds_entries():
+    fl = FastLane(capacity=2, ttl_s=60.0)
+    for v in (1, 2, 3, 4):
+        fl.predict(_rows(v), 0, _doubler([]))
+    assert fl.snapshot()["entries"] == 2
+
+
+def test_duplicate_rows_in_one_request_compute_once():
+    calls = []
+    fl = FastLane(capacity=16, ttl_s=60.0)
+    out = fl.predict(_rows(5, 5, 7, 5), 0, _doubler(calls))
+    np.testing.assert_allclose(out, [10.0, 10.0, 14.0, 10.0])
+    np.testing.assert_allclose(calls[0], [5.0, 7.0])  # unique rows only
+
+
+def test_quantile_shaped_rows_round_trip():
+    fl = FastLane(capacity=16, ttl_s=60.0)
+
+    def compute(rows):
+        return np.stack([rows[:, 0], rows[:, 0] + 1, rows[:, 0] + 2], axis=1)
+
+    a = fl.predict(_rows(1, 2), 0, compute)
+    assert a.shape == (2, 3)
+    b = fl.predict(_rows(2, 1), 0, compute)  # from cache, reordered
+    np.testing.assert_allclose(b, [[2, 3, 4], [1, 2, 3]])
+
+
+def test_max_rows_bypasses_cache():
+    calls = []
+    fl = FastLane(capacity=16, ttl_s=60.0, max_rows=2)
+    fl.predict(_rows(1, 2, 3), 0, _doubler(calls))
+    fl.predict(_rows(1, 2, 3), 0, _doubler(calls))
+    assert len(calls) == 2           # recomputed: over the bypass bound
+    assert fl.snapshot()["entries"] == 0
+
+
+def test_cache_disabled_singleflight_only():
+    calls = []
+    fl = FastLane(capacity=16, ttl_s=60.0, cache=False)
+    fl.predict(_rows(1), 0, _doubler(calls))
+    fl.predict(_rows(1), 0, _doubler(calls))
+    assert len(calls) == 2 and fl.snapshot()["entries"] == 0
+
+
+def test_singleflight_concurrent_identical_requests_compute_once():
+    """N concurrent identical requests cost ONE compute, and every
+    caller gets the identical (uncoalesced-equivalent) result."""
+    n_threads = 8
+    calls = []
+    release = threading.Event()
+    barrier = threading.Barrier(n_threads)
+    fl = FastLane(capacity=16, ttl_s=60.0)
+
+    def slow_compute(rows):
+        calls.append(np.array(rows[:, 0]))
+        release.wait(5.0)
+        return rows[:, 0] * 2.0
+
+    results = [None] * n_threads
+
+    def worker(i):
+        barrier.wait()
+        if i == 0:
+            time.sleep(0.0)  # every thread races the same key
+        results[i] = fl.predict(_rows(9), 0, slow_compute)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)   # let everyone reach the leader-or-join decision
+    release.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(calls) == 1, "identical concurrent rows must coalesce"
+    for r in results:
+        np.testing.assert_allclose(r, [18.0])
+    # Uncoalesced oracle: direct compute produces the same number.
+    np.testing.assert_allclose(results[0], _rows(9)[:, 0] * 2.0)
+
+
+def test_singleflight_error_propagates_and_never_poisons():
+    n_threads = 4
+    attempts = []
+    barrier = threading.Barrier(n_threads)
+    fl = FastLane(capacity=16, ttl_s=60.0)
+
+    def flaky(rows):
+        attempts.append(len(rows))
+        time.sleep(0.05)
+        raise RuntimeError("device fell over")
+
+    outcomes = [None] * n_threads
+
+    def worker(i):
+        barrier.wait()
+        try:
+            fl.predict(_rows(3), 0, flaky)
+            outcomes[i] = "ok"
+        except RuntimeError:
+            outcomes[i] = "raised"
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert outcomes == ["raised"] * n_threads
+    assert fl.snapshot() == {"entries": 0, "capacity": 16, "inflight": 0}
+    # Recovery: the next request computes fresh and caches normally.
+    out = fl.predict(_rows(3), 0, _doubler([]))
+    np.testing.assert_allclose(out, [6.0])
+    assert fl.snapshot()["entries"] == 1
+
+
+# ── EtaService integration ────────────────────────────────────────────
+
+def _write_model(path, seed):
+    model = EtaMLP(hidden=(8,), policy=F32_POLICY)
+    params = model.init(jax.random.PRNGKey(seed))
+    save_model(path, model, params)
+    import os
+
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+
+
+def _eta(svc):
+    eta, _ = svc.predict_eta_minutes(weather="Sunny", traffic="Low",
+                                     distance_m=10_000, pickup_time=None)
+    return eta
+
+
+def test_service_cache_serves_repeats_without_device_calls(tmp_path):
+    path = str(tmp_path / "m.msgpack")
+    _write_model(path, seed=0)
+    svc = EtaService(ServeConfig(adaptive_wait=False), model_path=path)
+    first = _eta(svc)
+    flushes_after_first = svc.stats["flushes"]
+    for _ in range(5):
+        assert _eta(svc) == first
+    assert svc.stats["flushes"] == flushes_after_first, \
+        "repeated identical rows must be served from cache"
+
+
+def test_no_stale_serve_after_reload(tmp_path):
+    """Acceptance: a hot-reload must invalidate every cached prediction
+    — the cache is keyed by model generation, so the very first request
+    after the swap computes against the NEW model."""
+    path = str(tmp_path / "m.msgpack")
+    _write_model(path, seed=0)
+    svc = EtaService(ServeConfig(adaptive_wait=False), model_path=path)
+    before = _eta(svc)
+    assert _eta(svc) == before          # primed: served from cache
+    _write_model(path, seed=99)
+    assert svc.reload_if_changed() is True
+    after = _eta(svc)
+    assert after is not None and after != before
+    # And the new answer matches a fresh, cache-cold service.
+    oracle = EtaService(
+        ServeConfig(fastlane_cache=False, fastlane_singleflight=False,
+                    adaptive_wait=False), model_path=path)
+    assert _eta(oracle) == after
+
+
+def test_chaos_device_error_bypasses_cache_not_poisons(tmp_path):
+    """Acceptance: an injected device.compute fault must neither serve
+    from nor write to the cache — the failed request degrades, the next
+    one computes fresh and returns the true value."""
+    path = str(tmp_path / "m.msgpack")
+    _write_model(path, seed=1)
+    svc = EtaService(ServeConfig(adaptive_wait=False), model_path=path)
+    oracle = _eta(svc)  # cached now; computed pre-chaos
+    svc._fastlane.invalidate()  # make the next request recompute
+    chaos.configure(ChaosEngine(spec="device.compute:error=1.0@1", seed=0))
+    degraded = _eta(svc)
+    assert degraded is None  # fault surfaced as graceful degrade
+    # limit=1 spent: device is healthy again; the cache must hold NO
+    # entry from the failed attempt and the fresh compute must match
+    # the pre-chaos oracle.
+    assert _eta(svc) == oracle

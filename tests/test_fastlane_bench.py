@@ -1,0 +1,50 @@
+"""Fast-lane perf regression gate (slow): runs a reduced
+``scripts/bench_serving_fastlane.py`` config — one real replica + the
+gateway, closed-loop load, fast lane off vs on — and fails the suite if
+the fast lane stops paying. Same contract as the chaos matrix: the
+composed system's perf invariants break loudly, not silently.
+
+The guardbands are intentionally looser than the artifact-of-record
+gates (artifacts/serving_fastlane.json, recorded by a full-length run):
+a CI container is 1-core and noisy, so this asserts direction, not
+magnitude — fast lane ON must not be SLOWER than OFF on either
+workload.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_fastlane_on_is_not_slower_than_off(tmp_path):
+    out = tmp_path / "serving_fastlane.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "bench_serving_fastlane.py"),
+         "--quick", "--out", str(out)],
+        cwd=REPO, timeout=900, capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(out.read_text())
+
+    rep = rec["workloads"]["repeated"]
+    # Repeated-OD workload: the fast lane must WIN — meaningfully better
+    # p95 or throughput (full gates: >=20% / >=1.3x; CI band: >=10% /
+    # >=1.1x to absorb 1-core scheduling noise).
+    assert (rep["summary"]["p95_cut"] >= 0.10
+            or rep["summary"]["throughput_ratio"] >= 1.10), rep["summary"]
+    assert rep["on"]["cache_hit_rate"] is not None \
+        and rep["on"]["cache_hit_rate"] > 0.5, rep["on"]
+
+    uniq = rec["workloads"]["unique"]
+    # All-unique workload: the cache can only add overhead — p95 must
+    # stay inside the guardband (no regression).
+    assert uniq["on"]["p95_ms"] <= uniq["off"]["p95_ms"] * 1.25, \
+        uniq["summary"]
+    assert uniq["on"]["errors"] == 0 and rep["on"]["errors"] == 0
