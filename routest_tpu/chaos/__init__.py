@@ -278,3 +278,12 @@ def configure(engine: Optional[ChaosEngine]) -> None:
 def inject(name: str) -> None:
     """Module-level convenience: ``chaos.inject("store.http")``."""
     get_chaos().inject(name)
+
+
+def current_engine() -> Optional[ChaosEngine]:
+    """The installed engine when injection is LIVE, else None — without
+    building one from env (readers like the flight recorder stamp chaos
+    state onto every request record and must not pay a config parse
+    when chaos was never configured)."""
+    engine = _engine
+    return engine if engine is not None and engine.enabled else None

@@ -167,6 +167,66 @@ class ObsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """SLO engine (``routest_tpu/obs/slo.py``): per-route objectives
+    evaluated over rolling multi-window burn rates (Google SRE workbook
+    §5, "multiwindow, multi-burn-rate alerts"). All knobs are
+    ``RTPU_SLO_*`` env vars.
+
+    ``objectives`` is a spec string; empty means the built-in defaults
+    (``/api/optimize_route``, ``/api/predict_eta``, and — on the replica
+    — the store dependency). Grammar::
+
+        spec ::= obj (";" obj)*
+        obj  ::= route [":" key "=" val ("," key "=" val)*]
+        keys: availability (target fraction, default 0.999),
+              latency_ms (threshold; omitted = no latency objective),
+              latency_target (fraction under threshold, default 0.99)
+
+    ``page_burn``/``warn_burn`` are the burn-rate thresholds that must
+    hold on BOTH windows for the alert edge (14.4 ≈ exhausting a 30-day
+    budget in 2 days, the workbook's fast-page default)."""
+
+    enabled: bool = True
+    tick_s: float = 1.0
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    page_burn: float = 14.4
+    warn_burn: float = 6.0
+    objectives: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class RecorderConfig:
+    """Flight recorder (``routest_tpu/obs/recorder.py``): an always-on
+    bounded ring of completed-request records + correlated log lines
+    that dumps a self-contained postmortem bundle on trigger. All knobs
+    are ``RTPU_RECORDER_*`` env vars; disk usage is bounded by
+    ``max_bundles``/``max_total_mb`` (oldest bundles pruned) and
+    ``min_interval_s`` rate-limits automatic triggers so a crash loop
+    cannot fill the disk."""
+
+    enabled: bool = True
+    capacity: int = 512
+    log_capacity: int = 512
+    dir: str = "artifacts/postmortems"
+    max_bundles: int = 16
+    max_total_mb: float = 64.0
+    min_interval_s: float = 30.0
+    # Automatic trigger thresholds: a 5xx burst (``burst_5xx`` server
+    # errors inside ``burst_window_s``) or a deadline-expiry spike
+    # (``deadline_spike`` 504s inside the same window).
+    burst_5xx: int = 5
+    burst_window_s: float = 10.0
+    deadline_spike: int = 20
+    # An SLO page edge fires at the FIRST evidence of an incident —
+    # often while the offending requests are still in flight. The
+    # follow-up bundle, this many seconds later, captures what the
+    # incident's opening seconds actually served. 0 disables.
+    followup_s: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ChaosConfig:
     """Fault injection (``routest_tpu/chaos``): a seeded, deterministic
     chaos layer wrapping every IO boundary. Disabled unless
@@ -188,6 +248,9 @@ class Config:
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
+    slo: SloConfig = dataclasses.field(default_factory=SloConfig)
+    recorder: RecorderConfig = dataclasses.field(
+        default_factory=RecorderConfig)
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -284,7 +347,9 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         unhealthy_after=_int("RTPU_FLEET_UNHEALTHY_AFTER", 3),
     )
     return Config(mesh=mesh, model=model, train=train, serve=serve,
-                  fleet=fleet, obs=obs, chaos=load_chaos_config(env))
+                  fleet=fleet, obs=obs, chaos=load_chaos_config(env),
+                  slo=load_slo_config(env),
+                  recorder=load_recorder_config(env))
 
 
 def load_chaos_config(env: Optional[Mapping[str, str]] = None) -> ChaosConfig:
@@ -300,6 +365,56 @@ def load_chaos_config(env: Optional[Mapping[str, str]] = None) -> ChaosConfig:
         return ChaosConfig(enabled=False, seed=0, spec=spec)
     enabled = bool(spec.strip()) and env.get("RTPU_CHAOS", "1") != "0"
     return ChaosConfig(enabled=enabled, seed=seed, spec=spec)
+
+
+def _env_num(env: Mapping[str, str], name: str, default, cast):
+    """Ops-knob number parse: a malformed value keeps the default (a
+    typo in an env var must never abort server boot)."""
+    raw = env.get(name)
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        return default
+
+
+def load_slo_config(env: Optional[Mapping[str, str]] = None) -> SloConfig:
+    """Just the SLO knobs (read lazily by ``routest_tpu/obs/slo.py``
+    without paying for a full Config build)."""
+    env = dict(env if env is not None else os.environ)
+    return SloConfig(
+        enabled=env.get("RTPU_SLO", "1") != "0",
+        tick_s=_env_num(env, "RTPU_SLO_TICK_S", 1.0, float),
+        fast_window_s=_env_num(env, "RTPU_SLO_FAST_S", 300.0, float),
+        slow_window_s=_env_num(env, "RTPU_SLO_SLOW_S", 3600.0, float),
+        page_burn=_env_num(env, "RTPU_SLO_PAGE_BURN", 14.4, float),
+        warn_burn=_env_num(env, "RTPU_SLO_WARN_BURN", 6.0, float),
+        objectives=env.get("RTPU_SLO_OBJECTIVES", ""),
+    )
+
+
+def load_recorder_config(
+        env: Optional[Mapping[str, str]] = None) -> RecorderConfig:
+    """Just the flight-recorder knobs (read lazily by
+    ``routest_tpu/obs/recorder.py`` at first ``get_recorder()``)."""
+    env = dict(env if env is not None else os.environ)
+    return RecorderConfig(
+        enabled=env.get("RTPU_RECORDER", "1") != "0",
+        capacity=_env_num(env, "RTPU_RECORDER_CAPACITY", 512, int),
+        log_capacity=_env_num(env, "RTPU_RECORDER_LOG_CAPACITY", 512, int),
+        dir=env.get("RTPU_RECORDER_DIR") or "artifacts/postmortems",
+        max_bundles=_env_num(env, "RTPU_RECORDER_MAX_BUNDLES", 16, int),
+        max_total_mb=_env_num(env, "RTPU_RECORDER_MAX_MB", 64.0, float),
+        min_interval_s=_env_num(env, "RTPU_RECORDER_MIN_INTERVAL_S",
+                                30.0, float),
+        burst_5xx=_env_num(env, "RTPU_RECORDER_BURST_5XX", 5, int),
+        burst_window_s=_env_num(env, "RTPU_RECORDER_BURST_WINDOW_S",
+                                10.0, float),
+        deadline_spike=_env_num(env, "RTPU_RECORDER_DEADLINE_SPIKE",
+                                20, int),
+        followup_s=_env_num(env, "RTPU_RECORDER_FOLLOWUP_S", 5.0, float),
+    )
 
 
 def load_obs_config(env: Optional[Mapping[str, str]] = None) -> ObsConfig:

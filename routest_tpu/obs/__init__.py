@@ -1,24 +1,33 @@
 """Observability spine: request tracing + one process-wide metrics registry.
 
-Three pieces (ISSUE 2; Dapper §2, W3C Trace Context):
+Five pieces (ISSUE 2 + ISSUE 5; Dapper §2, W3C Trace Context, SRE
+workbook ch. 5):
 
 - ``trace``   — a sampling :class:`Tracer` producing :class:`Span`s with
   contextvar-carried parentage and ``traceparent`` inject/extract, so one
   trace id survives client → gateway → replica → batcher → device;
 - ``registry`` — process-wide counters/gauges/histograms (fixed log-scale
-  buckets) behind one API, exported as JSON and Prometheus text;
+  buckets, per-bucket trace exemplars) behind one API, exported as JSON
+  and Prometheus text;
 - ``export``  — bounded in-memory span buffer with JSONL and Chrome
-  ``trace_event`` dumps, plus the optional per-span device-trace hook.
+  ``trace_event`` dumps, plus the optional per-span device-trace hook;
+- ``slo``     — per-route objectives evaluated over rolling multi-window
+  burn rates (``ok → warn → page``), rolled up from the registry;
+- ``recorder`` — the always-on flight recorder: bounded request/log
+  rings that dump self-contained postmortem bundles on trigger.
 
-Everything here is stdlib-only (the fleet gateway imports it) and safe to
-call on hot paths: an unsampled span is one small object and two
-contextvar operations; a disabled tracer is a shared no-op.
+``slo`` and ``recorder`` import lazily (``from routest_tpu.obs.slo
+import …``) — they pull ``core.config``, which the spine itself must
+not. Everything here is stdlib-only (the fleet gateway imports it) and
+safe to call on hot paths: an unsampled span is one small object and
+two contextvar operations; a disabled tracer is a shared no-op.
 """
 
 from routest_tpu.obs.export import (SpanBuffer, to_chrome_trace,  # noqa: F401
                                     to_jsonl)
 from routest_tpu.obs.registry import (DEFAULT_TIME_BUCKETS,  # noqa: F401
-                                      MetricsRegistry, get_registry)
+                                      MetricsRegistry, get_registry,
+                                      register_build_info)
 from routest_tpu.obs.trace import (CURRENT, REQUEST_ID_RE,  # noqa: F401
                                    Span, SpanContext, Tracer,
                                    configure_tracer, current_context,

@@ -21,7 +21,22 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Exemplar capture reads the ambient trace context lazily (obs.trace
+# imports nothing from this module, so the deferred import cannot
+# cycle; deferring keeps registry importable standalone).
+_current_context = None
+
+
+def _ambient_trace_context():
+    global _current_context
+    if _current_context is None:
+        from routest_tpu.obs.trace import current_context
+
+        _current_context = current_context
+    return _current_context()
 
 # Latency seconds, 500 µs … 60 s: the serving stack's observed range
 # (sub-ms batcher waits up to multi-second cold road solves).
@@ -85,7 +100,7 @@ class Gauge(_Child):
 
 
 class Histogram(_Child):
-    __slots__ = ("buckets", "counts", "sum", "count")
+    __slots__ = ("buckets", "counts", "sum", "count", "exemplars")
 
     def __init__(self, buckets: Sequence[float]) -> None:
         super().__init__()
@@ -93,15 +108,36 @@ class Histogram(_Child):
         self.counts = [0] * (len(self.buckets) + 1)  # + the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        # Per-bucket exemplars: the most recent (trace_id, value,
+        # unix_ms) observation made inside a SAMPLED trace — the link
+        # from "p99 spiked" to a dumpable trace (/api/trace?trace_id=).
+        self.exemplars: List[Optional[Tuple[str, float, int]]] = \
+            [None] * (len(self.buckets) + 1)
 
     def observe(self, v: float) -> None:
         if not math.isfinite(v):
             return  # a NaN observation would poison sum forever
         i = bisect.bisect_left(self.buckets, v)
+        ctx = _ambient_trace_context()
+        exemplar = (ctx.trace_id, v, int(time.time() * 1000)) \
+            if ctx is not None and ctx.sampled else None
         with self._lock:
             self.counts[i] += 1
             self.sum += v
             self.count += 1
+            if exemplar is not None:
+                self.exemplars[i] = exemplar
+
+    def exemplar_list(self) -> List[dict]:
+        """Non-empty bucket exemplars, one dict per bucket:
+        ``{le, trace_id, value, unix_ms}`` (``le`` = the bucket's upper
+        bound; the overflow bucket reports ``inf``)."""
+        with self._lock:
+            pairs = list(zip(list(self.buckets) + [math.inf],
+                             self.exemplars))
+        return [{"le": le, "trace_id": ex[0], "value": round(ex[1], 6),
+                 "unix_ms": ex[2]}
+                for le, ex in pairs if ex is not None]
 
     def cumulative(self) -> List[Tuple[float, int]]:
         """[(upper_bound, cumulative_count), …, (inf, total)]."""
@@ -226,6 +262,13 @@ class MetricsRegistry:
         return self._get_or_create(name, "histogram", help_, labelnames,
                                    buckets)
 
+    def get(self, name: str) -> Optional[_Metric]:
+        """Registered family by name, or None (read-side consumers —
+        the SLO engine's rollup sources — must not create families as a
+        side effect of looking)."""
+        with self._lock:
+            return self._metrics.get(name)
+
     # ── export ────────────────────────────────────────────────────────
 
     def snapshot(self) -> dict:
@@ -247,6 +290,9 @@ class MetricsRegistry:
                         for q, label in ((0.5, "p50"), (0.95, "p95"),
                                          (0.99, "p99")):
                             entry[label] = round(child.quantile(q), 6)
+                        exemplars = child.exemplar_list()
+                        if exemplars:
+                            entry["exemplars"] = exemplars
                     series.append(entry)
                 else:
                     series.append({"labels": labels, "value": child.value})
@@ -286,3 +332,58 @@ _default_registry = MetricsRegistry()
 def get_registry() -> MetricsRegistry:
     """The process-wide registry every layer records into."""
     return _default_registry
+
+
+_PROCESS_START = time.time()
+
+
+def _git_sha() -> str:
+    """Best-effort build identity: the deploy platforms' env stamps
+    first (the names ``core/config.py`` already honors for the health
+    version field), then the working tree's ``.git/HEAD`` (a file read,
+    no subprocess at serve boot)."""
+    import os
+
+    for name in ("RENDER_GIT_COMMIT", "GIT_COMMIT_SHA"):
+        sha = os.environ.get(name)
+        if sha:
+            return sha[:40]
+    try:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        with open(os.path.join(root, ".git", "HEAD")) as f:
+            head = f.read().strip()
+        if head.startswith("ref:"):
+            with open(os.path.join(root, ".git", head.split(None, 1)[1])) as f:
+                return f.read().strip()[:40]
+        return head[:40]
+    except OSError:
+        return "unknown"
+
+
+def register_build_info(registry: Optional[MetricsRegistry] = None) -> None:
+    """Register the standard identity gauges on ``registry`` (default:
+    the process registry): ``rtpu_build_info`` — constant 1 with
+    version/jax/git-sha labels, the Prometheus ``*_build_info``
+    convention — and ``rtpu_process_start_time_seconds``. Idempotent;
+    called from serving bring-up on both tiers."""
+    reg = registry if registry is not None else _default_registry
+    try:
+        from routest_tpu import __version__ as version
+    except ImportError:  # pragma: no cover - package always has one
+        version = "unknown"
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except ImportError:
+        jax_version = "absent"
+    reg.gauge(
+        "rtpu_build_info",
+        "Build identity: constant 1, carried in the labels.",
+        ("version", "jax", "git_sha"),
+    ).labels(version=version, jax=jax_version, git_sha=_git_sha()).set(1)
+    reg.gauge(
+        "rtpu_process_start_time_seconds",
+        "Unix time this process imported the metrics registry.",
+    ).set(_PROCESS_START)

@@ -96,6 +96,30 @@ def create_app(config: Optional[Config] = None,
     app.state = state  # for tests / introspection
     mount_auth(app, state.auth, mailer=state.mailer)
 
+    # Standard identity gauges (rtpu_build_info + process start time) on
+    # the process registry every /api/metrics exposition includes.
+    from routest_tpu.obs import register_build_info
+
+    register_build_info()
+
+    # SLO engine: per-route burn-rate objectives over THIS app's
+    # request-stats registry plus the store dependency, ticking on a
+    # daemon thread so alert edges (and their postmortem bundles) fire
+    # even when nobody polls /api/slo. The flight recorder subscribes
+    # to page edges and carries the engine's state in every bundle.
+    from routest_tpu.obs.recorder import get_recorder
+    from routest_tpu.obs.slo import build_replica_engine
+
+    recorder = get_recorder()
+    app.slo = None
+    if config.slo.enabled:
+        app.slo = build_replica_engine(app.request_stats.registry,
+                                       config.slo)
+        app.slo.on_page.append(recorder.on_slo_page)
+        recorder.register_slo_engine(app.slo)
+        if config.slo.tick_s > 0:
+            app.slo.start()
+
     # ── optimization ────────────────────────────────────────────────────
 
     @app.route("/api/request_route", methods=("POST",))
@@ -631,6 +655,31 @@ def create_app(config: Optional[Config] = None,
         # exceptions) — a dump endpoint must render them, not 500.
         return Response(_json.dumps(payload, default=str), 200,
                         mimetype="application/json")
+
+    @app.route("/api/slo", methods=("GET",))
+    def slo_state(request):
+        # Burn-rate alert surface (docs/OBSERVABILITY.md "SLOs &
+        # burn-rate alerts"): per-objective state machine, fast/slow
+        # burns, remaining error budget. A request forces a fresh tick
+        # so the answer reflects NOW, not the last ticker wakeup.
+        if app.slo is None:
+            return {"enabled": False}, 200
+        app.slo.tick()
+        return app.slo.snapshot(), 200
+
+    @app.route("/api/debug/snapshot", methods=("POST",))
+    def debug_snapshot(request):
+        # Manual postmortem trigger (same bundle the automatic
+        # triggers write). force=True: an operator asking for evidence
+        # bypasses the crash-loop rate limit; the disk bounds hold.
+        from routest_tpu.obs.recorder import get_recorder as _gr
+
+        rec = _gr()
+        bundle = rec.trigger("manual_api", {"source": "api"}, force=True)
+        if bundle is None:
+            return {"error": "recorder disabled or bundle write failed",
+                    "recorder": rec.snapshot()}, 503
+        return {"bundle": bundle, "recorder": rec.snapshot()}, 200
 
     @app.route("/api/health", methods=("GET",))
     def health(request):

@@ -69,6 +69,9 @@ def main() -> None:
 
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
+    from routest_tpu.obs.recorder import install_sigusr2_trigger
+
+    install_sigusr2_trigger()  # SIGUSR2 → gateway postmortem bundle
     stop.wait()
     _log.info("draining")
     gateway.drain(timeout=30)

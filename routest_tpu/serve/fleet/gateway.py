@@ -38,7 +38,8 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from routest_tpu.core.config import FleetConfig
-from routest_tpu.obs import get_registry, to_chrome_trace
+from routest_tpu.obs import (get_registry, register_build_info,
+                             to_chrome_trace)
 from routest_tpu.obs.trace import (REQUEST_ID_RE, get_tracer,
                                    mint_request_id, parse_traceparent,
                                    trace_span)
@@ -58,6 +59,24 @@ _IDEMPOTENT_POST = {
 _HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
                 "proxy-authorization", "te", "trailer",
                 "transfer-encoding", "upgrade"}
+
+# Bounded route-label vocabulary for the gateway's per-route metric
+# families (the SLO engine's rollup source). Anything else — including
+# attacker-chosen paths — folds into "other" so label cardinality
+# cannot be driven from the wire.
+_ROUTE_LABELS = _IDEMPOTENT_POST | {
+    "/api/optimize_route", "/api/optimize_route_batch", "/api/history",
+    "/api/update_tracker", "/api/confirm_route", "/api/health",
+    "/api/locations", "/api/ping", "/up",
+}
+
+
+def _route_label(bare: str) -> str:
+    if bare in _ROUTE_LABELS:
+        return bare
+    if bare.startswith("/api/history/"):
+        return "/api/history/<id>"
+    return "other"
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
@@ -180,6 +199,33 @@ class Gateway:
         self._m_admit_wait = reg.histogram(
             "rtpu_gateway_admit_wait_seconds",
             "Time spent queued in admission control.")
+        # Per-route request families: what the client actually saw from
+        # the fleet (post-admission, post-retry/hedge) — the gateway SLO
+        # engine's rollup source, and until now a blind spot (only
+        # per-replica upstream latency existed).
+        self._m_requests = reg.histogram(
+            "rtpu_gateway_request_seconds",
+            "Gateway request latency by route (client-observed).",
+            ("route",))
+        self._m_request_errors = reg.counter(
+            "rtpu_gateway_request_errors_total",
+            "Gateway responses with status >= 500, by route.", ("route",))
+        register_build_info()
+        # SLO engine over the per-route families above; the ticker
+        # starts with serve() (a Gateway constructed for one handle()
+        # call in tests shouldn't spawn threads).
+        from routest_tpu.obs.recorder import get_recorder
+        from routest_tpu.obs.slo import build_gateway_engine
+
+        self._recorder = get_recorder()
+        self.slo = None
+        from routest_tpu.core.config import load_slo_config
+
+        slo_cfg = load_slo_config()
+        if slo_cfg.enabled:
+            self.slo = build_gateway_engine(slo_cfg)
+            self.slo.on_page.append(self._recorder.on_slo_page)
+            self._recorder.register_slo_engine(self.slo)
 
     # ── admission control ─────────────────────────────────────────────
 
@@ -370,7 +416,34 @@ class Gateway:
 
     def handle(self, method: str, path: str, body: Optional[bytes],
                headers: Dict[str, str], deadline_ms: Optional[float]):
-        """Full gateway pipeline → (status, headers, body).
+        """Full gateway pipeline → (status, headers, body), measured:
+        every response lands in the per-route request families (the SLO
+        rollup source) and the flight recorder's request ring."""
+        t0 = time.perf_counter()
+        status, rh, data = self._handle_inner(method, path, body,
+                                              headers, deadline_ms)
+        seconds = time.perf_counter() - t0
+        route = _route_label(path.split("?", 1)[0])
+        self._m_requests.labels(route=route).observe(seconds)
+        if status >= 500:
+            self._m_request_errors.labels(route=route).inc()
+        rid = trace_id = None
+        for k, v in rh:
+            lk = k.lower()
+            if lk == "x-request-id":
+                rid = v
+            elif lk == "x-trace-id":
+                trace_id = v
+        self._recorder.record_request(
+            tier="gateway", method=method, path=path.split("?", 1)[0],
+            status=status, duration_ms=seconds * 1000.0,
+            request_id=rid, trace_id=trace_id, deadline_ms=deadline_ms)
+        return status, rh, data
+
+    def _handle_inner(self, method: str, path: str, body: Optional[bytes],
+                      headers: Dict[str, str],
+                      deadline_ms: Optional[float]):
+        """The pipeline proper → (status, headers, body).
 
         The trace is born HERE (or adopted from a well-formed client
         ``traceparent``): one root span per proxied request, with
@@ -588,12 +661,17 @@ class Gateway:
         the fleet tier's view into worker-side registries without a
         second scrape config. Unreachable replicas report the error
         instead of failing the whole endpoint."""
+        return self._fetch_replica_json("/api/metrics")
+
+    def _fetch_replica_json(self, path: str) -> dict:
+        """GET ``path`` from every replica → {replica_id: parsed JSON};
+        unreachable replicas report the error in place."""
         out = {}
         for r in self.replicas:
             try:
                 conn = _fresh_conn(r.host, r.port, timeout=2.0)
                 try:
-                    conn.request("GET", "/api/metrics")
+                    conn.request("GET", path)
                     resp = conn.getresponse()
                     out[r.id] = json.loads(resp.read())
                 finally:
@@ -636,6 +714,10 @@ class Gateway:
                     return self._metrics()
                 if bare == "/api/trace":
                     return self._trace()
+                if bare == "/api/slo":
+                    return self._slo()
+                if bare == "/api/debug/snapshot" and self.command == "POST":
+                    return self._debug_snapshot()
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
                 deadline_ms = None
@@ -668,6 +750,35 @@ class Gateway:
                     data = json.dumps(snap).encode()
                     ctype = "application/json"
                 self._respond(200, [("Content-Type", ctype)], data)
+
+            def _slo(self):
+                """Gateway burn-rate state (the same contract as the
+                replica's ``/api/slo``); ``?replicas=1`` embeds each
+                worker's /api/slo, mirroring the metrics passthrough."""
+                if gw.slo is None:
+                    payload = {"enabled": False}
+                else:
+                    gw.slo.tick()
+                    payload = gw.slo.snapshot()
+                if "replicas=1" in self.path:
+                    payload["replica_slo"] = gw._fetch_replica_json(
+                        "/api/slo")
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
+
+            def _debug_snapshot(self):
+                """Manual postmortem bundle from the GATEWAY process
+                (the replica's own /api/debug/snapshot is a plain
+                proxied POST — this path must not be forwarded)."""
+                bundle = gw._recorder.trigger(
+                    "manual_api", {"source": "gateway"}, force=True)
+                status = 200 if bundle else 503
+                self._respond(
+                    status, [("Content-Type", "application/json")],
+                    json.dumps({"bundle": bundle,
+                                "recorder": gw._recorder.snapshot()},
+                               default=str).encode())
 
             def _trace(self):
                 """Span flight-recorder dump (same contract as the
@@ -742,6 +853,8 @@ class Gateway:
         httpd = http.server.ThreadingHTTPServer((host, port), Handler)
         httpd.daemon_threads = True
         self._httpd = httpd
+        if self.slo is not None and self.slo.config.tick_s > 0:
+            self.slo.start()  # burn-rate ticker lives with the listener
         thread = threading.Thread(target=httpd.serve_forever, daemon=True,
                                   name="fleet-gateway")
         thread.start()
@@ -761,6 +874,8 @@ class Gateway:
                 if self._inflight == 0:
                     break
             time.sleep(0.05)
+        if self.slo is not None:
+            self.slo.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -1028,12 +1028,26 @@ class EtaService:
         if batcher is None:
             return None
         rows = np.asarray(rows, np.float32)
+        # Host-side non-finite containment: a NaN/Inf input row (a
+        # client sending "NaN" distances) must neither poison its
+        # batch-mates nor abort the jit under jax_debug_nans — the
+        # device only ever sees finite rows. Bad rows score as a finite
+        # placeholder and their outputs are stamped back to NaN, which
+        # the response layer already serializes as null.
+        bad = ~np.isfinite(rows).all(axis=1)
+        if bad.any():
+            rows = np.where(bad[:, None], np.float32(0.0), rows)
         fl = self._fastlane
         if fl is not None and fl.accepts(len(rows)):
-            return fl.predict(
+            preds = fl.predict(
                 rows, serving.generation,
                 lambda miss: self._submit_chunked(batcher, miss))
-        return self._submit_chunked(batcher, rows)
+        else:
+            preds = self._submit_chunked(batcher, rows)
+        if bad.any() and preds is not None:
+            preds = np.array(preds, np.float64, copy=True)  # never mutate
+            preds[bad] = np.nan                  # a cached/shared buffer
+        return preds
 
     @staticmethod
     def _submit_chunked(batcher: DynamicBatcher,

@@ -304,6 +304,12 @@ class ResilientStore:
         self._m_dropped = reg.counter(
             "rtpu_store_journal_dropped_total",
             "Journaled writes lost to the bound (oldest dropped).")
+        self._m_journaled = reg.counter(
+            "rtpu_store_journal_writes_total",
+            "Writes diverted to the journal (backend unavailable). "
+            "Counts as budget burn for the store-dependency SLO: a "
+            "breaker-open write succeeds locally without erroring, so "
+            "the error counter alone goes quiet mid-outage.")
 
     # ── breaker bookkeeping ───────────────────────────────────────────
 
@@ -331,6 +337,17 @@ class ResilientStore:
             _log.warning("store_breaker_opened", backend=self._inner.kind,
                          failures=self._failures,
                          cooldown_s=self._cooldown_s)
+            # Postmortem trigger: the breaker opening marks the moment
+            # the outage became policy (fail-fast + journal) — capture
+            # the evidence while the offending requests are still in
+            # the recorder/span rings. Rate-limited inside trigger().
+            from routest_tpu.obs.recorder import get_recorder
+
+            get_recorder().trigger("store_breaker_open", {
+                "backend": self._inner.kind,
+                "consecutive_failures": self._failures,
+                "last_error": f"{type(e).__name__}: {e}",
+            })
         else:
             _log.warning("store_error", op=op, backend=self._inner.kind,
                          error=f"{type(e).__name__}: {e}")
@@ -357,6 +374,7 @@ class ResilientStore:
                 self._m_dropped.inc()
             self._journal.append((op, dict(row)))
             depth = len(self._journal)
+        self._m_journaled.inc()
         self._m_journal_depth.set(depth)
         _log.warning("store_write_journaled", op=op, journal_depth=depth)
 

@@ -21,6 +21,7 @@ from werkzeug.exceptions import RequestEntityTooLarge
 from werkzeug.wrappers import Request, Response
 
 from routest_tpu.obs import get_registry
+from routest_tpu.obs.recorder import get_recorder
 from routest_tpu.obs.trace import (REQUEST_ID_RE, mint_request_id,
                                    parse_traceparent, trace_span)
 from routest_tpu.serve.deadline import (DEADLINE_HEADER, DeadlineExceeded,
@@ -150,6 +151,7 @@ class App:
         deadline_ms = parse_deadline_ms(raw_deadline) if raw_deadline else None
         with self._inflight_lock:
             self._inflight += 1
+        t0 = time.perf_counter()
         try:
             with trace_span("replica.request", parent=remote_ctx,
                             method=request.method, path=request.path,
@@ -158,6 +160,16 @@ class App:
                 try:
                     if deadline_ms is not None and deadline_ms <= 0:
                         self._m_expired.inc()
+                        # Edge rejections must count into the per-route
+                        # stats the SLO engine rolls up: a deadline
+                        # storm is an availability incident, and
+                        # skipping the counter here hid it from every
+                        # burn-rate window.
+                        _fn, template, _kw, _al = self._match(
+                            request.method, request.path)
+                        self.request_stats.add(
+                            f"{request.method} {template or request.path}",
+                            0.0, error=True)
                         response = json_response(
                             {"error": "deadline exceeded",
                              "deadline_ms": deadline_ms}, 504)
@@ -177,6 +189,17 @@ class App:
                     response.headers["X-Trace-Id"] = span.trace_id
             response.headers["X-Request-ID"] = rid
             self._apply_cors(request, response)
+            # Flight recorder: one bounded-ring record per completed
+            # request (trace id + status + deadline budget), the raw
+            # material every postmortem bundle is cut from. Streamed
+            # (SSE) responses record at handler return — their body
+            # lifetime is connection time, not request work.
+            get_recorder().record_request(
+                tier="replica", method=request.method, path=request.path,
+                status=response.status_code,
+                duration_ms=(time.perf_counter() - t0) * 1000.0,
+                request_id=rid, trace_id=span.trace_id,
+                deadline_ms=deadline_ms)
             return response(environ, start_response)
         finally:
             with self._inflight_lock:
@@ -307,6 +330,15 @@ def get_json(request: Request, silent: bool = True) -> Optional[dict]:
     cached = getattr(request, "_rtpu_json", _JSON_MISSING)
     if cached is not _JSON_MISSING:
         return cached
+    # Body-limit compat shim: werkzeug < 2.3 does not enforce
+    # max_content_length inside get_data() (it only guards form
+    # parsing), so the declared Content-Length is checked here. On
+    # newer werkzeug get_data() raises the same RequestEntityTooLarge
+    # from inside; both land in _dispatch_matched's clean 413.
+    limit = _max_body_bytes()
+    if request.content_length is not None and \
+            request.content_length > limit:
+        raise RequestEntityTooLarge()
     try:
         raw = request.get_data(as_text=True)
         parsed = json.loads(raw) if raw else None
@@ -341,6 +373,11 @@ def run_with_graceful_shutdown(app: App, host: str, port: int,
     from routest_tpu.utils.logging import get_logger
 
     log = get_logger("routest_tpu.serve.boot")
+    # SIGUSR2 → postmortem bundle (docs/OBSERVABILITY.md trigger table);
+    # main-thread only, which this function already requires.
+    from routest_tpu.obs.recorder import install_sigusr2_trigger
+
+    install_sigusr2_trigger()
     server = make_server(host, port, app, threaded=True)
     stop = threading.Event()
 

@@ -24,6 +24,37 @@ _LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
 _request_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "rtpu_request_id", default=None)
 
+# Trace correlation: every line emitted inside an active span carries
+# the span's trace/span ids automatically, so the flight recorder (and
+# a grep) can pull one request's log lines with no per-call-site
+# changes. The lookup is deferred-imported: obs.trace imports nothing
+# from this module, so this cannot cycle, and utils stays importable
+# without the obs package initialized.
+_trace_context = None
+
+
+def _ambient_span_ids():
+    global _trace_context
+    if _trace_context is None:
+        from routest_tpu.obs.trace import current_context
+
+        _trace_context = current_context
+    return _trace_context()
+
+
+# Log tee: the flight recorder installs a callback here to keep a
+# bounded ring of recent records (dicts, post-stamping). One slot, not
+# a list — there is one process recorder; tests may swap it.
+_tee = None
+
+
+def set_log_tee(fn) -> None:
+    """Install (or clear, with None) the process log tee. ``fn`` gets
+    every record dict AFTER level filtering and id stamping; it must
+    not raise (the recorder's ring append cannot)."""
+    global _tee
+    _tee = fn
+
 
 def set_request_id(rid: Optional[str]):
     """Bind the current context's request id; returns the reset token."""
@@ -59,6 +90,16 @@ class JsonLogger:
         rid = _request_id.get()
         if rid is not None and "request_id" not in record:
             record["request_id"] = rid
+        ctx = _ambient_span_ids()
+        if ctx is not None:
+            # Ids flow even for unsampled traces (same rule the tracer
+            # applies to header propagation): correlation must not
+            # depend on the sampling coin.
+            record.setdefault("trace_id", ctx.trace_id)
+            record.setdefault("span_id", ctx.span_id)
+        tee = _tee
+        if tee is not None:
+            tee(record)
         line = json.dumps(record, default=str)
         with self._lock:
             print(line, file=self._stream, flush=True)

@@ -1,23 +1,27 @@
-"""Observability overhead: tracing off vs sampled vs always-on.
+"""Observability overhead: everything-off vs sampled vs always-on.
 
-The ISSUE-2 acceptance bar is that always-on tracing costs ≤5% on the
-``load_test`` predict_eta p95. This script measures it honestly: three
-identical server subprocesses (the same spawn-and-wait pattern as
-``scripts/load_test.py``), differing ONLY in ``RTPU_OBS_*``:
+The acceptance bar (ISSUE 2, re-pinned by ISSUE 5 with the SLO engine
+and flight recorder in the stack) is that the always-on posture costs
+≤5% on the ``load_test`` predict_eta p95. This script measures it
+honestly: identical server subprocesses (the same spawn-and-wait
+pattern as ``scripts/load_test.py``), differing ONLY in env:
 
-- ``off``       — ``RTPU_OBS_TRACE=0`` (shared no-op spans);
-- ``sampled``   — ``RTPU_OBS_SAMPLE=0.1`` (production default posture);
-- ``always_on`` — ``RTPU_OBS_SAMPLE=1.0`` (every request recorded).
+- ``off``       — tracing, flight recorder, AND SLO engine disabled
+                  (``RTPU_OBS_TRACE=0 RTPU_RECORDER=0 RTPU_SLO=0``);
+- ``sampled``   — trace sampling 0.1, recorder+SLO on (production
+                  default posture);
+- ``always_on`` — trace sampling 1.0, recorder+SLO on (every request
+                  traced, recorded, and rolled into burn rates).
 
 Each mode runs the load_test single-row phase (the per-request-overhead-
-dominated endpoint: tiny payloads, so any tracing cost is maximally
-visible) plus a batch phase, and the report lands in
+dominated endpoint: tiny payloads, so any observability cost is
+maximally visible) plus a batch phase, and the report lands in
 ``artifacts/obs_overhead.json``. On a 1-core host client and server
 time-share, so run-to-run noise of a few percent is expected — the
-artifact records all three absolute numbers, not just the ratio.
+artifact records all absolute numbers, not just the ratio.
 
 Usage: python scripts/bench_obs_overhead.py [--threads 8] [--requests 40]
-       [--out artifacts/obs_overhead.json]
+       [--quick] [--out artifacts/obs_overhead.json]
 """
 
 from __future__ import annotations
@@ -50,9 +54,14 @@ def _free_port() -> int:
 
 
 def _spawn_server(env_overrides: dict) -> tuple:
+    import tempfile
+
     port = _free_port()
     env = dict(os.environ)
-    env.update({"PORT": str(port), "ROUTEST_FORCE_CPU": "1"})
+    env.update({"PORT": str(port), "ROUTEST_FORCE_CPU": "1",
+                # bundles (if any trigger fires mid-bench) go to a
+                # throwaway dir, not the repo's artifacts/
+                "RTPU_RECORDER_DIR": tempfile.mkdtemp(prefix="obs-bench-")})
     env.update(env_overrides)
     proc = subprocess.Popen([sys.executable, "-m", "routest_tpu.serve"],
                             env=env, cwd=REPO)
@@ -76,9 +85,12 @@ def _wait_ready(lt, proc, base: str, timeout: float = 300.0) -> None:
 
 
 MODES = (
-    ("off", {"RTPU_OBS_TRACE": "0"}),
-    ("sampled", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "0.1"}),
-    ("always_on", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "1.0"}),
+    ("off", {"RTPU_OBS_TRACE": "0", "RTPU_RECORDER": "0",
+             "RTPU_SLO": "0"}),
+    ("sampled", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "0.1",
+                 "RTPU_RECORDER": "1", "RTPU_SLO": "1"}),
+    ("always_on", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "1.0",
+                   "RTPU_RECORDER": "1", "RTPU_SLO": "1"}),
 )
 
 
@@ -138,20 +150,47 @@ def main() -> None:
     parser.add_argument("--repeats", type=int, default=3,
                         help="measured phases per mode; best-of-N "
                              "(noise only inflates latency)")
+    parser.add_argument("--quick", action="store_true",
+                        help="smaller phases (CI re-verification)")
     parser.add_argument("--out", default=os.path.join(
         REPO, "artifacts", "obs_overhead.json"))
     args = parser.parse_args()
+    if args.quick:
+        args.threads = min(args.threads, 4)
+        args.requests = min(args.requests, 20)
+        args.batch_size = min(args.batch_size, 1024)
+        args.repeats = min(args.repeats, 2)
 
     lt = _load_load_test()
     results = {}
-    for name, env_overrides in MODES:
-        print(f"[obs_overhead] mode={name} …", file=sys.stderr)
-        results[name] = run_mode(lt, env_overrides, args.threads,
-                                 args.requests, args.batch_size,
-                                 args.repeats)
-        print(f"[obs_overhead] {name}: "
-              f"{json.dumps(results[name].get('predict_eta', {}))}",
-              file=sys.stderr)
+    # Two passes, second in reversed mode order, best-of merged per
+    # mode: a sequential bench drifts (page cache, thermal, background
+    # load), which systematically taxes whichever mode runs LAST —
+    # measured at ~10% on the batch phase. Running both orders and
+    # keeping each mode's best cancels the drift; real overhead
+    # survives both orders.
+    for mode_order in (MODES, tuple(reversed(MODES))):
+        for name, env_overrides in mode_order:
+            print(f"[obs_overhead] mode={name} …", file=sys.stderr)
+            out = run_mode(lt, env_overrides, args.threads,
+                           args.requests, args.batch_size, args.repeats)
+            prev = results.get(name)
+            if prev is not None:
+                out["errors"] += prev["errors"]
+                out["runs"] += prev["runs"]
+                if (prev["predict_eta"].get("p95_ms") or 1e9) < \
+                        (out["predict_eta"].get("p95_ms") or 1e9):
+                    out["predict_eta"] = prev["predict_eta"]
+                    out["rps"] = prev["rps"]
+                pb, ob = (prev.get("predict_eta_batch") or {}), \
+                    (out.get("predict_eta_batch") or {})
+                if (pb.get("preds_per_s") or 0) > \
+                        (ob.get("preds_per_s") or 0):
+                    out["predict_eta_batch"] = pb
+            results[name] = out
+            print(f"[obs_overhead] {name}: "
+                  f"{json.dumps(results[name].get('predict_eta', {}))}",
+                  file=sys.stderr)
 
     def p95(mode: str):
         return results[mode].get("predict_eta", {}).get("p95_ms")

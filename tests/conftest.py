@@ -20,6 +20,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 # through a tmp dir.
 os.environ["ROUTEST_HIER_CACHE"] = "0"
 
+# Flight-recorder bundles (5xx-burst fuzz phases legitimately trip the
+# automatic triggers) go to a throwaway dir, not the repo's artifacts/.
+# setdefault: a test that pins its own dir (tmp_path) still wins.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "RTPU_RECORDER_DIR", tempfile.mkdtemp(prefix="rtpu-postmortems-"))
+
 import jax  # noqa: E402
 
 # The sandbox pins JAX_PLATFORMS=axon (real TPU tunnel); tests must stay

@@ -1,4 +1,5 @@
-"""Static sweep: no silent broad exception swallows under ``serve/``.
+"""Static sweep: no silent broad exception swallows under ``serve/``
+or ``obs/``.
 
 The store used to eat outages with ``except Exception: return False``
 and the bus fell back to in-memory with ``except Exception: pass`` —
@@ -17,9 +18,16 @@ merely mention excepts must not trip it.
 import ast
 import os
 
+import pytest
+
+import routest_tpu.obs
 import routest_tpu.serve
 
 SERVE_ROOT = os.path.dirname(os.path.abspath(routest_tpu.serve.__file__))
+# The recorder's trigger paths run during incidents: a silently
+# swallowed bundle-write failure would erase the postmortem evidence
+# exactly when it matters — same invariant, second tree.
+OBS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.obs.__file__))
 
 BROAD = {"Exception", "BaseException"}
 
@@ -55,15 +63,17 @@ def _offenders(path):
             yield node.lineno
 
 
-def test_no_silent_broad_excepts_under_serve():
+@pytest.mark.parametrize("root", [SERVE_ROOT, OBS_ROOT],
+                         ids=["serve", "obs"])
+def test_no_silent_broad_excepts(root):
     offenders = []
-    for dirpath, dirnames, filenames in os.walk(SERVE_ROOT):
+    for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for name in filenames:
             if not name.endswith(".py"):
                 continue
             path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, SERVE_ROOT)
+            rel = os.path.relpath(path, root)
             offenders.extend(f"{rel}:{line}" for line in _offenders(path))
     assert not offenders, (
         "silent broad except (log a JsonLogger event, count a metric, "

@@ -384,3 +384,108 @@ def test_gateway_metrics_embed_replica_registry(fleet_stack):
     assert "registry" in snap  # gateway's own registry families
     worker = snap["replica_metrics"]["r0"]
     assert "rtpu_batcher_queue_wait_seconds" in worker.get("registry", {})
+
+
+# ── exemplars + trace-stamped logging (ISSUE 5) ──────────────────────
+
+def test_histogram_exemplars_capture_ambient_trace(tracer):
+    reg = MetricsRegistry()
+    h = reg.histogram("exemplar_test_seconds", "t")
+    h.observe(0.003)                       # outside any span: no exemplar
+    with trace_span("exemplar.op") as span:
+        h.observe(0.004)                   # same 0.005 bucket, sampled
+    child = h.labels()
+    exemplars = child.exemplar_list()
+    assert len(exemplars) == 1
+    ex = exemplars[0]
+    assert ex["trace_id"] == span.trace_id
+    assert ex["value"] == 0.004            # most recent wins the bucket
+    assert ex["le"] == 0.005
+    assert ex["unix_ms"] > 1_000_000_000_000
+    # snapshot embeds them on histogram series
+    series = reg.snapshot()["exemplar_test_seconds"]["series"][0]
+    assert series["exemplars"][0]["trace_id"] == span.trace_id
+
+
+def test_histogram_exemplars_skip_unsampled(tracer):
+    configure_tracer(Tracer(enabled=True, sample_rate=0.0))
+    reg = MetricsRegistry()
+    h = reg.histogram("exemplar_unsampled_seconds", "t")
+    with trace_span("unsampled.op"):
+        h.observe(0.004)
+    assert h.labels().exemplar_list() == []
+
+
+def test_jsonlogger_stamps_trace_ids(tracer):
+    """Satellite: every line inside a span carries trace_id/span_id
+    automatically; lines outside carry neither."""
+    import io
+
+    from routest_tpu.utils.logging import JsonLogger
+
+    stream = io.StringIO()
+    log = JsonLogger("stamp-test", stream=stream)
+    log.info("outside_span")
+    with trace_span("logged.op") as span:
+        log.info("inside_span")
+    outside, inside = [json.loads(line)
+                       for line in stream.getvalue().strip().splitlines()]
+    assert "trace_id" not in outside and "span_id" not in outside
+    assert inside["trace_id"] == span.trace_id
+    assert inside["span_id"]  # the ambient span's id, 16 hex chars
+
+
+def test_build_info_gauges():
+    from routest_tpu.obs import register_build_info
+
+    reg = MetricsRegistry()
+    register_build_info(reg)
+    snap = reg.snapshot()
+    info = snap["rtpu_build_info"]["series"][0]
+    assert info["value"] == 1
+    assert info["labels"]["version"]
+    assert info["labels"]["jax"]
+    start = snap["rtpu_process_start_time_seconds"]["series"][0]["value"]
+    assert 0 < start <= time.time()
+
+
+def test_metrics_endpoint_exposes_build_info():
+    app = create_app(Config())
+    try:
+        client = Client(app)
+        r = client.get("/api/metrics?format=prometheus")
+        text = r.get_data(as_text=True)
+        assert "rtpu_build_info{" in text
+        assert "rtpu_process_start_time_seconds" in text
+        body = client.get("/api/metrics").get_json()
+        assert "rtpu_build_info" in body["registry"]
+    finally:
+        if app.slo is not None:
+            app.slo.stop()
+
+
+def test_gateway_slo_endpoint_with_replica_passthrough(fleet_stack):
+    """The gateway answers /api/slo itself (its own burn-rate engine,
+    per-route request families) and ?replicas=1 embeds each worker's
+    state, mirroring the metrics passthrough."""
+    _, base = fleet_stack
+    # one proxied request so the gateway's route families exist
+    req = urllib.request.Request(
+        f"{base}/api/predict_eta", data=b'{"summary": {"distance": 900}}',
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60):
+        pass
+    with urllib.request.urlopen(f"{base}/api/slo?replicas=1",
+                                timeout=30) as r:
+        body = json.loads(r.read())
+    assert body["component"] == "gateway"
+    assert body["state"] in ("ok", "warn", "page")
+    assert "availability:" in "".join(body["objectives"])
+    replica = body["replica_slo"]["r0"]
+    assert replica["component"] == "replica"
+    assert "availability:/api/predict_eta" in replica["objectives"]
+    # per-route gateway families back the engine
+    snap = get_registry().snapshot()
+    routes = [s["labels"]["route"]
+              for s in snap["rtpu_gateway_request_seconds"]["series"]]
+    assert "/api/predict_eta" in routes

@@ -1,0 +1,49 @@
+"""Observability overhead budget (slow): re-runs the bench ``--quick``
+and fails loudly on a breach of the ≤5% p95 budget with the FULL
+always-on posture — tracing at 1.0, flight recorder, and SLO engine
+all live (ISSUE 5 extended the bench with the recorder+SLO modes).
+
+1-core CI hosts time-share client and server, so a guardband above the
+committed artifact's budget absorbs scheduler noise while a real
+regression (a per-request recorder/SLO cost that scales with traffic)
+still trips it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The 5% budget is the artifact-of-record bar (measured best-of-N on a
+# quiet host); the CI guardband tolerates scheduler noise on shared
+# 1-core runners without letting an order-of-magnitude regression pass.
+CI_GUARDBAND_PCT = 15.0
+
+
+@pytest.mark.slow
+def test_obs_overhead_quick_within_budget(tmp_path):
+    out = tmp_path / "obs_overhead.json"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "bench_obs_overhead.py"),
+         "--quick", "--out", str(out)],
+        cwd=REPO, timeout=1500, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    record = json.loads(out.read_text())
+    overhead = record.get("p95_overhead_always_on_pct")
+    assert overhead is not None, record
+    assert overhead <= CI_GUARDBAND_PCT, (
+        f"always-on observability (trace+recorder+SLO) p95 overhead "
+        f"{overhead}% breaches the CI guardband "
+        f"({CI_GUARDBAND_PCT}%; artifact budget is 5%) — "
+        f"{json.dumps(record['modes'], indent=2)[:2000]}")
+
+
+@pytest.mark.slow
+def test_committed_overhead_artifact_within_budget():
+    record = json.load(open(os.path.join(REPO, "artifacts",
+                                         "obs_overhead.json")))
+    assert record["within_5pct_budget"], record

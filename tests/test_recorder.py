@@ -1,0 +1,259 @@
+"""Flight recorder: rings, triggers, bundle format, disk bounds, rate
+limiting, and the serving-layer wiring (wsgi records, store breaker
+trigger, /api/debug/snapshot)."""
+
+import io
+import json
+import os
+import time
+
+from routest_tpu.core.config import Config, RecorderConfig
+from routest_tpu.obs.recorder import (FlightRecorder, configure_recorder,
+                                      get_recorder)
+from routest_tpu.utils.logging import JsonLogger
+
+
+def _cfg(tmp_path, **kw):
+    defaults = dict(dir=str(tmp_path / "pm"), min_interval_s=0.0,
+                    burst_5xx=3, burst_window_s=5.0, deadline_spike=4)
+    defaults.update(kw)
+    return RecorderConfig(**defaults)
+
+
+def _bundles(root):
+    if not os.path.isdir(root):
+        return []
+    return sorted(d for d in os.listdir(root) if d.startswith("pm_"))
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_bundle_contents(tmp_path):
+    rec = FlightRecorder(_cfg(tmp_path))
+    rec.record_request(tier="replica", method="POST", path="/api/x",
+                       status=200, duration_ms=12.5, request_id="rid1",
+                       trace_id="t" * 32, deadline_ms=500.0)
+    rec.add_log({"event": "something_happened", "trace_id": "t" * 32})
+    path = rec.trigger("unit_test", {"why": "test"}, force=True)
+    assert path is not None and os.path.isdir(path)
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["reason"] == "unit_test"
+    assert manifest["detail"] == {"why": "test"}
+    assert manifest["counts"]["requests"] == 1
+    assert manifest["config"]["digest"]
+    # secrets never enter the manifest
+    assert all("SERVICE_ROLE_KEY" not in k or v == "<redacted>"
+               for k, v in manifest["config"]["env"].items())
+    reqs = _read_jsonl(os.path.join(path, "requests.jsonl"))
+    assert reqs[0]["trace_id"] == "t" * 32
+    assert reqs[0]["deadline_ms"] == 500.0
+    logs = _read_jsonl(os.path.join(path, "logs.jsonl"))
+    assert logs[0]["event"] == "something_happened"
+    assert os.path.exists(os.path.join(path, "spans.jsonl"))
+
+
+def test_rate_limit_suppresses_auto_triggers(tmp_path):
+    rec = FlightRecorder(_cfg(tmp_path, min_interval_s=60.0))
+    assert rec.trigger("first") is not None
+    assert rec.trigger("second") is None          # suppressed
+    assert rec.triggers_suppressed == 1
+    assert rec.trigger("manual", force=True) is not None  # bypasses
+    assert len(_bundles(str(tmp_path / "pm"))) == 2
+
+
+def test_disk_bounds_prune_oldest(tmp_path):
+    rec = FlightRecorder(_cfg(tmp_path, max_bundles=3))
+    paths = [rec.trigger(f"r{i}", force=True) for i in range(5)]
+    assert all(paths)
+    left = _bundles(str(tmp_path / "pm"))
+    assert len(left) == 3
+    # newest survive (names sort by UTC stamp)
+    assert os.path.basename(paths[-1]) in left
+    assert os.path.basename(paths[0]) not in left
+
+
+def test_5xx_burst_triggers_bundle(tmp_path):
+    rec = FlightRecorder(_cfg(tmp_path))
+    for i in range(3):
+        rec.record_request(tier="replica", method="POST", path="/api/x",
+                           status=503, duration_ms=1.0,
+                           trace_id=f"trace{i}")
+    bundles = _bundles(str(tmp_path / "pm"))
+    assert len(bundles) == 1
+    assert "5xx_burst" in bundles[0]
+    path = os.path.join(str(tmp_path / "pm"), bundles[0])
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["detail"]["last_trace_id"] == "trace2"
+    reqs = _read_jsonl(os.path.join(path, "requests.jsonl"))
+    assert {r["trace_id"] for r in reqs} == {"trace0", "trace1", "trace2"}
+
+
+def test_deadline_spike_triggers_bundle(tmp_path):
+    rec = FlightRecorder(_cfg(tmp_path, burst_5xx=100))
+    for _ in range(4):
+        rec.record_request(tier="gateway", method="POST", path="/api/x",
+                           status=504, duration_ms=1.0)
+    bundles = _bundles(str(tmp_path / "pm"))
+    assert any("deadline_expiry_spike" in b for b in bundles)
+
+
+def test_disabled_recorder_is_inert(tmp_path):
+    rec = FlightRecorder(_cfg(tmp_path, enabled=False))
+    rec.record_request(tier="replica", method="GET", path="/x",
+                       status=500, duration_ms=1.0)
+    assert rec.trigger("x", force=True) is None
+    assert _bundles(str(tmp_path / "pm")) == []
+
+
+def test_request_ring_is_bounded(tmp_path):
+    rec = FlightRecorder(_cfg(tmp_path, capacity=8))
+    for i in range(20):
+        rec.record_request(tier="replica", method="GET", path=f"/{i}",
+                           status=200, duration_ms=1.0)
+    rows = rec.requests_snapshot()
+    assert len(rows) == 8
+    assert rows[-1]["path"] == "/19"
+
+
+def test_store_breaker_open_triggers_bundle(tmp_path):
+    from routest_tpu.serve.store import ResilientStore
+
+    class DeadStore:
+        kind = "dead"
+
+        def insert_request(self, row):
+            raise ConnectionError("backend down")
+
+        def insert_result(self, row):
+            raise ConnectionError("backend down")
+
+        def ping(self):
+            raise ConnectionError("backend down")
+
+    rec = FlightRecorder(_cfg(tmp_path))
+    configure_recorder(rec)
+    try:
+        store = ResilientStore(DeadStore(), retries=0,
+                               breaker_threshold=2, cooldown_s=30.0)
+        store.insert_request({"x": 1})   # journaled; failure 1
+        store.insert_request({"x": 2})   # journaled; failure 2 → opens
+        bundles = _bundles(str(tmp_path / "pm"))
+        assert len(bundles) == 1
+        assert "store_breaker_open" in bundles[0]
+        manifest = json.load(open(os.path.join(
+            str(tmp_path / "pm"), bundles[0], "manifest.json")))
+        assert manifest["detail"]["backend"] == "dead"
+    finally:
+        configure_recorder(None)
+
+
+def test_wsgi_records_completed_requests(tmp_path):
+    from werkzeug.test import Client
+
+    from routest_tpu.serve.app import create_app
+
+    rec = FlightRecorder(_cfg(tmp_path, burst_5xx=1000))
+    configure_recorder(rec)
+    try:
+        app = create_app(Config())
+        client = Client(app)
+        r = client.post("/api/predict_eta",
+                        json={"summary": {"distance": 9000}})
+        assert r.status_code in (200, 503)
+        rows = [row for row in rec.requests_snapshot()
+                if row["path"] == "/api/predict_eta"]
+        assert rows, "completed request never reached the recorder"
+        row = rows[-1]
+        assert row["tier"] == "replica"
+        assert row["status"] == r.status_code
+        assert row["trace_id"] == r.headers.get("X-Trace-Id")
+        assert row["duration_ms"] > 0
+    finally:
+        configure_recorder(None)
+        if app.slo is not None:
+            app.slo.stop()
+
+
+def test_debug_snapshot_endpoint(tmp_path):
+    from werkzeug.test import Client
+
+    from routest_tpu.serve.app import create_app
+
+    rec = FlightRecorder(_cfg(tmp_path))
+    configure_recorder(rec)
+    try:
+        app = create_app(Config())
+        client = Client(app)
+        r = client.post("/api/debug/snapshot")
+        assert r.status_code == 200
+        body = r.get_json()
+        assert os.path.isdir(body["bundle"])
+        assert body["recorder"]["bundles_written"] == 1
+        # the bundle's request ring includes requests served BEFORE the
+        # trigger (that's the point of an always-on recorder)
+        client.get("/api/ping")
+        r2 = client.post("/api/debug/snapshot")
+        reqs = _read_jsonl(os.path.join(r2.get_json()["bundle"],
+                                        "requests.jsonl"))
+        assert any(row["path"] == "/api/ping" for row in reqs)
+    finally:
+        configure_recorder(None)
+        if app.slo is not None:
+            app.slo.stop()
+
+
+def test_log_tee_feeds_ring_and_bundle(tmp_path):
+    rec = FlightRecorder(_cfg(tmp_path))
+    configure_recorder(rec)
+    try:
+        log = JsonLogger("tee-test", stream=io.StringIO())
+        log.info("correlated_event", key="value")
+        rows = [r for r in rec._logs if r.get("event") == "correlated_event"]
+        assert rows and rows[0]["key"] == "value"
+    finally:
+        configure_recorder(None)
+
+
+def test_slo_page_writes_bundle_with_offender(tmp_path):
+    """The tentpole loop in miniature: 504 storm → SLO page edge →
+    postmortem bundle whose request ring carries the offending trace
+    ids."""
+    from werkzeug.test import Client
+
+    from routest_tpu.serve.app import create_app
+
+    rec = FlightRecorder(_cfg(tmp_path, burst_5xx=10_000,
+                              deadline_spike=10_000))
+    configure_recorder(rec)
+    try:
+        app = create_app(Config())
+        client = Client(app)
+        client.get("/api/slo")               # baseline sample
+        offenders = set()
+        for _ in range(25):
+            r = client.post("/api/predict_eta",
+                            json={"summary": {"distance": 1000}},
+                            headers={"X-Deadline-Ms": "0"})
+            assert r.status_code == 504
+            offenders.add(r.headers.get("X-Trace-Id"))
+        client.get("/api/slo")               # evaluation tick → page
+        deadline = time.time() + 5
+        bundles = []
+        while time.time() < deadline:
+            bundles = [b for b in _bundles(str(tmp_path / "pm"))
+                       if "slo_page" in b]
+            if bundles:
+                break
+            time.sleep(0.05)
+        assert bundles, "SLO page edge never produced a bundle"
+        reqs = _read_jsonl(os.path.join(str(tmp_path / "pm"), bundles[0],
+                                        "requests.jsonl"))
+        recorded = {r.get("trace_id") for r in reqs}
+        assert offenders & recorded, "no offending trace id in bundle"
+    finally:
+        configure_recorder(None)
+        if app.slo is not None:
+            app.slo.stop()

@@ -1,0 +1,270 @@
+"""SLO engine: burn-rate math, the alert state machine, rollup
+sources, spec parsing, and the ``/api/slo`` surface."""
+
+import time
+
+import pytest
+
+from routest_tpu.core.config import Config, SloConfig
+from routest_tpu.obs.registry import MetricsRegistry, get_registry
+from routest_tpu.obs.slo import (OK, PAGE, WARN, SloEngine, SloObjective,
+                                 build_replica_engine,
+                                 histogram_family_rollup,
+                                 parse_objective_spec,
+                                 route_availability_source,
+                                 route_latency_source, snap_threshold)
+from routest_tpu.utils.profiling import RequestStats
+
+
+def _engine(fast=1.0, slow=10.0, page=14.4, warn=6.0, component="test"):
+    return SloEngine(
+        config=SloConfig(tick_s=0.0, fast_window_s=fast, slow_window_s=slow,
+                         page_burn=page, warn_burn=warn),
+        component=component)
+
+
+class _FakeSource:
+    def __init__(self):
+        self.total = 0.0
+        self.bad = 0.0
+
+    def __call__(self):
+        return self.total, self.bad
+
+
+def test_healthy_traffic_stays_ok():
+    eng = _engine()
+    src = _FakeSource()
+    eng.add_objective(SloObjective("a", "availability", 0.999, src))
+    t = 0.0
+    for _ in range(30):
+        src.total += 100
+        t += 0.5
+        eng.tick(now=t)
+    assert eng.worst_state() == OK
+    snap = eng.snapshot()["objectives"]["a"]
+    assert snap["burn_fast"] == 0.0
+    assert snap["error_budget_remaining"] == 1.0
+
+
+def test_error_burst_pages_and_recovers():
+    eng = _engine(fast=2.0, slow=20.0)
+    src = _FakeSource()
+    eng.add_objective(SloObjective("a", "availability", 0.99, src))
+    pages = []
+    eng.on_page.append(lambda name, detail: pages.append((name, detail)))
+    t = 0.0
+    for _ in range(10):          # healthy warmup
+        src.total += 100
+        t += 0.5
+        eng.tick(now=t)
+    assert eng.worst_state() == OK
+    for _ in range(6):           # outage: 50% errors
+        src.total += 100
+        src.bad += 50
+        t += 0.5
+        eng.tick(now=t)
+    assert eng.worst_state() == PAGE
+    assert pages and pages[0][0] == "a"
+    assert pages[0][1]["to"] == PAGE
+    # page edge fires ONCE, not on every tick while paged
+    for _ in range(2):
+        src.total += 100
+        src.bad += 50
+        t += 0.5
+        eng.tick(now=t)
+    assert len(pages) == 1
+    # recovery: healthy traffic clears the fast window, page clears
+    # even while the slow window still remembers the outage
+    for _ in range(8):
+        src.total += 100
+        t += 0.5
+        eng.tick(now=t)
+    assert eng.worst_state() != PAGE
+
+
+def test_page_requires_both_windows():
+    # A burst too short to sustain the slow-window burn must not page
+    # (the multiwindow rationale: fast-only spikes are blips).
+    eng = _engine(fast=1.0, slow=1000.0, page=10.0, warn=1000.0)
+    src = _FakeSource()
+    eng.add_objective(SloObjective("a", "availability", 0.5, src))
+    t = 0.0
+    for _ in range(2000):        # long healthy history
+        src.total += 100
+        t += 0.5
+        eng.tick(now=t)
+    src.total += 100
+    src.bad += 100               # one bad tick: fast burn 2.0/budget=4 …
+    t += 0.5
+    eng.tick(now=t)
+    snap = eng.snapshot()["objectives"]["a"]
+    assert snap["burn_fast"] > snap["burn_slow"]
+    assert eng.worst_state() == OK
+
+
+def test_warn_between_thresholds():
+    eng = _engine(fast=5.0, slow=5.0, page=100.0, warn=2.0)
+    src = _FakeSource()
+    eng.add_objective(SloObjective("a", "availability", 0.9, src))
+    t = 0.0
+    for _ in range(10):
+        src.total += 100
+        src.bad += 30            # 30% errors: burn 3 vs warn 2, page 100
+        t += 0.5
+        eng.tick(now=t)
+    assert eng.worst_state() == WARN
+
+
+def test_source_failure_skips_objective_loudly():
+    eng = _engine()
+
+    def broken():
+        raise RuntimeError("rollup exploded")
+
+    src = _FakeSource()
+    eng.add_objective(SloObjective("broken", "availability", 0.99, broken))
+    eng.add_objective(SloObjective("fine", "availability", 0.99, src))
+    src.total = 100
+    eng.tick(now=1.0)
+    eng.tick(now=2.0)            # must not raise; 'fine' keeps sampling
+    assert eng.snapshot()["objectives"]["fine"]["total"] == 100
+
+
+def test_metrics_exported_on_process_registry():
+    eng = _engine(component="metrics-test")
+    src = _FakeSource()
+    eng.add_objective(SloObjective("m", "availability", 0.99, src))
+    src.total = 10
+    eng.tick(now=1.0)
+    snap = get_registry().snapshot()
+    for family in ("rtpu_slo_alert_state", "rtpu_slo_burn_rate",
+                   "rtpu_slo_error_budget_remaining"):
+        series = snap[family]["series"]
+        assert any(s["labels"].get("component") == "metrics-test"
+                   for s in series), family
+
+
+# ── rollup sources ───────────────────────────────────────────────────
+
+def test_availability_source_rolls_up_routes():
+    stats = RequestStats()
+    stats.add("POST /api/predict_eta", 0.01)
+    stats.add("POST /api/predict_eta", 0.01, error=True)
+    stats.add("POST /api/optimize_route", 0.02)
+    src = route_availability_source(
+        stats.registry, "/api/predict_eta",
+        "request_duration_seconds", "request_errors_total")
+    total, bad = src()
+    assert (total, bad) == (2, 1)
+
+
+def test_latency_source_snaps_threshold_to_bucket():
+    stats = RequestStats()
+    for seconds in (0.001, 0.002, 0.2, 0.4):
+        stats.add("GET /x", seconds)
+    # threshold 150 ms is not a bucket bound: it snaps UP to the 0.25 s
+    # log bucket, so the 0.2 s observation counts as good, 0.4 s as bad.
+    src = route_latency_source(stats.registry, "/x", 0.15,
+                               "request_duration_seconds")
+    total, bad = src()
+    assert total == 4
+    assert bad == 1
+    # an exact bound evaluates at itself; a between value snaps up
+    assert snap_threshold(0.1, (0.05, 0.1, 0.25)) == 0.1
+    assert snap_threshold(0.15, (0.05, 0.1, 0.25)) == 0.25
+
+
+def test_rollup_missing_family_reads_zero():
+    reg = MetricsRegistry()
+    assert histogram_family_rollup(reg, "nope", "") == (0.0, None)
+
+
+# ── spec parsing ─────────────────────────────────────────────────────
+
+def test_parse_objective_spec():
+    objs = parse_objective_spec(
+        "/api/predict_eta:availability=0.995,latency_ms=200;"
+        "/api/optimize_route")
+    assert objs[0]["route"] == "/api/predict_eta"
+    assert objs[0]["availability"] == 0.995
+    assert objs[0]["latency_ms"] == 200
+    assert objs[1]["route"] == "/api/optimize_route"
+    assert objs[1]["availability"] == 0.999  # default
+
+
+def test_parse_objective_spec_skips_malformed():
+    objs = parse_objective_spec(
+        "/api/ok;/api/bad:unknown_key=1;/api/bad2:availability=x;;")
+    assert [o["route"] for o in objs] == ["/api/ok"]
+
+
+def test_duplicate_objective_rejected():
+    eng = _engine()
+    src = _FakeSource()
+    eng.add_objective(SloObjective("dup", "availability", 0.99, src))
+    with pytest.raises(ValueError):
+        eng.add_objective(SloObjective("dup", "availability", 0.99, src))
+
+
+# ── serving surface ──────────────────────────────────────────────────
+
+def test_replica_engine_defaults_and_endpoint():
+    from werkzeug.test import Client
+
+    from routest_tpu.serve.app import create_app
+
+    app = create_app(Config())
+    try:
+        client = Client(app)
+        # drive one real request so the rollup families exist
+        client.post("/api/predict_eta", json={
+            "summary": {"distance": 8000}, "traffic": "Low"})
+        r = client.get("/api/slo")
+        assert r.status_code == 200
+        body = r.get_json()
+        assert body["state"] in (OK, WARN, PAGE)
+        names = set(body["objectives"])
+        assert "availability:/api/predict_eta" in names
+        assert "availability:/api/optimize_route" in names
+        assert "availability:store" in names
+        pe = body["objectives"]["availability:/api/predict_eta"]
+        assert pe["total"] >= 1
+        assert pe["state"] == OK
+    finally:
+        if app.slo is not None:
+            app.slo.stop()
+
+
+def test_replica_pages_on_504_storm():
+    """Deadline-storm detection end to end at the app layer: edge 504s
+    count into the per-route stats, the burn rate crosses page on both
+    windows, and /api/slo reports it."""
+    from werkzeug.test import Client
+
+    from routest_tpu.serve.app import create_app
+
+    app = create_app(Config())
+    try:
+        client = Client(app)
+        client.get("/api/slo")  # baseline sample before the storm
+        for _ in range(25):
+            client.post("/api/predict_eta",
+                        json={"summary": {"distance": 1000}},
+                        headers={"X-Deadline-Ms": "0"})
+        time.sleep(0.05)
+        r = client.get("/api/slo")
+        obj = r.get_json()["objectives"]["availability:/api/predict_eta"]
+        assert obj["bad"] >= 25
+        assert obj["state"] == PAGE
+    finally:
+        if app.slo is not None:
+            app.slo.stop()
+
+
+def test_build_replica_engine_honors_spec(monkeypatch):
+    monkeypatch.setenv("RTPU_SLO_OBJECTIVES",
+                       "/api/custom:availability=0.9,latency_ms=100")
+    eng = build_replica_engine(RequestStats().registry)
+    names = set(eng.snapshot()["objectives"])
+    assert names == {"availability:/api/custom", "latency:/api/custom"}

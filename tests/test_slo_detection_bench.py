@@ -1,0 +1,53 @@
+"""Full SLO-detection run (slow): real fleet, real fault injection.
+
+Tier-1 covers the engine, recorder, and wiring hermetically
+(tests/test_slo.py, tests/test_recorder.py); this exercises the
+composed loop through ``scripts/bench_slo_detection.py --quick`` and
+asserts the ISSUE-5 acceptance invariants: every replayed chaos
+scenario reaches the ``page`` alert state within the slow-window
+bound, and each scenario's postmortem bundle contains the trace id of
+at least one offending request."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_slo_detection_quick(tmp_path):
+    out = tmp_path / "slo_detection.json"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "bench_slo_detection.py"),
+         "--quick", "--out", str(out)],
+        cwd=REPO, timeout=1500, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    record = json.loads(out.read_text())
+    scenarios = record["scenarios"]
+    assert set(scenarios) == {"deadline_storm", "replica_crash",
+                              "device_error_burst", "store_outage"}
+    for name, s in scenarios.items():
+        assert s.get("paged"), (name, s)
+        assert s["time_to_detect_s"] is not None \
+            and s["time_to_detect_s"] <= s["slow_window_bound_s"], (name, s)
+        assert s.get("bundle_has_offender"), (name, s)
+    assert record["all_pass"]
+
+
+@pytest.mark.slow
+def test_committed_artifact_passes():
+    """The committed measurement of record must itself satisfy the
+    acceptance bar (a stale artifact from before a regression would
+    otherwise keep "passing")."""
+    path = os.path.join(REPO, "artifacts", "slo_detection.json")
+    record = json.load(open(path))
+    assert record["all_pass"]
+    for name, s in record["scenarios"].items():
+        assert s["pass"], (name, s)
+        assert s["time_to_detect_s"] <= s["slow_window_bound_s"]
+        assert s["bundle_offending_traces"] >= 1
