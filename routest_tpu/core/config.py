@@ -145,6 +145,47 @@ class FleetConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """SLO-driven fleet autoscaling (``serve/fleet/autoscaler.py``):
+    a control loop that reads gateway pressure (admission-queue depth,
+    per-replica outstanding) and the SLO engine's fast-window burn
+    rate, and actuates ``ReplicaSupervisor.scale_to``-style membership
+    changes through the gateway's dynamic registration. All knobs are
+    ``RTPU_AUTOSCALE_*`` env vars; disabled by default (a fixed fleet
+    stays fixed unless a deploy opts in).
+
+    Scale-up fires when ANY pressure signal (``up_queue_frac`` of the
+    admission queue occupied, mean outstanding per live replica ≥
+    ``up_outstanding``, or worst fast-window burn ≥ ``up_burn``) holds
+    for ``up_stable_ticks`` consecutive ticks outside the up-cooldown.
+    Scale-down requires EVERY quiet signal (no queue, outstanding ≤
+    ``down_outstanding``, burn < ``up_burn``) for ``down_stable_ticks``
+    ticks outside the down-cooldown — asymmetric hysteresis: scaling up
+    is cheap to be wrong about for a minute, scaling down during an
+    incident is not."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    tick_s: float = 1.0
+    # Pressure (scale-up) signals.
+    up_queue_frac: float = 0.25
+    up_outstanding: float = 8.0
+    up_burn: float = 6.0
+    up_stable_ticks: int = 2
+    up_step: int = 1
+    up_cooldown_s: float = 10.0
+    # Quiet (scale-down) signals.
+    down_outstanding: float = 1.0
+    down_stable_ticks: int = 12
+    down_step: int = 1
+    down_cooldown_s: float = 30.0
+    # Actuation bounds.
+    startup_timeout_s: float = 180.0
+    drain_timeout_s: float = 15.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ObsConfig:
     """Observability spine (``routest_tpu/obs``): request tracing +
     unified metrics registry. All knobs are ``RTPU_OBS_*`` env vars.
@@ -246,6 +287,8 @@ class Config:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    autoscale: AutoscaleConfig = dataclasses.field(
+        default_factory=AutoscaleConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
@@ -347,7 +390,8 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         unhealthy_after=_int("RTPU_FLEET_UNHEALTHY_AFTER", 3),
     )
     return Config(mesh=mesh, model=model, train=train, serve=serve,
-                  fleet=fleet, obs=obs, chaos=load_chaos_config(env),
+                  fleet=fleet, autoscale=load_autoscale_config(env),
+                  obs=obs, chaos=load_chaos_config(env),
                   slo=load_slo_config(env),
                   recorder=load_recorder_config(env))
 
@@ -377,6 +421,39 @@ def _env_num(env: Mapping[str, str], name: str, default, cast):
         return cast(raw)
     except ValueError:
         return default
+
+
+def load_autoscale_config(
+        env: Optional[Mapping[str, str]] = None) -> AutoscaleConfig:
+    """Just the autoscaler knobs (read by ``serve/fleet`` bring-up and
+    benches without paying for a full Config build)."""
+    env = dict(env if env is not None else os.environ)
+    return AutoscaleConfig(
+        enabled=env.get("RTPU_AUTOSCALE", "0") == "1",
+        min_replicas=_env_num(env, "RTPU_AUTOSCALE_MIN", 1, int),
+        max_replicas=_env_num(env, "RTPU_AUTOSCALE_MAX", 4, int),
+        tick_s=_env_num(env, "RTPU_AUTOSCALE_TICK_S", 1.0, float),
+        up_queue_frac=_env_num(env, "RTPU_AUTOSCALE_UP_QUEUE_FRAC",
+                               0.25, float),
+        up_outstanding=_env_num(env, "RTPU_AUTOSCALE_UP_OUTSTANDING",
+                                8.0, float),
+        up_burn=_env_num(env, "RTPU_AUTOSCALE_UP_BURN", 6.0, float),
+        up_stable_ticks=_env_num(env, "RTPU_AUTOSCALE_UP_TICKS", 2, int),
+        up_step=_env_num(env, "RTPU_AUTOSCALE_UP_STEP", 1, int),
+        up_cooldown_s=_env_num(env, "RTPU_AUTOSCALE_UP_COOLDOWN_S",
+                               10.0, float),
+        down_outstanding=_env_num(env, "RTPU_AUTOSCALE_DOWN_OUTSTANDING",
+                                  1.0, float),
+        down_stable_ticks=_env_num(env, "RTPU_AUTOSCALE_DOWN_TICKS",
+                                   12, int),
+        down_step=_env_num(env, "RTPU_AUTOSCALE_DOWN_STEP", 1, int),
+        down_cooldown_s=_env_num(env, "RTPU_AUTOSCALE_DOWN_COOLDOWN_S",
+                                 30.0, float),
+        startup_timeout_s=_env_num(env, "RTPU_AUTOSCALE_STARTUP_TIMEOUT_S",
+                                   180.0, float),
+        drain_timeout_s=_env_num(env, "RTPU_AUTOSCALE_DRAIN_TIMEOUT_S",
+                                 15.0, float),
+    )
 
 
 def load_slo_config(env: Optional[Mapping[str, str]] = None) -> SloConfig:

@@ -1,0 +1,227 @@
+"""Workload models: what each scheduled arrival actually sends.
+
+Uniform synthetic traffic (what ``scripts/load_test.py`` generates) is
+the kindest possible workload: every key is unique, so caches never
+help and never mislead, and every route is exercised in proportion to
+nothing in particular. Real traffic is skewed — a few OD pairs carry
+most of the demand (the head of a Zipf distribution), and a serving
+stack's cache/batching behavior under that skew is a different system
+than under uniform keys. This module produces that traffic:
+
+- :class:`ZipfODWorkload` — OD pairs over the Manila extract
+  (``data/locations.SEED_LOCATIONS``), rank-assigned by a seeded
+  permutation and sampled Zipf(s). Each pair maps to ONE exact
+  ``/api/predict_eta`` body (distance from the haversine between the
+  endpoints, context fields derived from the pair index), so repeated
+  draws of a hot pair are byte-identical — precisely what the PR-4
+  content-addressed prediction cache keys on.
+- :class:`MixedWorkload` — a route mix with configurable ratios
+  (predict / request_route / history / batch), each route drawing its
+  bodies from the same seeded streams.
+
+Determinism contract: ``sequence(n)`` for the same ``(model
+parameters, seed)`` returns the same ``n`` requests, independent of
+how many were drawn before — reports can say "identical offered
+traffic" and mean it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from routest_tpu.data.locations import SEED_LOCATIONS
+
+_WEATHER = ("Sunny", "Cloudy", "Stormy", "Windy", "Fog")
+_TRAFFIC = ("Low", "Medium", "High", "Jam")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlannedRequest:
+    """One unit of offered work: enough to send it and to label the
+    result in the report."""
+
+    method: str
+    path: str
+    body: Optional[dict]
+    route: str                  # report label (path sans query/params)
+
+
+def _haversine_m(lat1: float, lon1: float, lat2: float, lon2: float) -> float:
+    r = 6_371_000.0
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = p2 - p1
+    dl = math.radians(lon2 - lon1)
+    a = math.sin(dp / 2) ** 2 + math.cos(p1) * math.cos(p2) \
+        * math.sin(dl / 2) ** 2
+    return 2 * r * math.asin(math.sqrt(a))
+
+
+class ZipfODWorkload:
+    """Zipf-distributed OD pairs → byte-stable ``/api/predict_eta``
+    bodies.
+
+    All ordered pairs over the 21 Manila sites (420) are ranked by a
+    seeded permutation (so rank ≠ geography), then sampled with
+    ``P(rank k) ∝ k^-s``. ``s≈1.1`` gives the textbook "top 1% of keys
+    ≈ one third of traffic" skew; ``s=0`` degrades to uniform."""
+
+    def __init__(self, s: float = 1.1, seed: int = 0,
+                 locations: Sequence[Tuple[str, float, float]] =
+                 SEED_LOCATIONS) -> None:
+        if s < 0:
+            raise ValueError("zipf exponent must be >= 0")
+        self.s = s
+        self.seed = seed
+        self.pairs: List[Tuple[int, int]] = [
+            (i, j) for i in range(len(locations))
+            for j in range(len(locations)) if i != j]
+        self._locations = tuple(locations)
+        rng = np.random.default_rng(seed)
+        self._rank_to_pair = rng.permutation(len(self.pairs))
+        ranks = np.arange(1, len(self.pairs) + 1, dtype=np.float64)
+        pmf = ranks ** -s
+        self._pmf = pmf / pmf.sum()
+
+    def pair_indices(self, n: int, seed_offset: int = 1) -> np.ndarray:
+        """``n`` sampled pair ids (deterministic; a fresh generator per
+        call so the sequence never depends on prior draws)."""
+        rng = np.random.default_rng((self.seed, seed_offset))
+        ranks = rng.choice(len(self.pairs), size=n, p=self._pmf)
+        return self._rank_to_pair[ranks]
+
+    def body_for_pair(self, pair_id: int) -> dict:
+        """The ONE body this pair always produces (byte-stable →
+        cache-keyable). Context fields hash off the pair id, distance
+        is the real haversine between the endpoints."""
+        i, j = self.pairs[int(pair_id)]
+        _, lat1, lon1 = self._locations[i]
+        _, lat2, lon2 = self._locations[j]
+        return {
+            "summary": {"distance": round(_haversine_m(lat1, lon1,
+                                                       lat2, lon2), 1)},
+            "weather": _WEATHER[pair_id % len(_WEATHER)],
+            "traffic": _TRAFFIC[pair_id % len(_TRAFFIC)],
+            "driver_age": 25.0 + (pair_id % 30),
+            "pickup_time": "2026-08-04T18:00:00",
+        }
+
+    def sequence(self, n: int) -> List[PlannedRequest]:
+        return [PlannedRequest("POST", "/api/predict_eta",
+                               self.body_for_pair(p), "/api/predict_eta")
+                for p in self.pair_indices(n)]
+
+    def route_body_for_pair(self, pair_id: int, stops: int = 2) -> dict:
+        """A ``/api/request_route``-shaped body over the same pair
+        vocabulary (source = pair's origin, destinations walk the
+        location list from the pair's target)."""
+        i, j = self.pairs[int(pair_id)]
+        _, lat1, lon1 = self._locations[i]
+        dests = []
+        for k in range(stops):
+            _, lat, lon = self._locations[(j + k) % len(self._locations)]
+            dests.append({"lat": lat, "lon": lon, "payload": 1})
+        return {
+            "source_point": {"lat": lat1, "lon": lon1},
+            "destination_points": dests,
+            "driver_details": {"vehicle_type": "car",
+                               "vehicle_capacity": 100,
+                               "maximum_distance": 300_000},
+            "use_ml_eta": True,
+        }
+
+
+DEFAULT_MIX: Dict[str, float] = {
+    "predict_eta": 0.85,
+    "request_route": 0.05,
+    "history": 0.10,
+}
+
+
+class MixedWorkload:
+    """Route mix with configurable ratios over seeded body streams.
+
+    ``mix`` maps route kind → weight (normalized internally). Kinds:
+    ``predict_eta`` (Zipf OD single rows), ``request_route`` (the
+    routing path over the same OD vocabulary), ``history`` (GET reads),
+    ``predict_eta_batch`` (columnar batches of ``batch_rows`` Zipf
+    rows). SSE streams are long-lived connections, not arrivals — the
+    engine holds those separately (``engine.SseClients``)."""
+
+    KINDS = ("predict_eta", "request_route", "history",
+             "predict_eta_batch", "update_tracker")
+
+    def __init__(self, mix: Optional[Dict[str, float]] = None,
+                 s: float = 1.1, seed: int = 0,
+                 batch_rows: int = 64,
+                 sse_channel: str = "loadgen") -> None:
+        mix = dict(mix if mix is not None else DEFAULT_MIX)
+        unknown = set(mix) - set(self.KINDS)
+        if unknown:
+            raise ValueError(f"unknown workload kinds: {sorted(unknown)}")
+        total = sum(mix.values())
+        if total <= 0:
+            raise ValueError("mix weights must sum to > 0")
+        self.mix = {k: v / total for k, v in mix.items() if v > 0}
+        self.seed = seed
+        self.batch_rows = batch_rows
+        self.sse_channel = sse_channel
+        self.od = ZipfODWorkload(s=s, seed=seed)
+
+    def sequence(self, n: int) -> List[PlannedRequest]:
+        rng = np.random.default_rng((self.seed, 2))
+        kinds = list(self.mix)
+        weights = np.asarray([self.mix[k] for k in kinds])
+        draws = rng.choice(len(kinds), size=n, p=weights)
+        pair_ids = self.od.pair_indices(max(n, 1), seed_offset=3)
+        out: List[PlannedRequest] = []
+        for idx, kind_i in enumerate(draws):
+            kind = kinds[int(kind_i)]
+            pair = int(pair_ids[idx])
+            if kind == "predict_eta":
+                out.append(PlannedRequest(
+                    "POST", "/api/predict_eta",
+                    self.od.body_for_pair(pair), "/api/predict_eta"))
+            elif kind == "request_route":
+                out.append(PlannedRequest(
+                    "POST", "/api/request_route",
+                    self.od.route_body_for_pair(pair),
+                    "/api/request_route"))
+            elif kind == "history":
+                out.append(PlannedRequest(
+                    "GET", "/api/history?limit=10", None, "/api/history"))
+            elif kind == "update_tracker":
+                # Driver-position tick published to the SSE bus on the
+                # workload's channel — what lights up the long-lived
+                # ``engine.SseClients`` subscribers.
+                i, j = self.od.pairs[pair]
+                _, lat1, lon1 = self.od._locations[i]
+                _, lat2, lon2 = self.od._locations[j]
+                out.append(PlannedRequest(
+                    "POST", "/api/update_tracker", {
+                        "route_id": self.sse_channel,
+                        "route": [[lon1, lat1], [lon2, lat2]],
+                        "destinations": [{"lat": lat2, "lon": lon2}],
+                        "driver_name": f"lg-{pair}",
+                        "vehicle_type": "car",
+                        "duration": 600, "distance": 5000, "trips": 1,
+                        "pickup_time": "2026-08-04T18:00:00",
+                    }, "/api/update_tracker"))
+            else:  # predict_eta_batch
+                rows = self.od.pair_indices(self.batch_rows,
+                                            seed_offset=1000 + pair)
+                out.append(PlannedRequest(
+                    "POST", "/api/predict_eta_batch",
+                    {"items": [self.od.body_for_pair(int(r))
+                               for r in rows]},
+                    "/api/predict_eta_batch"))
+        return out
+
+    def describe(self) -> dict:
+        return {"mix": dict(self.mix), "zipf_s": self.od.s,
+                "seed": self.seed, "od_pairs": len(self.od.pairs),
+                "batch_rows": self.batch_rows,
+                "sse_channel": self.sse_channel}

@@ -78,6 +78,10 @@ class FlightRecorder:
         self._requests: Deque[dict] = collections.deque(maxlen=cap)
         self._logs: Deque[dict] = collections.deque(
             maxlen=max(1, self.config.log_capacity))
+        # Operational events (fleet scale decisions, replica joins /
+        # drains): far rarer than requests, but a postmortem without
+        # the fleet-size history around the incident is half a story.
+        self._events: Deque[dict] = collections.deque(maxlen=256)
         self._lock = threading.Lock()
         self._last_bundle_mono = -float("inf")
         # Burst detectors: timestamps of recent server errors / 504s.
@@ -159,6 +163,18 @@ class FlightRecorder:
     def add_log(self, record: dict) -> None:
         """The ``JsonLogger`` tee target: bounded append, never raises."""
         self._logs.append(record)
+
+    def record_event(self, kind: str, detail: Optional[Dict] = None) -> None:
+        """One operational event (autoscale decision, replica join,
+        drain) into the bounded events ring — bundles carry these as
+        ``events.jsonl`` so a postmortem shows the fleet-size history
+        alongside the requests it shaped."""
+        if not self.config.enabled:
+            return
+        rec = {"ts": round(time.time(), 3), "kind": kind}
+        if detail:
+            rec.update(detail)
+        self._events.append(rec)
 
     def on_slo_page(self, slo: str, detail: dict) -> None:
         """SLO engine ``on_page`` adapter: one bundle NOW (the rings as
@@ -270,6 +286,7 @@ class FlightRecorder:
             os.makedirs(path, exist_ok=True)
             requests = list(self._requests)
             logs = list(self._logs)
+            events = list(self._events)
         spans = get_tracer().buffer.snapshot()
         manifest = {
             "reason": reason,
@@ -278,7 +295,7 @@ class FlightRecorder:
             "pid": os.getpid(),
             "config": _config_fingerprint(),
             "counts": {"requests": len(requests), "spans": len(spans),
-                       "logs": len(logs)},
+                       "logs": len(logs), "events": len(events)},
             "registry": get_registry().snapshot(),
             "slo": [engine.snapshot() for engine in self.slo_engines],
             "chaos": _chaos_snapshot(),
@@ -287,7 +304,8 @@ class FlightRecorder:
             json.dump(manifest, f, indent=2, default=str)
         for name, rows in (("requests.jsonl", requests),
                            ("spans.jsonl", spans),
-                           ("logs.jsonl", logs)):
+                           ("logs.jsonl", logs),
+                           ("events.jsonl", events)):
             with open(os.path.join(path, name), "w") as f:
                 for row in rows:
                     f.write(json.dumps(row, default=str) + "\n")
@@ -301,6 +319,7 @@ class FlightRecorder:
                 "enabled": self.config.enabled,
                 "requests_buffered": len(self._requests),
                 "logs_buffered": len(self._logs),
+                "events_buffered": len(self._events),
                 "bundles_written": self.bundles_written,
                 "triggers_suppressed": self.triggers_suppressed,
                 "dir": self._bundle_root(),
@@ -308,6 +327,9 @@ class FlightRecorder:
 
     def requests_snapshot(self) -> List[dict]:
         return list(self._requests)
+
+    def events_snapshot(self) -> List[dict]:
+        return list(self._events)
 
 
 def _active_chaos_points() -> List[str]:

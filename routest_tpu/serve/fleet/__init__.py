@@ -14,15 +14,23 @@ This package is that tier, process-level and stdlib-only:
   consecutive-failure circuit breaker with half-open probing, one
   idempotent retry across replicas, optional p95-delay hedging, and a
   bounded admission queue that degrades overload into fast 429s;
-- ``python -m routest_tpu.serve.fleet`` — wires both up from
-  ``core.config.FleetConfig`` (``RTPU_FLEET_*`` env knobs).
+- ``autoscaler.Autoscaler`` — the SLO-driven control loop over both:
+  reads admission-queue depth, per-replica outstanding, and burn-rate
+  signals, and scales the fleet within bounds with hysteresis and
+  per-direction cooldowns (``RTPU_AUTOSCALE_*`` env knobs; new
+  replicas join via the gateway's half-open probe path, removed ones
+  drain first);
+- ``python -m routest_tpu.serve.fleet`` — wires everything up from
+  ``core.config.FleetConfig`` (``RTPU_FLEET_*`` env knobs;
+  ``RTPU_AUTOSCALE=1`` arms the autoscaler).
 
 Replicas share nothing in-process; cross-replica state (SSE fanout,
 history) rides the same broker/store backends the workers already speak
 (``REDIS_URL``/``SUPABASE_URL``), exactly like ``tests/test_cross_process.py``.
 """
 
+from routest_tpu.serve.fleet.autoscaler import Autoscaler
 from routest_tpu.serve.fleet.gateway import Gateway
 from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
 
-__all__ = ["Gateway", "ReplicaSupervisor"]
+__all__ = ["Autoscaler", "Gateway", "ReplicaSupervisor"]

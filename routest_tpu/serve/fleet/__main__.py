@@ -62,6 +62,17 @@ def main() -> None:
               url=f"http://{fleet.gateway_host}:{fleet.gateway_port}",
               replicas=[f"127.0.0.1:{p}" for p in ports])
 
+    autoscaler = None
+    if config.autoscale.enabled:
+        from routest_tpu.serve.fleet.autoscaler import Autoscaler
+
+        autoscaler = Autoscaler(supervisor, gateway, config.autoscale)
+        autoscaler.start()
+        _log.info("autoscaler_started",
+                  min=config.autoscale.min_replicas,
+                  max=config.autoscale.max_replicas,
+                  tick_s=config.autoscale.tick_s)
+
     stop = threading.Event()
 
     def _term(*_):
@@ -74,6 +85,8 @@ def main() -> None:
     install_sigusr2_trigger()  # SIGUSR2 → gateway postmortem bundle
     stop.wait()
     _log.info("draining")
+    if autoscaler is not None:
+        autoscaler.stop()
     gateway.drain(timeout=30)
     supervisor.drain(timeout=30)
     if broker is not None:

@@ -120,6 +120,10 @@ class _Upstream:
         self.id = rid
         self.host = host
         self.port = port
+        # Draining: scheduled for removal — excluded from routing while
+        # outstanding requests finish (dynamic membership, see
+        # Gateway.remove_replica).
+        self.draining = False
         self.outstanding = 0
         self.consecutive_failures = 0
         self.state = CLOSED
@@ -210,6 +214,14 @@ class Gateway:
         self._m_request_errors = reg.counter(
             "rtpu_gateway_request_errors_total",
             "Gateway responses with status >= 500, by route.", ("route",))
+        self._m_replicas = reg.gauge(
+            "rtpu_fleet_replicas",
+            "Replicas registered with the gateway (draining excluded).")
+        self._m_replicas.set(len(self.replicas))
+        self._next_rid = len(self.replicas)  # monotonic fallback namer
+        # Attached by serve/fleet/autoscaler.py when scaling is on; the
+        # /api/autoscale endpoint reads it.
+        self.autoscaler = None
         register_build_info()
         # SLO engine over the per-route families above; the ticker
         # starts with serve() (a Gateway constructed for one handle()
@@ -265,6 +277,62 @@ class Gateway:
             self._inflight -= 1
             self._cond.notify()
 
+    # ── dynamic membership ────────────────────────────────────────────
+
+    def add_replica(self, host: str, port: int,
+                    rid: Optional[str] = None) -> str:
+        """Register one more upstream at runtime. The newcomer enters
+        in the HALF_OPEN breaker state — the same path a recovered
+        replica takes: ``_pick`` hands it exactly ONE probe request,
+        and only a success admits it to normal rotation, so a worker
+        that answered its startup probe but wedges on real traffic
+        never absorbs a burst. Returns the replica id."""
+        with self._lock:
+            if rid is None:
+                rid = f"r{self._next_rid}"
+                self._next_rid += 1
+            elif rid.startswith("r") and rid[1:].isdigit():
+                self._next_rid = max(self._next_rid, int(rid[1:]) + 1)
+            if any(r.id == rid for r in self.replicas):
+                raise ValueError(f"replica id {rid!r} already registered")
+            up = _Upstream(rid, host, port)
+            up.state = HALF_OPEN
+            up.opened_at = time.time()
+            self.replicas.append(up)
+            live = sum(1 for r in self.replicas if not r.draining)
+        self._m_replicas.set(live)
+        _log.info("replica_registered", replica=rid, host=host, port=port,
+                  replicas=live)
+        return rid
+
+    def remove_replica(self, rid: str, timeout: float = 15.0) -> bool:
+        """Deregister an upstream, draining first: the replica stops
+        receiving new picks immediately, outstanding requests get up to
+        ``timeout`` seconds to finish, then it is dropped (its pooled
+        connections closed). Returns False for an unknown id. Inflight
+        work past the timeout is abandoned to its own fate — the
+        response still flows (the socket lives until ``_forward_once``
+        returns); only the bookkeeping entry is gone."""
+        with self._lock:
+            up = next((r for r in self.replicas if r.id == rid), None)
+            if up is None:
+                return False
+            up.draining = True
+            live = sum(1 for r in self.replicas if not r.draining)
+        self._m_replicas.set(live)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if up.outstanding <= 0:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            drained = up.outstanding <= 0
+            self.replicas = [r for r in self.replicas if r.id != rid]
+        up.drop_conns()
+        _log.info("replica_deregistered", replica=rid, drained=drained)
+        return True
+
     # ── routing + circuit breaker ─────────────────────────────────────
 
     def _pick(self, exclude: Tuple[str, ...] = ()) -> Optional[_Upstream]:
@@ -272,7 +340,7 @@ class Gateway:
         with self._lock:
             candidates = []
             for r in self.replicas:
-                if r.id in exclude:
+                if r.id in exclude or r.draining:
                     continue
                 if r.state == OPEN:
                     if now - r.opened_at >= self.config.cooldown_s:
@@ -627,6 +695,7 @@ class Gateway:
                 replicas[r.id] = {
                     "base": r.base,
                     "state": r.state,
+                    "draining": r.draining,
                     "outstanding": r.outstanding,
                     "requests": r.requests,
                     "errors": r.errors,
@@ -667,7 +736,9 @@ class Gateway:
         """GET ``path`` from every replica → {replica_id: parsed JSON};
         unreachable replicas report the error in place."""
         out = {}
-        for r in self.replicas:
+        with self._lock:
+            replicas = list(self.replicas)   # membership may change
+        for r in replicas:
             try:
                 conn = _fresh_conn(r.host, r.port, timeout=2.0)
                 try:
@@ -716,6 +787,8 @@ class Gateway:
                     return self._trace()
                 if bare == "/api/slo":
                     return self._slo()
+                if bare == "/api/autoscale":
+                    return self._autoscale()
                 if bare == "/api/debug/snapshot" and self.command == "POST":
                     return self._debug_snapshot()
                 length = int(self.headers.get("Content-Length") or 0)
@@ -763,6 +836,17 @@ class Gateway:
                 if "replicas=1" in self.path:
                     payload["replica_slo"] = gw._fetch_replica_json(
                         "/api/slo")
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
+
+            def _autoscale(self):
+                """Autoscaler state (fleet size, pending joins, recent
+                decisions, config) — ``{"enabled": false}`` when no
+                autoscaler is attached."""
+                scaler = gw.autoscaler
+                payload = {"enabled": False} if scaler is None \
+                    else scaler.snapshot()
                 self._respond(200,
                               [("Content-Type", "application/json")],
                               json.dumps(payload, default=str).encode())
@@ -834,7 +918,12 @@ class Gateway:
                     self.send_header("Connection", "close")
                     self.end_headers()
                     while True:
-                        chunk = resp.read(8192)
+                        # read1, not read: read(8192) blocks until the
+                        # full 8 KiB accumulates, which buffers small
+                        # SSE events in the gateway for unbounded time
+                        # on a quiet channel. read1 forwards whatever
+                        # the replica flushed, as soon as it flushed.
+                        chunk = resp.read1(8192)
                         if not chunk:
                             break
                         self.wfile.write(chunk)

@@ -8,18 +8,29 @@ exponential backoff (a worker that keeps dying must not busy-loop the
 host), and resets the backoff once a worker has been up long enough to
 count as stable. ``drain()`` is the SIGTERM path: TERM every child,
 wait, KILL stragglers.
+
+The fleet is **elastic**: ``add_replica``/``remove_replica``/
+``scale_to`` change membership at runtime (the autoscaler's actuators,
+``serve/fleet/autoscaler.py``). Added replicas follow the normal
+spawn/startup-probe path (``wait_port_ready`` is the explicit startup
+probe); removed ones are *retired* — flagged so the monitor never
+restarts them — then SIGTERMed, which the worker's graceful-shutdown
+path turns into drain-then-exit. Replica indices are minted from a
+monotonic counter and never reused, so the ``r<i>`` identity in logs,
+metrics, and the gateway stays unambiguous across scale events.
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
 import time
 import urllib.request
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from routest_tpu.obs import get_registry
 from routest_tpu.utils.logging import get_logger
@@ -39,9 +50,13 @@ def default_worker_command(port: int) -> List[str]:
 class _Replica:
     __slots__ = ("index", "port", "proc", "restarts", "started_at",
                  "next_start_at", "consecutive_crashes", "health_failures",
-                 "last_exit_code", "last_probe_at", "ever_up", "waiting")
+                 "last_exit_code", "last_probe_at", "ever_up", "waiting",
+                 "retired")
 
     def __init__(self, index: int, port: int) -> None:
+        # Set under the supervisor lock when the replica is being
+        # scaled away: the monitor must never restart a retired worker.
+        self.retired = False
         self.index = index
         self.port = port
         self.proc: Optional[subprocess.Popen] = None
@@ -85,6 +100,7 @@ class ReplicaSupervisor:
                  health_path: str = "/up",
                  quiet: bool = True) -> None:
         self._replicas = [_Replica(i, p) for i, p in enumerate(ports)]
+        self._next_index = len(self._replicas)   # monotonic, never reused
         self._command = command or default_worker_command
         self._env = dict(env if env is not None else os.environ)
         self._cwd = cwd
@@ -103,10 +119,15 @@ class ReplicaSupervisor:
 
     @property
     def ports(self) -> List[int]:
-        return [r.port for r in self._replicas]
+        with self._lock:
+            return [r.port for r in self._replicas if not r.retired]
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas if not r.retired)
 
     def start(self) -> None:
-        for r in self._replicas:
+        for r in list(self._replicas):
             self._spawn(r)
         self._thread = threading.Thread(target=self._monitor, daemon=True,
                                         name="fleet-supervisor")
@@ -129,7 +150,9 @@ class ReplicaSupervisor:
     def ready(self, timeout: float = 240.0) -> bool:
         """Block until every replica answers its health probe."""
         deadline = time.time() + timeout
-        for r in self._replicas:
+        with self._lock:
+            replicas = [r for r in self._replicas if not r.retired]
+        for r in replicas:
             while time.time() < deadline and not self._stopping.is_set():
                 if self._probe(r.port):
                     break
@@ -137,6 +160,92 @@ class ReplicaSupervisor:
             else:
                 return False
         return True
+
+    # ── elastic membership ─────────────────────────────────────────────
+
+    @staticmethod
+    def _free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def add_replica(self, port: Optional[int] = None) -> Tuple[int, int]:
+        """Spawn one more worker → ``(index, port)``. The index comes
+        from the monotonic counter (never reused); the port defaults to
+        a fresh OS-assigned one — deterministic ``base_port + i``
+        schemes collide with retired ports still in TIME_WAIT. The
+        caller owns readiness (``wait_port_ready`` is the startup
+        probe); the monitor babysits the new worker like any other."""
+        if port is None:
+            port = self._free_port()
+        with self._lock:
+            r = _Replica(self._next_index, port)
+            self._next_index += 1
+            self._replicas.append(r)
+            self._spawn(r)
+        return r.index, r.port
+
+    def wait_port_ready(self, port: int, timeout: float = 120.0) -> bool:
+        """Startup probe for one replica: poll ``/up`` until it answers
+        (or the supervisor is stopping / the timeout lapses)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline and not self._stopping.is_set():
+            if self._probe(port):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def remove_replica(self, index: int, timeout: float = 20.0) -> bool:
+        """Retire + stop the replica with supervisor index ``index``
+        (drain-then-stop: SIGTERM first — the worker's graceful-
+        shutdown path finishes inflight requests — then SIGKILL past
+        ``timeout``). Returns False for an unknown/already-retired
+        index. Callers that front this replica with a gateway must
+        deregister it there FIRST so no new work routes to it."""
+        with self._lock:
+            r = next((x for x in self._replicas
+                      if x.index == index and not x.retired), None)
+            if r is None:
+                return False
+            r.retired = True        # the monitor must not restart it
+            proc = r.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            except OSError:
+                pass
+        with self._lock:
+            self._replicas = [x for x in self._replicas
+                              if x.index != index]
+        _log.info("replica_retired", index=index, port=r.port)
+        return True
+
+    def scale_to(self, n: int) -> Dict[str, List[Tuple[int, int]]]:
+        """Grow or shrink the fleet to ``n`` workers → ``{"added":
+        [(index, port), …], "removed": [(index, port), …]}``. Shrinking
+        retires the newest replicas first (LIFO keeps long-lived
+        identities stable). Growing spawns; readiness is the caller's
+        to await (``wait_port_ready``)."""
+        n = max(0, int(n))
+        added: List[Tuple[int, int]] = []
+        removed: List[Tuple[int, int]] = []
+        while self.replica_count() < n:
+            added.append(self.add_replica())
+        while self.replica_count() > n:
+            with self._lock:
+                live = [r for r in self._replicas if not r.retired]
+                victim = max(live, key=lambda r: r.index)
+            if not self.remove_replica(victim.index):
+                break
+            removed.append((victim.index, victim.port))
+        return {"added": added, "removed": removed}
 
     def drain(self, timeout: float = 30.0) -> None:
         """Graceful stop: TERM everyone, wait, KILL stragglers."""
@@ -165,11 +274,10 @@ class ReplicaSupervisor:
         Returns True when a live process was killed. A process kill
         cannot be a probability draw inside the victim, so the harness
         actuates it here and the chaos ledger records it."""
-        try:
-            r = self._replicas[index]
-        except IndexError:
-            return False
         with self._lock:
+            r = next((x for x in self._replicas if x.index == index), None)
+            if r is None:
+                return False
             proc = r.proc
             if proc is None or proc.poll() is not None:
                 return False
@@ -211,10 +319,13 @@ class ReplicaSupervisor:
 
     def _monitor(self) -> None:
         while not self._stopping.is_set():
-            for r in self._replicas:
+            with self._lock:
+                replicas = list(self._replicas)   # membership may change
+            for r in replicas:
                 now = time.time()
                 with self._lock:
-                    if self._stopping.is_set() or r.proc is None:
+                    if self._stopping.is_set() or r.proc is None \
+                            or r.retired:
                         continue
                     code = r.proc.poll()
                     if code is not None:
@@ -259,6 +370,8 @@ class ReplicaSupervisor:
         with self._lock:
             out = {}
             for r in self._replicas:
+                if r.retired:
+                    continue
                 alive = r.proc is not None and r.proc.poll() is None
                 out[f"r{r.index}"] = {
                     "port": r.port,

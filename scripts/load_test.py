@@ -9,6 +9,14 @@ Reports RPS and latency percentiles per endpoint, plus the server's own
 
 Usage: python scripts/load_test.py [--threads 32] [--requests 50]
        [--base-url http://host:port]  (target an already-running server)
+
+All phases here are CLOSED-LOOP (each client waits for its response
+before sending again) and their artifacts say so (``"loop":
+"closed"``): under overload they self-throttle and under-report the
+user-visible tail (coordinated omission). ``--open-loop --rate R``
+switches to the ``routest_tpu/loadgen`` engine — a seeded arrival
+schedule fired independently of the server, Zipf-skewed OD keys,
+latency measured from intended send time. See docs/LOADGEN.md.
 """
 
 from __future__ import annotations
@@ -526,6 +534,48 @@ def run_batch_load(bases, n_threads: int, n_requests: int,
     return report, errors
 
 
+def run_open_loop_mode(bases, args):
+    """The ``--open-loop`` path: delegate arrival scheduling to
+    ``routest_tpu/loadgen`` (this script stays the CLI; the engine owns
+    the semantics). Reports CO-correct percentiles plus the fast-lane
+    cache delta the Zipf key skew produced server-side."""
+    from routest_tpu.loadgen import (RateCurve, ZipfODWorkload, cache_delta,
+                                     fetch_metrics, paced_schedule,
+                                     poisson_schedule, run_open_loop,
+                                     summarize)
+
+    curve = RateCurve.constant(args.rate)
+    if args.arrival == "poisson":
+        offsets = poisson_schedule(curve, args.duration, seed=args.seed)
+    else:
+        offsets = paced_schedule(curve, args.duration)
+    workload = ZipfODWorkload(s=args.zipf_s, seed=args.seed)
+    requests = workload.sequence(len(offsets))
+
+    def metrics_all():
+        out = {}
+        for i, base in enumerate(bases):
+            try:
+                out[f"w{i}"] = fetch_metrics(base)
+            except Exception:
+                out[f"w{i}"] = {}
+        return {"replica_metrics": out}
+
+    before = metrics_all()
+    records = run_open_loop(bases, offsets, requests,
+                            workers=args.open_workers)
+    report = summarize(records, args.duration, len(offsets))
+    report.update({
+        "arrival": curve.spec | {"process": args.arrival},
+        "workload": {"kind": "zipf_od", "s": args.zipf_s,
+                     "seed": args.seed, "od_pairs": len(workload.pairs)},
+        "seed": args.seed,
+        "workers": len(bases),
+        "cache": cache_delta(before, metrics_all()),
+    })
+    return report
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--threads", type=int, default=None,
@@ -581,6 +631,29 @@ def main() -> None:
                              "an accelerator backend). Name it for "
                              "one-off runs so the canonical artifacts "
                              "survive")
+    parser.add_argument("--open-loop", action="store_true",
+                        help="open-loop mode via routest_tpu/loadgen: "
+                             "a seeded arrival schedule at --rate rps "
+                             "fired independently of the server, "
+                             "latency from INTENDED send time "
+                             "(coordinated-omission-correct). Replaces "
+                             "the closed-loop phases.")
+    parser.add_argument("--rate", type=float, default=50.0,
+                        help="open-loop offered rate in requests/s")
+    parser.add_argument("--duration", type=float, default=30.0,
+                        help="open-loop run length in seconds")
+    parser.add_argument("--arrival", choices=("poisson", "paced"),
+                        default="poisson",
+                        help="open-loop arrival process (poisson = "
+                             "memoryless users; paced = deterministic)")
+    parser.add_argument("--zipf-s", type=float, default=1.1,
+                        help="open-loop OD-key skew exponent (0 = "
+                             "uniform)")
+    parser.add_argument("--seed", type=int, default=42,
+                        help="open-loop schedule + workload seed (same "
+                             "seed ⇒ identical offered load)")
+    parser.add_argument("--open-workers", type=int, default=64,
+                        help="open-loop sender threads")
     args = parser.parse_args()
     # NB: --cpu configures the SERVER subprocess (via ROUTEST_FORCE_CPU
     # below); the load generator itself never touches jax.
@@ -654,6 +727,28 @@ def main() -> None:
                     sys.exit(2)
                 time.sleep(0.5)
 
+    if args.open_loop:
+        try:
+            report = run_open_loop_mode(bases, args)
+        except BaseException:
+            for p_ in server_procs:
+                p_.terminate()
+            raise
+        report["cpu_count"] = os.cpu_count() or 1
+        print(json.dumps(report, indent=2))
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "artifacts", "load_test_open_loop.json")
+        out_dir = os.path.dirname(out)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"[load_test] open-loop report → {out}", file=sys.stderr)
+        for p_ in server_procs:
+            p_.terminate()
+        sys.exit(1 if report["errors"] else 0)
+
     try:
         cores = os.cpu_count() or 1
         n_threads = args.threads if args.threads else min(32, 8 * cores)
@@ -688,6 +783,11 @@ def main() -> None:
             p_.terminate()
         raise
     report["cpu_count"] = cores
+    # Self-describing measurement regime: every phase above is closed-
+    # loop (clients self-throttle to the server's pace), which under-
+    # reports tails under overload — the open-loop artifact is the one
+    # that binds there (docs/LOADGEN.md).
+    report["loop"] = "closed"
     # TPU-backed servers record to their own artifact so the CPU and
     # accelerator evidence never overwrite each other — and the budgets
     # bind at full strength only there (they are production-host SLOs).

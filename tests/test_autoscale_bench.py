@@ -1,0 +1,74 @@
+"""Full autoscale run (slow): real fleet, open-loop load, live scaling.
+
+Tier-1 covers the policy, membership mechanics, and loadgen invariants
+hermetically (tests/test_fleet_dynamic.py, tests/test_loadgen.py);
+this exercises the composed loop through ``scripts/bench_autoscale.py
+--quick`` and asserts the ISSUE-6 acceptance invariants as DIRECTION
+guardbands (a 1-core CI host proves the control loop, not parallel
+speedup): the scale-up decision lands inside the flash-crowd spike
+window, the fleet returns to min size, shed rate stays bounded, zero
+5xx, the seeded schedule reproduces, and the closed-vs-open comparison
+shows the coordinated-omission gap."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_autoscale_quick(tmp_path):
+    out = tmp_path / "autoscale.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_autoscale.py"),
+         "--quick", "--out", str(out)],
+        cwd=REPO, timeout=1800, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    record = json.loads(out.read_text())
+    scenarios = record["scenarios"]
+    assert set(scenarios) == {"flash_crowd", "diurnal", "closed_vs_open"}
+
+    fc = scenarios["flash_crowd"]
+    # Direction guardbands: up DURING the spike, back down after, shed
+    # bounded, no 5xx, same seed ⇒ same schedule.
+    assert fc["autoscale"]["up_decisions_in_spike_window"] >= 1, fc
+    assert fc["autoscale"]["max_replicas_seen"] >= 2, fc
+    assert fc["autoscale"]["down_decisions"] >= 1, fc
+    assert fc["autoscale"]["final_replicas"] <= 1, fc
+    assert fc["load"]["error_rate"] <= 0.01, fc["load"]
+    assert fc["load"]["shed_rate"] <= 0.35, fc["load"]
+    assert fc["schedule_reproducible"], fc
+    assert fc["slo"]["recovered"], fc["slo"]
+
+    dn = scenarios["diurnal"]
+    assert dn["autoscale"]["up_decisions"] >= 1, dn
+    assert dn["autoscale"]["final_replicas"] <= 1, dn
+    assert dn["load"]["error_rate"] <= 0.01, dn["load"]
+    assert dn["sse"]["connected"] == dn["sse"]["requested"], dn["sse"]
+    assert dn["sse"]["events"] > 0, dn["sse"]
+
+    co = scenarios["closed_vs_open"]
+    assert co["coordinated_omission_p99_gap_x"] is not None, co
+    assert co["coordinated_omission_p99_gap_x"] >= 2.0, co
+
+    assert record["all_pass"]
+
+
+@pytest.mark.slow
+def test_committed_artifact_passes():
+    """The committed measurement of record must itself satisfy the
+    acceptance bar (a stale artifact from before a regression would
+    otherwise keep "passing")."""
+    path = os.path.join(REPO, "artifacts", "autoscale.json")
+    record = json.load(open(path))
+    assert record["all_pass"]
+    fc = record["scenarios"]["flash_crowd"]
+    assert fc["autoscale"]["up_decisions_in_spike_window"] >= 1
+    assert fc["autoscale"]["final_replicas"] <= 1
+    assert fc["schedule_reproducible"]
+    co = record["scenarios"]["closed_vs_open"]
+    assert co["coordinated_omission_p99_gap_x"] >= 2.0

@@ -12,8 +12,26 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from routest_tpu.core import distributed
+
+# Some jaxlib builds ship a CPU backend without cross-process
+# collectives (no Gloo): every multi-process CPU test then dies inside
+# device_put/psum with this exact runtime error. That is a toolchain
+# capability gap, not a regression in core/distributed.py — skip with
+# the reason on the record instead of failing the suite. The message is
+# matched narrowly so a REAL distributed-runtime bug still fails loudly.
+_NO_MULTIPROC_CPU = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _skip_if_backend_cannot(err: str, procs=()) -> None:
+    if _NO_MULTIPROC_CPU in err:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives "
+                    "(no Gloo in this build)")
 
 
 def test_hybrid_mesh_single_process_fallback():
@@ -156,6 +174,7 @@ def test_two_process_data_parallel_train_step():
     outs = []
     for p in procs:
         out, err = p.communicate(timeout=300)
+        _skip_if_backend_cannot(err, procs)
         assert p.returncode == 0, err[-2000:]
         outs.append(out)
     lines = [next(l for l in o.splitlines() if l.startswith("TWOPROC"))
@@ -262,6 +281,7 @@ def _run_elastic_pair(ports_idx, stop_after, ckpt_dir, ports):
     lines = []
     for p in procs:
         out, err = p.communicate(timeout=300)
+        _skip_if_backend_cannot(err, procs)
         assert p.returncode == 0, err[-2000:]
         lines.append(next(l for l in out.splitlines()
                           if l.startswith("ELASTIC")))

@@ -1,0 +1,274 @@
+"""Loadgen invariants: determinism, distribution shape, and the
+coordinated-omission accounting — all hermetic (stub HTTP servers).
+
+The determinism tests ARE the product contract: "same seed ⇒ identical
+schedule and key sequence" is what lets two bench runs claim identical
+offered load, so they assert bit-equality, not statistics.
+"""
+
+import http.server
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from routest_tpu.loadgen import (MixedWorkload, RateCurve, ZipfODWorkload,
+                                 paced_schedule, poisson_schedule,
+                                 run_closed_loop, run_open_loop, summarize,
+                                 timeline, with_burst)
+from routest_tpu.loadgen.report import registry_totals
+
+
+# ── arrival processes ────────────────────────────────────────────────
+
+def test_poisson_schedule_deterministic_and_seed_sensitive():
+    curve = RateCurve.constant(50.0)
+    a = poisson_schedule(curve, 10.0, seed=7)
+    b = poisson_schedule(curve, 10.0, seed=7)
+    c = poisson_schedule(curve, 10.0, seed=8)
+    np.testing.assert_array_equal(a, b)
+    assert not (len(a) == len(c) and (a == c).all())
+    assert (np.diff(a) >= 0).all()          # sorted offsets
+    assert a[0] >= 0 and a[-1] < 10.0
+    # mean rate within sampling noise of the target (±20% at n≈500)
+    assert 0.8 * 500 <= len(a) <= 1.2 * 500
+
+
+def test_paced_schedule_is_exact():
+    sched = paced_schedule(RateCurve.constant(10.0), 5.0)
+    assert len(sched) == 50
+    np.testing.assert_allclose(np.diff(sched), 0.1, rtol=1e-9)
+
+
+def test_flash_crowd_rate_steps():
+    curve = RateCurve.flash_crowd(5.0, 10.0, at_s=10.0, duration_s=5.0)
+    assert curve.rate(9.99) == 5.0
+    assert curve.rate(10.0) == 50.0
+    assert curve.rate(14.99) == 50.0
+    assert curve.rate(15.0) == 5.0
+    assert curve.peak == 50.0
+    sched = poisson_schedule(curve, 20.0, seed=3)
+    in_spike = ((sched >= 10.0) & (sched < 15.0)).sum()
+    outside = len(sched) - in_spike
+    # 5 s at 50 rps ≈ 250 arrivals vs 15 s at 5 rps ≈ 75: the spike
+    # dominates even with Poisson noise.
+    assert in_spike > 2 * outside
+
+
+def test_diurnal_curve_trough_and_crest():
+    curve = RateCurve.diurnal(base=2.0, peak=20.0, period_s=60.0)
+    assert curve.rate(0.0) == pytest.approx(2.0)       # trough at phase
+    assert curve.rate(30.0) == pytest.approx(20.0)     # crest mid-period
+    assert curve.rate(60.0) == pytest.approx(2.0)
+    assert 2.0 <= curve.mean_rate(60.0) <= 20.0
+
+
+def test_steps_curve_and_burst():
+    curve = RateCurve.steps([(0, 4.0), (5, 8.0)])
+    assert curve.rate(4.9) == 4.0 and curve.rate(5.0) == 8.0
+    sched = with_burst(paced_schedule(curve, 10.0), at_s=3.1, n=100)
+    assert (sched == 3.1).sum() == 100
+    assert (np.diff(sched) >= 0).all()
+
+
+def test_rate_curve_validation():
+    with pytest.raises(ValueError):
+        RateCurve.constant(0.0)
+    with pytest.raises(ValueError):
+        RateCurve.flash_crowd(5.0, 0.5, 1.0, 1.0)
+    with pytest.raises(ValueError):
+        RateCurve.steps([(1.0, 5.0)])      # must start at t=0
+
+
+# ── workload models ──────────────────────────────────────────────────
+
+def test_zipf_workload_same_seed_same_sequence():
+    a = ZipfODWorkload(s=1.1, seed=11).sequence(200)
+    b = ZipfODWorkload(s=1.1, seed=11).sequence(200)
+    assert a == b
+    c = ZipfODWorkload(s=1.1, seed=12).sequence(200)
+    assert a != c
+
+
+def test_zipf_skew_concentrates_traffic():
+    w = ZipfODWorkload(s=1.1, seed=0)
+    ids = w.pair_indices(4000)
+    counts = np.bincount(ids, minlength=len(w.pairs))
+    top = np.sort(counts)[::-1]
+    uniform_share = 4000 / len(w.pairs)
+    # The hottest key carries far more than a uniform share; s=0 is
+    # uniform and must NOT concentrate.
+    assert top[0] > 10 * uniform_share
+    flat = np.bincount(ZipfODWorkload(s=0.0, seed=0).pair_indices(4000),
+                       minlength=len(w.pairs))
+    assert np.sort(flat)[::-1][0] < 5 * uniform_share
+
+
+def test_zipf_bodies_are_byte_stable_per_pair():
+    w = ZipfODWorkload(seed=5)
+    body1 = w.body_for_pair(17)
+    body2 = w.body_for_pair(17)
+    assert json.dumps(body1) == json.dumps(body2)
+    assert body1["summary"]["distance"] > 0
+    # distinct pairs → distinct keys (distance differs by geography)
+    assert json.dumps(w.body_for_pair(18)) != json.dumps(body1)
+
+
+def test_mixed_workload_ratios_and_determinism():
+    m = MixedWorkload(mix={"predict_eta": 0.7, "history": 0.2,
+                           "request_route": 0.1}, seed=9)
+    seq = m.sequence(1000)
+    assert seq == MixedWorkload(mix={"predict_eta": 0.7, "history": 0.2,
+                                     "request_route": 0.1},
+                                seed=9).sequence(1000)
+    from collections import Counter
+
+    counts = Counter(r.route for r in seq)
+    assert 600 <= counts["/api/predict_eta"] <= 800
+    assert 120 <= counts["/api/history"] <= 280
+    assert 40 <= counts["/api/request_route"] <= 160
+    for r in seq:
+        if r.route == "/api/history":
+            assert r.method == "GET" and r.body is None
+        else:
+            assert r.method == "POST" and r.body
+
+
+def test_mixed_workload_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown workload kinds"):
+        MixedWorkload(mix={"nope": 1.0})
+
+
+# ── open-loop engine (stub server) ───────────────────────────────────
+
+class _Stub(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code):
+        body = b'{"ok": true}'
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        self._send(200)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        if self.server.delay_s:
+            time.sleep(self.server.delay_s)
+        self._send(self.server.status)
+
+
+def _stub(delay_s=0.0, status=200):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Stub)
+    srv.daemon_threads = True
+    srv.delay_s = delay_s
+    srv.status = status
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_port}"
+
+
+def test_open_loop_latency_measured_from_intended_send():
+    """THE coordinated-omission property: a server stall charges the
+    requests scheduled during it for their full wait, even though the
+    sends themselves happened late. One worker + a slow server forces
+    the backlog; the last arrival's recorded latency must include its
+    whole queueing delay, while its service time stays ~the stall."""
+    srv, base = _stub(delay_s=0.2)
+    try:
+        offsets = np.asarray([0.0, 0.05, 0.10, 0.15])
+        reqs = ZipfODWorkload(seed=1).sequence(4)
+        records = run_open_loop([base], offsets, reqs, workers=1)
+        assert len(records) == 4
+        last = records[-1]
+        # 4 sequential 0.2 s services starting ~t=0 finish ~0.8 s; the
+        # last was SCHEDULED at 0.15 s → ≥ ~0.6 s CO-correct latency.
+        assert last.latency_s > 0.45
+        assert last.service_s < 0.45
+        assert last.send_delay_s > 0.3
+        assert last.latency_s == pytest.approx(
+            last.send_delay_s + last.service_s, abs=0.05)
+    finally:
+        srv.shutdown()
+
+
+def test_open_vs_closed_loop_gap_on_same_stalled_server():
+    srv, base = _stub(delay_s=0.15)
+    try:
+        w = ZipfODWorkload(seed=2)
+        offsets = paced_schedule(RateCurve.constant(20.0), 2.0)
+        open_rep = summarize(
+            run_open_loop([base], offsets, w.sequence(len(offsets)),
+                          workers=2, timeout=10.0),
+            2.0, len(offsets))
+        closed_rep = summarize(
+            run_closed_loop([base], w.sequence(100), workers=2,
+                            duration_s=2.0),
+            2.0, 100, loop="closed")
+        # Offered 20 rps, capacity ~13 rps (2 workers × 0.15 s): the
+        # open-loop p99 must expose the backlog the closed loop hides.
+        assert open_rep["loop"] == "open"
+        assert closed_rep["loop"] == "closed"
+        assert open_rep["latency"]["p99_ms"] \
+            > 2 * closed_rep["latency"]["p99_ms"]
+    finally:
+        srv.shutdown()
+
+
+def test_report_counts_sheds_and_errors_separately():
+    srv, base = _stub(status=429)
+    try:
+        offsets = paced_schedule(RateCurve.constant(40.0), 0.5)
+        reqs = ZipfODWorkload(seed=3).sequence(len(offsets))
+        rep = summarize(run_open_loop([base], offsets, reqs, workers=4),
+                        0.5, len(offsets))
+        assert rep["shed"] == len(offsets) and rep["errors"] == 0
+        assert rep["shed_rate"] == 1.0
+        srv.status = 503
+        rep = summarize(run_open_loop([base], offsets, reqs, workers=4),
+                        0.5, len(offsets))
+        assert rep["errors"] == len(offsets) and rep["shed"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_timeline_buckets_by_scheduled_offset():
+    srv, base = _stub()
+    try:
+        offsets = paced_schedule(RateCurve.constant(10.0), 2.0)
+        reqs = ZipfODWorkload(seed=4).sequence(len(offsets))
+        tl = timeline(run_open_loop([base], offsets, reqs, workers=4),
+                      bucket_s=1.0)
+        assert [b["t"] for b in tl] == [0.0, 1.0]
+        # paced offsets accumulate float error (10 × 0.1 ≈ 0.9999…),
+        # so the boundary arrival may land either side of the bucket
+        # edge — totals are exact, per-bucket within one.
+        assert sum(b["ok"] for b in tl) == 20
+        assert all(9 <= b["ok"] <= 11 for b in tl)
+    finally:
+        srv.shutdown()
+
+
+def test_registry_totals_sums_process_and_replicas():
+    metrics = {
+        "registry": {"rtpu_cache_hits_total": {
+            "type": "counter",
+            "series": [{"labels": {}, "value": 5.0}]}},
+        "replica_metrics": {
+            "r0": {"registry": {"rtpu_cache_hits_total": {
+                "type": "counter",
+                "series": [{"labels": {}, "value": 7.0}]}}},
+            "r1": {"error": "unreachable"},
+        },
+    }
+    got = registry_totals(metrics, ["rtpu_cache_hits_total", "absent"])
+    assert got == {"rtpu_cache_hits_total": 12.0, "absent": 0.0}
