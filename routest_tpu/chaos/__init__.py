@@ -19,6 +19,14 @@ Registered fault points (see docs/ROBUSTNESS.md for the full table):
   ``ReplicaSupervisor.kill_replica`` (a process kill cannot be a
   probability draw inside the victim); recorded here for one unified
   injection ledger
+- ``replica.boot`` and ``replica.boot.<version>`` — supervisor spawn:
+  an ``error``/``drop`` substitutes an argv that exits immediately (the
+  bad-deploy crash loop, deterministic), ``latency`` delays the spawn
+  (slow boot); the per-version point lets a spec doom exactly one
+  rollout's spawns
+- ``model.load``       — serving-artifact load (startup AND hot-swap
+  replacement builds): an injected fault degrades exactly like a
+  corrupt file — load_error set, the old model keeps serving
 
 Three fault kinds per point, each with its own probability:
 

@@ -94,6 +94,15 @@ class ServeConfig:
     # when high (throughput mode). Off = the fixed max_wait_ms window.
     adaptive_wait: bool = True
     min_wait_ms: float = 0.0
+    # Verified hot-swap (docs/ROBUSTNESS.md "Safe change delivery"): a
+    # replacement artifact scores a deterministic golden batch BEFORE
+    # the serving generation flips — non-finite outputs, or a median
+    # absolute divergence from the live model beyond
+    # ``swap_max_divergence`` (output units: ETA minutes), reject the
+    # swap loudly while the old model keeps serving. 0 disables the
+    # divergence bound (the finiteness gate always holds).
+    swap_verify: bool = True
+    swap_max_divergence: float = 240.0
     # External services — all optional; absent ⇒ hermetic in-memory fakes.
     supabase_url: Optional[str] = None
     supabase_service_key: Optional[str] = None
@@ -182,6 +191,45 @@ class AutoscaleConfig:
     down_cooldown_s: float = 30.0
     # Actuation bounds.
     startup_timeout_s: float = 180.0
+    drain_timeout_s: float = 15.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutConfig:
+    """Safe change delivery (``serve/fleet/rollout.py``): canary →
+    bake → promote rollouts with automatic rollback. All knobs are
+    ``RTPU_ROLLOUT_*`` env vars.
+
+    A rollout replaces ``canary_replicas`` workers with the new version
+    (retire → SIGTERM-drain → spawn → startup probe → health gate →
+    half-open gateway join), routes ``canary_fraction`` of traffic to
+    the canary cohort for ``bake_s``, and compares canary-vs-baseline
+    error rate and latency through the SLO engine's windowed rollups
+    over the version-labeled gateway request families. Rollback fires
+    on a boot crash loop (``crash_restarts`` supervisor restarts before
+    the startup probe answers), an artifact-verification failure (the
+    canary's ``/api/health`` model check is not ``ok``), a canary error
+    rate above ``max(max_error_rate, max_error_ratio × baseline)``, a
+    canary over-``latency_threshold_ms`` fraction exceeding baseline's
+    by ``max_latency_regression``, or any fleet-wide SLO page edge
+    during the bake — each one restores the previous version and writes
+    a flight-recorder bundle naming the offending version."""
+
+    canary_fraction: float = 0.25
+    canary_replicas: int = 1
+    bake_s: float = 30.0
+    tick_s: float = 0.5
+    max_unavailable: int = 1
+    # Comparison gates (the bake verdict needs evidence first).
+    min_canary_requests: int = 20
+    max_error_rate: float = 0.05
+    max_error_ratio: float = 3.0
+    latency_threshold_ms: float = 1500.0
+    max_latency_regression: float = 0.25
+    # Boot/verify gates for each replaced replica.
+    crash_restarts: int = 2
+    boot_timeout_s: float = 120.0
+    health_timeout_s: float = 20.0
     drain_timeout_s: float = 15.0
 
 
@@ -289,6 +337,8 @@ class Config:
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     autoscale: AutoscaleConfig = dataclasses.field(
         default_factory=AutoscaleConfig)
+    rollout: RolloutConfig = dataclasses.field(
+        default_factory=RolloutConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
@@ -357,6 +407,8 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         fastlane_max_rows=_int("RTPU_FASTLANE_MAX_ROWS", 1024),
         adaptive_wait=env.get("RTPU_FASTLANE_ADAPTIVE", "1") != "0",
         min_wait_ms=_float("RTPU_FASTLANE_MIN_WAIT_MS", 0.0),
+        swap_verify=env.get("RTPU_SWAP_VERIFY", "1") != "0",
+        swap_max_divergence=_float_tolerant("RTPU_SWAP_MAX_DIV", 240.0),
         supabase_url=env.get("SUPABASE_URL"),
         supabase_service_key=env.get("SUPABASE_SERVICE_ROLE_KEY"),
         redis_url=env.get("REDIS_URL"),
@@ -391,6 +443,7 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     )
     return Config(mesh=mesh, model=model, train=train, serve=serve,
                   fleet=fleet, autoscale=load_autoscale_config(env),
+                  rollout=load_rollout_config(env),
                   obs=obs, chaos=load_chaos_config(env),
                   slo=load_slo_config(env),
                   recorder=load_recorder_config(env))
@@ -452,6 +505,40 @@ def load_autoscale_config(
         startup_timeout_s=_env_num(env, "RTPU_AUTOSCALE_STARTUP_TIMEOUT_S",
                                    180.0, float),
         drain_timeout_s=_env_num(env, "RTPU_AUTOSCALE_DRAIN_TIMEOUT_S",
+                                 15.0, float),
+    )
+
+
+def load_rollout_config(
+        env: Optional[Mapping[str, str]] = None) -> RolloutConfig:
+    """Just the change-delivery knobs (read by ``serve/fleet/rollout.py``
+    and benches without paying for a full Config build)."""
+    env = dict(env if env is not None else os.environ)
+    return RolloutConfig(
+        canary_fraction=_env_num(env, "RTPU_ROLLOUT_CANARY_FRACTION",
+                                 0.25, float),
+        canary_replicas=_env_num(env, "RTPU_ROLLOUT_CANARY_REPLICAS",
+                                 1, int),
+        bake_s=_env_num(env, "RTPU_ROLLOUT_BAKE_S", 30.0, float),
+        tick_s=_env_num(env, "RTPU_ROLLOUT_TICK_S", 0.5, float),
+        max_unavailable=_env_num(env, "RTPU_ROLLOUT_MAX_UNAVAILABLE",
+                                 1, int),
+        min_canary_requests=_env_num(env, "RTPU_ROLLOUT_MIN_REQUESTS",
+                                     20, int),
+        max_error_rate=_env_num(env, "RTPU_ROLLOUT_MAX_ERROR_RATE",
+                                0.05, float),
+        max_error_ratio=_env_num(env, "RTPU_ROLLOUT_MAX_ERROR_RATIO",
+                                 3.0, float),
+        latency_threshold_ms=_env_num(env, "RTPU_ROLLOUT_LATENCY_MS",
+                                      1500.0, float),
+        max_latency_regression=_env_num(
+            env, "RTPU_ROLLOUT_MAX_LATENCY_REGRESSION", 0.25, float),
+        crash_restarts=_env_num(env, "RTPU_ROLLOUT_CRASH_RESTARTS", 2, int),
+        boot_timeout_s=_env_num(env, "RTPU_ROLLOUT_BOOT_TIMEOUT_S",
+                                120.0, float),
+        health_timeout_s=_env_num(env, "RTPU_ROLLOUT_HEALTH_TIMEOUT_S",
+                                  20.0, float),
+        drain_timeout_s=_env_num(env, "RTPU_ROLLOUT_DRAIN_TIMEOUT_S",
                                  15.0, float),
     )
 

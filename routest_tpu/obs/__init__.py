@@ -26,8 +26,8 @@ two contextvar operations; a disabled tracer is a shared no-op.
 from routest_tpu.obs.export import (SpanBuffer, to_chrome_trace,  # noqa: F401
                                     to_jsonl)
 from routest_tpu.obs.registry import (DEFAULT_TIME_BUCKETS,  # noqa: F401
-                                      MetricsRegistry, get_registry,
-                                      register_build_info)
+                                      MetricsRegistry, build_info,
+                                      get_registry, register_build_info)
 from routest_tpu.obs.trace import (CURRENT, REQUEST_ID_RE,  # noqa: F401
                                    Span, SpanContext, Tracer,
                                    configure_tracer, current_context,

@@ -361,13 +361,10 @@ def _git_sha() -> str:
         return "unknown"
 
 
-def register_build_info(registry: Optional[MetricsRegistry] = None) -> None:
-    """Register the standard identity gauges on ``registry`` (default:
-    the process registry): ``rtpu_build_info`` — constant 1 with
-    version/jax/git-sha labels, the Prometheus ``*_build_info``
-    convention — and ``rtpu_process_start_time_seconds``. Idempotent;
-    called from serving bring-up on both tiers."""
-    reg = registry if registry is not None else _default_registry
+def build_info() -> Dict[str, str]:
+    """The ``rtpu_build_info`` identity labels as a plain dict —
+    shared by the metric registration below and JSON surfaces that
+    report build identity (``/api/version``, rollout records)."""
     try:
         from routest_tpu import __version__ as version
     except ImportError:  # pragma: no cover - package always has one
@@ -378,11 +375,21 @@ def register_build_info(registry: Optional[MetricsRegistry] = None) -> None:
         jax_version = jax.__version__
     except ImportError:
         jax_version = "absent"
+    return {"version": version, "jax": jax_version, "git_sha": _git_sha()}
+
+
+def register_build_info(registry: Optional[MetricsRegistry] = None) -> None:
+    """Register the standard identity gauges on ``registry`` (default:
+    the process registry): ``rtpu_build_info`` — constant 1 with
+    version/jax/git-sha labels, the Prometheus ``*_build_info``
+    convention — and ``rtpu_process_start_time_seconds``. Idempotent;
+    called from serving bring-up on both tiers."""
+    reg = registry if registry is not None else _default_registry
     reg.gauge(
         "rtpu_build_info",
         "Build identity: constant 1, carried in the labels.",
         ("version", "jax", "git_sha"),
-    ).labels(version=version, jax=jax_version, git_sha=_git_sha()).set(1)
+    ).labels(**build_info()).set(1)
     reg.gauge(
         "rtpu_process_start_time_seconds",
         "Unix time this process imported the metrics registry.",

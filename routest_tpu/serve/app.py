@@ -609,6 +609,30 @@ def create_app(config: Optional[Config] = None,
         # plain HTTP 200, no body contract beyond "the app is up".
         return Response(b"OK", mimetype="text/html")
 
+    @app.route("/api/version", methods=("GET",))
+    def version_info(request):
+        # Change-delivery identity (docs/ROBUSTNESS.md "Safe change
+        # delivery"): which build and which model BYTES this replica is
+        # serving, cheap enough to poll — the rollout controller's
+        # version-skew view and the gateway's /api/autoscale `versions`
+        # section read it.
+        from routest_tpu.obs import build_info
+
+        eta = state.eta
+        return {
+            "version_label": os.environ.get("RTPU_VERSION"),
+            "build": build_info(),
+            "model": {
+                "available": eta.available,
+                "generation": eta.generation,
+                "fingerprint": eta.fingerprint,
+                "path": eta.model_path,
+                "kernel": eta.kernel,
+                "quantiles": list(eta.quantiles),
+                "loaded_unix": eta.loaded_unix,
+            },
+        }, 200
+
     @app.route("/api/metrics", methods=("GET",))
     def metrics(request):
         # TPU-era observability (SURVEY.md §5.5): per-route latency
@@ -721,6 +745,8 @@ def create_app(config: Optional[Config] = None,
                 **r.solver_info,
             }
         model_res = {"status": "ok" if state.eta.available else "degraded",
+                     "generation": state.eta.generation,
+                     "fingerprint": state.eta.fingerprint,
                      **({"error": state.eta.load_error}
                         if state.eta.load_error else {})}
 

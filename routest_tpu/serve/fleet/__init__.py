@@ -20,6 +20,13 @@ This package is that tier, process-level and stdlib-only:
   per-direction cooldowns (``RTPU_AUTOSCALE_*`` env knobs; new
   replicas join via the gateway's half-open probe path, removed ones
   drain first);
+- ``rollout.RolloutController`` — safe change delivery over both: a
+  canary → bake → promote state machine with verified replica
+  replacement (drain, boot crash-loop watch, ``/api/health`` model
+  gate, half-open join), SLO-engine canary-vs-baseline comparison over
+  version-labeled request families, and automatic rollback that writes
+  a flight-recorder bundle naming the offending version
+  (``RTPU_ROLLOUT_*`` env knobs; ``GET/POST /api/rollout``);
 - ``python -m routest_tpu.serve.fleet`` — wires everything up from
   ``core.config.FleetConfig`` (``RTPU_FLEET_*`` env knobs;
   ``RTPU_AUTOSCALE=1`` arms the autoscaler).
@@ -31,6 +38,9 @@ history) rides the same broker/store backends the workers already speak
 
 from routest_tpu.serve.fleet.autoscaler import Autoscaler
 from routest_tpu.serve.fleet.gateway import Gateway
+from routest_tpu.serve.fleet.rollout import (RolloutController,
+                                             rolling_restart)
 from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
 
-__all__ = ["Autoscaler", "Gateway", "ReplicaSupervisor"]
+__all__ = ["Autoscaler", "Gateway", "ReplicaSupervisor",
+           "RolloutController", "rolling_restart"]

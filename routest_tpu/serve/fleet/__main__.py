@@ -41,13 +41,16 @@ def main() -> None:
         env["REDIS_URL"] = f"tcp://127.0.0.1:{broker.port}"
         _log.info("sse_broker_started", url=env["REDIS_URL"])
 
+    # Version label for the boot fleet (rollouts replace it per-replica;
+    # RTPU_VERSION names what THIS deploy is serving).
+    version = env.get("RTPU_VERSION") or None
     supervisor = ReplicaSupervisor(
         ports, env=env,
         probe_interval_s=fleet.probe_interval_s,
         unhealthy_after=fleet.unhealthy_after,
         backoff_base_s=fleet.backoff_base_s,
         backoff_cap_s=fleet.backoff_cap_s,
-        quiet=False)
+        quiet=False, version=version)
     supervisor.start()
     _log.info("supervising", replicas=n, ports=ports)
     if not supervisor.ready(timeout=300):
@@ -56,11 +59,20 @@ def main() -> None:
         sys.exit(2)
 
     gateway = Gateway([("127.0.0.1", p) for p in ports], fleet,
-                      supervisor=supervisor)
+                      supervisor=supervisor, version=version)
     gateway.serve(fleet.gateway_host, fleet.gateway_port)
     _log.info("gateway_up",
               url=f"http://{fleet.gateway_host}:{fleet.gateway_port}",
               replicas=[f"127.0.0.1:{p}" for p in ports])
+
+    # Change-delivery surface: always attached (idle until a rollout is
+    # started via POST /api/rollout or an embedding harness).
+    from routest_tpu.serve.fleet.rollout import RolloutController
+
+    rollout = RolloutController(supervisor, gateway, config.rollout)
+    _log.info("rollout_controller_attached",
+              canary_fraction=config.rollout.canary_fraction,
+              bake_s=config.rollout.bake_s)
 
     autoscaler = None
     if config.autoscale.enabled:
@@ -85,6 +97,9 @@ def main() -> None:
     install_sigusr2_trigger()  # SIGUSR2 → gateway postmortem bundle
     stop.wait()
     _log.info("draining")
+    if rollout.active():
+        rollout.abort("fleet_shutdown")
+        rollout.wait(timeout=60)
     if autoscaler is not None:
         autoscaler.stop()
     gateway.drain(timeout=30)

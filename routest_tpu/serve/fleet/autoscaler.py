@@ -91,6 +91,7 @@ class Autoscaler:
         self._pending: List[_Pending] = []
         self._up_ticks = 0
         self._down_ticks = 0
+        self._rollout_hold = False
         self._last_up = -float("inf")
         self._last_down = -float("inf")
         self._lock = threading.Lock()
@@ -237,8 +238,11 @@ class Autoscaler:
             pending = list(self._pending)
         for p in pending:
             if self.supervisor._probe(p.port):
+                status = self.supervisor.replica_status(p.index) or {}
                 rid = self.gateway.add_replica("127.0.0.1", p.port,
-                                               rid=f"r{p.index}")
+                                               rid=f"r{p.index}",
+                                               version=status.get(
+                                                   "version"))
                 with self._lock:
                     self._pending = [x for x in self._pending
                                      if x.index != p.index]
@@ -268,6 +272,22 @@ class Autoscaler:
     def tick(self) -> Optional[str]:
         """One control iteration; returns the actuated direction (for
         tests/benches polling the loop synchronously)."""
+        # Change delivery owns the fleet while a rollout is in flight:
+        # membership churn would corrupt the canary/baseline cohorts
+        # and race the drain sequences, so the controller HOLDS —
+        # hysteresis resets, one history note per rollout. (No scale
+        # decisions mid-rollout; the rollout's own bake comparison is
+        # the safety valve meanwhile.)
+        rollout = getattr(self.gateway, "rollout", None)
+        if rollout is not None and rollout.active():
+            self._up_ticks = 0
+            self._down_ticks = 0
+            if not self._rollout_hold:
+                self._rollout_hold = True
+                self._note({"direction": "hold",
+                            "reason": "rollout_active"})
+            return None
+        self._rollout_hold = False
         self._admit_pending()
         sig = self.read_signals()
         decision = self.decide(sig)

@@ -67,7 +67,7 @@ _HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
 _ROUTE_LABELS = _IDEMPOTENT_POST | {
     "/api/optimize_route", "/api/optimize_route_batch", "/api/history",
     "/api/update_tracker", "/api/confirm_route", "/api/health",
-    "/api/locations", "/api/ping", "/up",
+    "/api/locations", "/api/ping", "/api/version", "/up",
 }
 
 
@@ -116,10 +116,15 @@ class _Upstream:
     """One replica as the gateway sees it: outstanding-request gauge,
     circuit breaker, connection pool, counters."""
 
-    def __init__(self, rid: str, host: str, port: int) -> None:
+    def __init__(self, rid: str, host: str, port: int,
+                 version: Optional[str] = None) -> None:
         self.id = rid
         self.host = host
         self.port = port
+        # Version label (rollout/canary cohort identity): stamps the
+        # version-labeled per-route request families so canary and
+        # baseline are separately observable. None = "unversioned".
+        self.version = version
         # Draining: scheduled for removal — excluded from routing while
         # outstanding requests finish (dynamic membership, see
         # Gateway.remove_replica).
@@ -164,10 +169,10 @@ class _Upstream:
 class Gateway:
     def __init__(self, targets: Sequence[Tuple[str, int]],
                  config: Optional[FleetConfig] = None,
-                 supervisor=None) -> None:
+                 supervisor=None, version: Optional[str] = None) -> None:
         self.config = config or FleetConfig()
         self.supervisor = supervisor
-        self.replicas = [_Upstream(f"r{i}", host, port)
+        self.replicas = [_Upstream(f"r{i}", host, port, version=version)
                          for i, (host, port) in enumerate(targets)]
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -214,14 +219,47 @@ class Gateway:
         self._m_request_errors = reg.counter(
             "rtpu_gateway_request_errors_total",
             "Gateway responses with status >= 500, by route.", ("route",))
+        # Version-labeled per-route families: the SAME client-observed
+        # measurements as above, additionally keyed by the serving
+        # version of the replica that answered — the rollout
+        # controller's canary-vs-baseline comparison source. Kept
+        # separate from the unversioned families so the gateway SLO
+        # engine's rollups (and their dashboards) are untouched by
+        # rollouts. Label cardinality is operator-bounded: versions
+        # exist only when a rollout names one.
+        self._m_vrequests = reg.histogram(
+            "rtpu_gateway_version_request_seconds",
+            "Gateway request latency by route and serving version "
+            "(replica-answered requests only).", ("route", "version"))
+        self._m_vrequest_errors = reg.counter(
+            "rtpu_gateway_version_request_errors_total",
+            "Gateway responses with status >= 500, by route and "
+            "serving version.", ("route", "version"))
         self._m_replicas = reg.gauge(
             "rtpu_fleet_replicas",
             "Replicas registered with the gateway (draining excluded).")
         self._m_replicas.set(len(self.replicas))
+        self._m_canary_fraction = reg.gauge(
+            "rtpu_gateway_canary_fraction",
+            "Traffic fraction routed to the canary cohort (0 = none).")
         self._next_rid = len(self.replicas)  # monotonic fallback namer
+        # rid → version label, append-only (a drained replica's id never
+        # comes back, and late responses must still attribute to the
+        # version that served them).
+        self._version_by_rid: Dict[str, Optional[str]] = {
+            r.id: r.version for r in self.replicas}
+        # Canary routing state (set_canary/clear_canary): while a
+        # rollout bakes, ``_pick`` splits traffic between the canary
+        # and baseline cohorts by an exact credit counter.
+        self._canary_rids: frozenset = frozenset()
+        self._canary_fraction = 0.0
+        self._canary_credit = 0.0
         # Attached by serve/fleet/autoscaler.py when scaling is on; the
         # /api/autoscale endpoint reads it.
         self.autoscaler = None
+        # Attached by serve/fleet/rollout.py; /api/rollout reads it and
+        # the autoscaler holds while it is active.
+        self.rollout = None
         register_build_info()
         # SLO engine over the per-route families above; the ticker
         # starts with serve() (a Gateway constructed for one handle()
@@ -280,7 +318,8 @@ class Gateway:
     # ── dynamic membership ────────────────────────────────────────────
 
     def add_replica(self, host: str, port: int,
-                    rid: Optional[str] = None) -> str:
+                    rid: Optional[str] = None,
+                    version: Optional[str] = None) -> str:
         """Register one more upstream at runtime. The newcomer enters
         in the HALF_OPEN breaker state — the same path a recovered
         replica takes: ``_pick`` hands it exactly ONE probe request,
@@ -295,15 +334,44 @@ class Gateway:
                 self._next_rid = max(self._next_rid, int(rid[1:]) + 1)
             if any(r.id == rid for r in self.replicas):
                 raise ValueError(f"replica id {rid!r} already registered")
-            up = _Upstream(rid, host, port)
+            up = _Upstream(rid, host, port, version=version)
             up.state = HALF_OPEN
             up.opened_at = time.time()
             self.replicas.append(up)
+            self._version_by_rid[rid] = version
             live = sum(1 for r in self.replicas if not r.draining)
         self._m_replicas.set(live)
         _log.info("replica_registered", replica=rid, host=host, port=port,
-                  replicas=live)
+                  version=version, replicas=live)
         return rid
+
+    # ── canary routing ────────────────────────────────────────────────
+
+    def set_canary(self, rids, fraction: float) -> None:
+        """Route ``fraction`` of picks to the ``rids`` cohort (the
+        rollout controller's bake phase). The split is an exact credit
+        counter, not a probability draw — 0.25 means every 4th pick,
+        deterministically, so a short bake still offers the canary a
+        predictable sample and the blast radius of a bad version is
+        bounded to the fraction by construction."""
+        fraction = min(1.0, max(0.0, float(fraction)))
+        with self._lock:
+            self._canary_rids = frozenset(rids)
+            self._canary_fraction = fraction
+            self._canary_credit = 0.0
+        self._m_canary_fraction.set(fraction)
+        _log.info("canary_routing_set", rids=sorted(self._canary_rids),
+                  fraction=fraction)
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            was = bool(self._canary_rids)
+            self._canary_rids = frozenset()
+            self._canary_fraction = 0.0
+            self._canary_credit = 0.0
+        self._m_canary_fraction.set(0.0)
+        if was:
+            _log.info("canary_routing_cleared")
 
     def remove_replica(self, rid: str, timeout: float = 15.0) -> bool:
         """Deregister an upstream, draining first: the replica stops
@@ -352,6 +420,22 @@ class Gateway:
                 candidates.append(r)
             if not candidates:
                 return None
+            # Canary split: when both cohorts can serve, the credit
+            # counter sends exactly the configured fraction of picks to
+            # the canary set (retries/hedges that excluded every member
+            # of one cohort fall through to the other naturally).
+            if self._canary_rids and self._canary_fraction > 0.0:
+                canary = [r for r in candidates
+                          if r.id in self._canary_rids]
+                baseline = [r for r in candidates
+                            if r.id not in self._canary_rids]
+                if canary and baseline:
+                    self._canary_credit += self._canary_fraction
+                    if self._canary_credit >= 1.0:
+                        self._canary_credit -= 1.0
+                        candidates = canary
+                    else:
+                        candidates = baseline
             self._rr += 1
             # A half-open replica that is due its probe takes priority
             # for exactly ONE request (probe_inflight gates the rest) —
@@ -495,13 +579,28 @@ class Gateway:
         self._m_requests.labels(route=route).observe(seconds)
         if status >= 500:
             self._m_request_errors.labels(route=route).inc()
-        rid = trace_id = None
+        rid = trace_id = replica_id = None
         for k, v in rh:
             lk = k.lower()
             if lk == "x-request-id":
                 rid = v
             elif lk == "x-trace-id":
                 trace_id = v
+            elif lk == "x-rtpu-replica":
+                replica_id = v
+        if replica_id is not None:
+            # Version-labeled mirror of the per-route families: which
+            # serving VERSION answered (the replica tag is stamped by
+            # _tag_replica on every proxied response). Looked up in the
+            # append-only rid→version map, not the live replica list, so
+            # a canary drained mid-flight still gets its errors charged
+            # to the canary version.
+            version = self._version_by_rid.get(replica_id) or "unversioned"
+            self._m_vrequests.labels(route=route,
+                                     version=version).observe(seconds)
+            if status >= 500:
+                self._m_vrequest_errors.labels(route=route,
+                                               version=version).inc()
         self._recorder.record_request(
             tier="gateway", method=method, path=path.split("?", 1)[0],
             status=status, duration_ms=seconds * 1000.0,
@@ -695,6 +794,8 @@ class Gateway:
                 replicas[r.id] = {
                     "base": r.base,
                     "state": r.state,
+                    "version": r.version,
+                    "canary": r.id in self._canary_rids,
                     "draining": r.draining,
                     "outstanding": r.outstanding,
                     "requests": r.requests,
@@ -715,6 +816,7 @@ class Gateway:
                 "hedges": self.hedges,
                 "hedge_wins": self.hedge_wins,
                 "draining": self.draining,
+                "canary_fraction": self._canary_fraction,
             }
         if self.supervisor is not None:
             sup = self.supervisor.snapshot()
@@ -723,6 +825,29 @@ class Gateway:
                     replicas[rid]["supervisor"] = info
             fleet["restarts"] = sum(i["restarts"] for i in sup.values())
         return {"fleet": fleet, "replicas": replicas}
+
+    def version_skew(self) -> dict:
+        """Per-replica version + live model identity at a glance:
+        the gateway's own version label merged with each replica's
+        ``/api/version`` (build info, model generation + artifact
+        fingerprint) — the 'is anything serving stale bytes?' answer
+        surfaced on ``/api/autoscale`` and ``/api/metrics?replicas=1``.
+        Unreachable replicas report the error in place."""
+        fetched = self._fetch_replica_json("/api/version")
+        with self._lock:
+            labels = {r.id: {"version": r.version,
+                             "canary": r.id in self._canary_rids,
+                             "draining": r.draining}
+                      for r in self.replicas}
+        out = {}
+        for rid, entry in labels.items():
+            info = fetched.get(rid)
+            if isinstance(info, dict):
+                for key in ("version_label", "build", "model", "error"):
+                    if key in info:
+                        entry[key] = info[key]
+            out[rid] = entry
+        return out
 
     def replica_metrics(self) -> dict:
         """Per-replica ``/api/metrics`` JSON (batcher stage histograms
@@ -789,6 +914,8 @@ class Gateway:
                     return self._slo()
                 if bare == "/api/autoscale":
                     return self._autoscale()
+                if bare == "/api/rollout":
+                    return self._rollout()
                 if bare == "/api/debug/snapshot" and self.command == "POST":
                     return self._debug_snapshot()
                 length = int(self.headers.get("Content-Length") or 0)
@@ -820,6 +947,7 @@ class Gateway:
                     snap["registry"] = get_registry().snapshot()
                     if "replicas=1" in self.path:
                         snap["replica_metrics"] = gw.replica_metrics()
+                        snap["versions"] = gw.version_skew()
                     data = json.dumps(snap).encode()
                     ctype = "application/json"
                 self._respond(200, [("Content-Type", ctype)], data)
@@ -843,10 +971,67 @@ class Gateway:
             def _autoscale(self):
                 """Autoscaler state (fleet size, pending joins, recent
                 decisions, config) — ``{"enabled": false}`` when no
-                autoscaler is attached."""
+                autoscaler is attached. Always carries ``versions``:
+                per-replica build info + live model generation/
+                fingerprint, so version skew is visible at a glance."""
                 scaler = gw.autoscaler
                 payload = {"enabled": False} if scaler is None \
                     else scaler.snapshot()
+                payload["versions"] = gw.version_skew()
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
+
+            def _rollout(self):
+                """Change-delivery surface: GET = the rollout
+                controller's state machine snapshot (decisions,
+                canary cohort, verdicts); POST starts or aborts one
+                (``{"version": "...", "env": {...}}`` /
+                ``{"action": "abort"}``)."""
+                ro = gw.rollout
+                if self.command == "POST":
+                    if ro is None:
+                        return self._respond(
+                            503, [("Content-Type", "application/json")],
+                            json.dumps({"error": "no rollout controller "
+                                                 "attached"}).encode())
+                    length = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        body = json.loads(self.rfile.read(length)
+                                          or b"{}")
+                    except ValueError:
+                        body = None
+                    if not isinstance(body, dict):
+                        return self._respond(
+                            400, [("Content-Type", "application/json")],
+                            json.dumps({"error": "body must be a JSON "
+                                                 "object"}).encode())
+                    if body.get("action") == "abort":
+                        aborted = ro.abort("api")
+                        payload = {"aborted": aborted, **ro.snapshot()}
+                        return self._respond(
+                            200, [("Content-Type", "application/json")],
+                            json.dumps(payload, default=str).encode())
+                    version = body.get("version")
+                    env = body.get("env") or {}
+                    if not isinstance(version, str) or not version \
+                            or not isinstance(env, dict) \
+                            or not all(isinstance(k, str)
+                                       and isinstance(v, str)
+                                       for k, v in env.items()):
+                        return self._respond(
+                            400, [("Content-Type", "application/json")],
+                            json.dumps({"error": "need a version string "
+                                        "(and optional str→str env "
+                                        "overlay)"}).encode())
+                    started = ro.start(version, env=env)
+                    payload = {"started": started, **ro.snapshot()}
+                    return self._respond(
+                        202 if started else 409,
+                        [("Content-Type", "application/json")],
+                        json.dumps(payload, default=str).encode())
+                payload = {"enabled": False} if ro is None \
+                    else ro.snapshot()
                 self._respond(200,
                               [("Content-Type", "application/json")],
                               json.dumps(payload, default=str).encode())

@@ -51,14 +51,23 @@ class _Replica:
     __slots__ = ("index", "port", "proc", "restarts", "started_at",
                  "next_start_at", "consecutive_crashes", "health_failures",
                  "last_exit_code", "last_probe_at", "ever_up", "waiting",
-                 "retired")
+                 "retired", "env", "version")
 
-    def __init__(self, index: int, port: int) -> None:
+    def __init__(self, index: int, port: int,
+                 env: Optional[Dict[str, str]] = None,
+                 version: Optional[str] = None) -> None:
         # Set under the supervisor lock when the replica is being
         # scaled away: the monitor must never restart a retired worker.
         self.retired = False
         self.index = index
         self.port = port
+        # Per-replica env overlay + version label (safe change delivery:
+        # a canary runs the same command with a different overlay —
+        # model path, chaos spec, RTPU_VERSION — and the monitor's
+        # respawns reuse the SAME overlay, so a restart never silently
+        # reverts a replica to the fleet default).
+        self.env = dict(env) if env else None
+        self.version = version
         self.proc: Optional[subprocess.Popen] = None
         self.restarts = 0
         self.started_at = 0.0
@@ -98,8 +107,15 @@ class ReplicaSupervisor:
                  backoff_base_s: float = 0.5,
                  backoff_cap_s: float = 30.0,
                  health_path: str = "/up",
-                 quiet: bool = True) -> None:
-        self._replicas = [_Replica(i, p) for i, p in enumerate(ports)]
+                 quiet: bool = True,
+                 version: Optional[str] = None) -> None:
+        # Fleet-default version label + env overlay for NEW replicas
+        # (``set_default`` repoints them after a promoted rollout, so
+        # autoscaler spawns come up on the promoted version).
+        self._default_version = version
+        self._default_overlay: Optional[Dict[str, str]] = None
+        self._replicas = [_Replica(i, p, version=version)
+                          for i, p in enumerate(ports)]
         self._next_index = len(self._replicas)   # monotonic, never reused
         self._command = command or default_worker_command
         self._env = dict(env if env is not None else os.environ)
@@ -135,9 +151,31 @@ class ReplicaSupervisor:
 
     def _spawn(self, r: _Replica) -> None:
         env = dict(self._env)
+        if r.env:
+            env.update(r.env)
         env["PORT"] = str(r.port)
+        argv = self._command(r.port)
+        # Chaos fault point ``replica.boot`` (+ a per-version variant so
+        # a spec can doom exactly one rollout's spawns): a boot fault
+        # cannot raise inside a worker that does not exist yet, so the
+        # injection happens HERE and substitutes an argv that exits
+        # immediately — the monitor sees a real crash and walks the
+        # normal backoff-restart path, which is exactly what a bad
+        # deploy's crash loop looks like. A ``latency`` rule simply
+        # delays the spawn (slow boot).
+        from routest_tpu.chaos import ChaosError
+        from routest_tpu.chaos import inject as chaos_inject
+
+        try:
+            chaos_inject("replica.boot")
+            if r.version:
+                chaos_inject(f"replica.boot.{r.version}")
+        except ChaosError as e:
+            argv = [sys.executable, "-c", "import sys; sys.exit(13)"]
+            _log.warning("replica_boot_chaos", index=r.index, port=r.port,
+                         version=r.version, error=str(e))
         out = subprocess.DEVNULL if self._quiet else None
-        r.proc = subprocess.Popen(self._command(r.port), env=env,
+        r.proc = subprocess.Popen(argv, env=env,
                                   cwd=self._cwd, stdout=out, stderr=out)
         r.started_at = time.time()
         r.health_failures = 0
@@ -145,7 +183,7 @@ class ReplicaSupervisor:
         r.waiting = False
         r.last_exit_code = None
         _log.info("replica_spawned", index=r.index, port=r.port,
-                  pid=r.proc.pid, restarts=r.restarts)
+                  pid=r.proc.pid, restarts=r.restarts, version=r.version)
 
     def ready(self, timeout: float = 240.0) -> bool:
         """Block until every replica answers its health probe."""
@@ -169,21 +207,62 @@ class ReplicaSupervisor:
             s.bind(("127.0.0.1", 0))
             return s.getsockname()[1]
 
-    def add_replica(self, port: Optional[int] = None) -> Tuple[int, int]:
+    def add_replica(self, port: Optional[int] = None, *,
+                    env: Optional[Dict[str, str]] = None,
+                    version: Optional[str] = None) -> Tuple[int, int]:
         """Spawn one more worker → ``(index, port)``. The index comes
         from the monotonic counter (never reused); the port defaults to
         a fresh OS-assigned one — deterministic ``base_port + i``
         schemes collide with retired ports still in TIME_WAIT. The
         caller owns readiness (``wait_port_ready`` is the startup
-        probe); the monitor babysits the new worker like any other."""
+        probe); the monitor babysits the new worker like any other.
+
+        ``env`` overlays the base environment for THIS replica (and its
+        monitor respawns); ``version`` labels it for rollout/version-
+        skew tracking. Both default to the fleet defaults installed by
+        ``set_default`` (which a promoted rollout repoints)."""
         if port is None:
             port = self._free_port()
         with self._lock:
-            r = _Replica(self._next_index, port)
+            if env is None:
+                env = self._default_overlay
+            if version is None:
+                version = self._default_version
+            r = _Replica(self._next_index, port, env=env, version=version)
             self._next_index += 1
             self._replicas.append(r)
             self._spawn(r)
         return r.index, r.port
+
+    def set_default(self, env: Optional[Dict[str, str]] = None,
+                    version: Optional[str] = None) -> None:
+        """Repoint the fleet default overlay/version for FUTURE spawns
+        (the promote step of a rollout: once the new version owns the
+        fleet, autoscaler growth must come up on it too)."""
+        with self._lock:
+            self._default_overlay = dict(env) if env else None
+            self._default_version = version
+
+    def replica_status(self, index: int) -> Optional[Dict]:
+        """One replica's liveness/restart view → dict or None for an
+        unknown/retired index. The rollout controller's boot watch
+        reads this: a spawn that keeps exiting shows up as a climbing
+        ``restarts`` long before any startup-probe timeout."""
+        with self._lock:
+            r = next((x for x in self._replicas
+                      if x.index == index and not x.retired), None)
+            if r is None:
+                return None
+            return {
+                "index": r.index,
+                "port": r.port,
+                "alive": r.proc is not None and r.proc.poll() is None,
+                "restarts": r.restarts,
+                "ever_up": r.ever_up,
+                "last_exit_code": r.last_exit_code,
+                "version": r.version,
+                "env": dict(r.env) if r.env else None,
+            }
 
     def wait_port_ready(self, port: int, timeout: float = 120.0) -> bool:
         """Startup probe for one replica: poll ``/up`` until it answers
@@ -377,6 +456,7 @@ class ReplicaSupervisor:
                     "port": r.port,
                     "alive": alive,
                     "restarts": r.restarts,
+                    "version": r.version,
                     "uptime_s": round(time.time() - r.started_at, 1)
                     if alive else 0.0,
                 }

@@ -15,6 +15,7 @@ surfaces 503).
 from __future__ import annotations
 
 import datetime as dt
+import hashlib
 import itertools
 import math
 import os
@@ -66,6 +67,64 @@ _EMPTY_SERVING = _ServingState(None, None, ())
 # Model-generation counter: every serving state that goes live anywhere
 # in the process (startup, hot-reload replacement) draws a fresh id.
 _GENERATION = itertools.count()
+
+# Change-delivery observability (docs/ROBUSTNESS.md "Safe change
+# delivery"): every verified-swap verdict counts here, and the gauge
+# tracks the LIVE generation so version skew across a fleet is readable
+# from /api/metrics without parsing logs.
+_m_swaps = get_registry().counter(
+    "rtpu_model_swaps_total",
+    "Model hot-swap attempts, by result (accepted / rejected).",
+    ("result",))
+_m_generation = get_registry().gauge(
+    "rtpu_model_generation",
+    "Generation id of the live serving model (monotonic per process).")
+
+
+def _artifact_fingerprint(path: str) -> Optional[str]:
+    """Content fingerprint of the serving artifact (sha256, short) —
+    the identity the rollout controller and ``/api/version`` report, so
+    'which bytes is r3 actually serving?' has a one-line answer."""
+    try:
+        digest = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                digest.update(chunk)
+        return digest.hexdigest()[:16]
+    except OSError:
+        return None
+
+
+_GOLDEN_BATCH: Optional[np.ndarray] = None
+
+
+def golden_batch() -> np.ndarray:
+    """Deterministic verification rows spanning the feature domain.
+
+    Every (weather × traffic) category pair appears twice, with
+    weekday/hour/distance/driver-age swept across their ranges — the
+    fixed batch a replacement artifact must score finitely (and close
+    to the live model, see ``swap_max_divergence``) before a hot-swap
+    flips the serving generation. Encoded once per process; the rows
+    are plain model inputs, so the same batch verifies MLP, quantile,
+    GBDT, and AOT-export artifacts alike (shared 12-feature ABI)."""
+    global _GOLDEN_BATCH
+    if _GOLDEN_BATCH is None:
+        from routest_tpu.data.features import (TRAFFIC_CATEGORIES,
+                                               WEATHER_CATEGORIES)
+
+        combos = [(w, t) for w in WEATHER_CATEGORIES
+                  for t in TRAFFIC_CATEGORIES]
+        n = 2 * len(combos)
+        _GOLDEN_BATCH = encode_requests(
+            weather=[w for w, _ in combos] * 2,
+            traffic=[t for _, t in combos] * 2,
+            weekday=[i % 7 for i in range(n)],
+            hour=[(7 * i) % 24 for i in range(n)],
+            distance_km=[0.5 + (i % 12) * 2.5 for i in range(n)],
+            driver_age=[20.0 + (i % 8) * 5.0 for i in range(n)],
+        )
+    return _GOLDEN_BATCH
 
 
 class _InReload(threading.local):
@@ -592,6 +651,8 @@ class EtaService:
         self._path = model_path or default_model_path()
         self._loaded_mtime_ns = self._artifact_mtime_ns()
         self._reload_lock = threading.Lock()
+        self.fingerprint: Optional[str] = None
+        self.loaded_unix: Optional[float] = None
         self._load(self._path)
         self._batcher: Optional[DynamicBatcher] = None
         self._serving = _EMPTY_SERVING
@@ -714,6 +775,11 @@ class EtaService:
             self._serving = _ServingState(self._model, self._batcher,
                                           self.quantiles,
                                           generation=next(_GENERATION))
+            self.loaded_unix = time.time()
+            # A replacement built for verification is NOT live yet; its
+            # parent flips the gauge if (and only if) the swap lands.
+            if not _in_reload.flag:
+                _m_generation.set(self._serving.generation)
             self._warm_buckets()
 
     def _warm_buckets(self) -> None:
@@ -880,6 +946,20 @@ class EtaService:
             return fallback
 
     def _load(self, path: str) -> None:
+        # Chaos fault point: a bad deploy's first observable failure is
+        # often the artifact load itself — seeded injection here makes
+        # that scenario replayable (``model.load:error=1.0@1`` fails
+        # exactly one load). An injected fault degrades exactly like a
+        # corrupt file: load_error set, old model (if any) keeps serving.
+        from routest_tpu.chaos import ChaosError
+        from routest_tpu.chaos import inject as chaos_inject
+
+        try:
+            chaos_inject("model.load")
+        except ChaosError as e:
+            self._error = f"chaos injected at model.load: {e}"
+            return
+        self.fingerprint = _artifact_fingerprint(path)
         # AOT export? Sniff the magic so a .stablehlo artifact gets a
         # real error from ITS loader instead of "not a msgpack artifact".
         try:
@@ -950,10 +1030,25 @@ class EtaService:
             finally:
                 _in_reload.flag = False
             if not fresh.available:
+                _m_swaps.labels(result="rejected").inc()
                 log.warning("model_reload_rejected", path=self._path,
+                            fingerprint=fresh.fingerprint,
                             error=fresh.load_error)
                 # remember the bad mtime: don't rebuild-and-reject on
                 # every poll until the file changes again
+                self._loaded_mtime_ns = mtime
+                return False
+            # Golden-batch gate: a deserializable, self-check-passing
+            # artifact can still be wrong (truncated weights that load,
+            # a layer scaled by a bad export). Score the fixed golden
+            # rows off-path and reject non-finite or wildly divergent
+            # outputs BEFORE the generation flips — the live model
+            # never stops serving during any of this.
+            ok, verdict = self._verify_swap(fresh)
+            if not ok:
+                _m_swaps.labels(result="rejected").inc()
+                log.warning("model_swap_rejected", path=self._path,
+                            fingerprint=fresh.fingerprint, **verdict)
                 self._loaded_mtime_ns = mtime
                 return False
             # ONE reference flip makes the swap atomic for readers (they
@@ -967,6 +1062,10 @@ class EtaService:
             self.kernel = fresh.kernel
             self._error = None
             self._loaded_mtime_ns = fresh._loaded_mtime_ns
+            self.fingerprint = fresh.fingerprint
+            self.loaded_unix = fresh.loaded_unix
+            _m_swaps.labels(result="accepted").inc()
+            _m_generation.set(self._serving.generation)
             # Cache coherency on reload: correctness already holds (the
             # new snapshot carries a new generation, so old keys can
             # never match) — this drop is memory hygiene, freeing the
@@ -974,8 +1073,54 @@ class EtaService:
             # for LRU/TTL.
             if self._fastlane is not None:
                 self._fastlane.invalidate()
-            log.info("model_reloaded", path=self._path, kernel=self.kernel)
+            log.info("model_reloaded", path=self._path, kernel=self.kernel,
+                     generation=self._serving.generation,
+                     fingerprint=self.fingerprint, **verdict)
             return True
+
+    def _verify_swap(self, fresh: "EtaService") -> Tuple[bool, dict]:
+        """Score the golden batch on the REPLACEMENT service →
+        ``(accept, verdict-detail)``. Two gates: every output finite,
+        and — when the live model is comparable (same output shape;
+        a point→quantile upgrade is a deliberate structural change and
+        skips it) — median absolute divergence within
+        ``swap_max_divergence`` minutes. Both run entirely off-path on
+        the replacement's own batcher."""
+        cfg = self._cfg
+        if not getattr(cfg, "swap_verify", True):
+            return True, {"verified": False}
+        golden = golden_batch()
+        try:
+            new = fresh._predict_rows(fresh._serving, golden)
+        except Exception as e:
+            return False, {"reason": "golden batch scoring failed: "
+                                     f"{type(e).__name__}: {e}"}
+        if new is None:
+            return False, {"reason": "golden batch produced no output"}
+        new = np.asarray(new, np.float64)
+        finite = np.isfinite(new).reshape(len(new), -1).all(axis=1)
+        if not finite.all():
+            return False, {"reason": "non-finite golden outputs",
+                           "bad_rows": int((~finite).sum()),
+                           "rows": int(len(new))}
+        bound = float(getattr(cfg, "swap_max_divergence", 0.0) or 0.0)
+        serving = self._serving
+        if bound > 0 and serving.batcher is not None:
+            try:
+                old = self._predict_rows(serving, golden)
+            except Exception:
+                old = None  # live model unscoreable: finiteness decides
+            if old is not None:
+                old = np.asarray(old, np.float64)
+                if old.shape == new.shape and bool(np.isfinite(old).all()):
+                    div = float(np.median(np.abs(new - old)))
+                    if div > bound:
+                        return False, {"reason": "divergence beyond bound",
+                                       "divergence": round(div, 3),
+                                       "bound": bound}
+                    return True, {"divergence": round(div, 4),
+                                  "bound": bound}
+        return True, {}
 
     def start_reload_watcher(self, interval_s: float) -> threading.Event:
         """Poll the artifact mtime every ``interval_s`` seconds on a
@@ -1001,6 +1146,17 @@ class EtaService:
     @property
     def available(self) -> bool:
         return self._model is not None
+
+    @property
+    def generation(self) -> int:
+        """Generation id of the LIVE serving snapshot (-1 = nothing
+        serving). The fast-lane cache keys on it; the rollout controller
+        reads it through ``/api/version`` to prove a swap landed."""
+        return self._serving.generation
+
+    @property
+    def model_path(self) -> str:
+        return self._path
 
     @property
     def quantiles(self) -> Tuple[float, ...]:
@@ -1206,7 +1362,8 @@ class EtaService:
     @property
     def stats(self) -> dict:
         base = {"available": self.available, "error": self._error,
-                "kernel": self.kernel}
+                "kernel": self.kernel, "generation": self.generation,
+                "fingerprint": self.fingerprint}
         if self._batcher is not None:
             base.update(self._batcher.stats)
         if self._fastlane is not None:

@@ -20,14 +20,25 @@ import os
 
 import pytest
 
+import routest_tpu.chaos
 import routest_tpu.obs
 import routest_tpu.serve
+import routest_tpu.serve.fleet
 
 SERVE_ROOT = os.path.dirname(os.path.abspath(routest_tpu.serve.__file__))
 # The recorder's trigger paths run during incidents: a silently
 # swallowed bundle-write failure would erase the postmortem evidence
 # exactly when it matters — same invariant, second tree.
 OBS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.obs.__file__))
+# serve/fleet is inside SERVE_ROOT's walk already, but gets its own
+# explicit id: the rollout controller's replace/rollback sequences are
+# exactly where a swallowed failure would leave a fleet half-rolled
+# with nothing in the logs — a failure here must name the tree.
+FLEET_ROOT = os.path.dirname(
+    os.path.abspath(routest_tpu.serve.fleet.__file__))
+# The chaos engine is what every robustness claim leans on; it must
+# never eat its own errors either.
+CHAOS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.chaos.__file__))
 
 BROAD = {"Exception", "BaseException"}
 
@@ -63,8 +74,9 @@ def _offenders(path):
             yield node.lineno
 
 
-@pytest.mark.parametrize("root", [SERVE_ROOT, OBS_ROOT],
-                         ids=["serve", "obs"])
+@pytest.mark.parametrize("root",
+                         [SERVE_ROOT, OBS_ROOT, FLEET_ROOT, CHAOS_ROOT],
+                         ids=["serve", "obs", "fleet", "chaos"])
 def test_no_silent_broad_excepts(root):
     offenders = []
     for dirpath, dirnames, filenames in os.walk(root):

@@ -1,0 +1,66 @@
+"""Full change-delivery run (slow): real fleet, open-loop load, live
+verified hot-swaps and canary rollouts.
+
+Tier-1 covers the swap gate, canary routing, and the state machine
+hermetically (tests/test_rollout.py, tests/test_rolling_restart_sse.py);
+this exercises the composed stack through ``scripts/bench_rollout.py
+--quick`` and asserts the ISSUE-7 acceptance invariants as DIRECTION
+guardbands: ≥3 hot-swaps land under load with zero client 5xx and no
+SLO page, every bad artifact is rejected with the old model serving,
+and each of the three bad-deploy archetypes auto-rolls back with the
+offending version in a flight-recorder bundle and blast radius bounded
+to the canary fraction."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_rollout_quick(tmp_path):
+    out = tmp_path / "rollout.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_rollout.py"),
+         "--quick", "--out", str(out)],
+        cwd=REPO, timeout=1800, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    record = json.loads(out.read_text())
+    scenarios = record["scenarios"]
+    assert set(scenarios) == {"hot_swap", "boot_crash",
+                              "corrupt_artifact", "slo_regression",
+                              "rollout_good"}
+
+    hs = scenarios["hot_swap"]
+    assert len(hs["good_swaps"]) >= 3, hs
+    assert all(s["landed"] for s in hs["good_swaps"]), hs
+    assert hs["swap_counts"]["rejected"] >= 3, hs
+    assert all(r["rejected"] and r["generation_unchanged"]
+               for r in hs["bad_artifacts"]), hs
+    assert hs["load"]["errors"] == 0, hs["load"]
+    assert not hs["slo"]["paged"], hs["slo"]
+
+    for name, triggers in (
+            ("boot_crash", {"boot_crash_loop", "boot_timeout"}),
+            ("corrupt_artifact", {"verify_failed"}),
+            ("slo_regression", {"canary_latency", "canary_error_rate",
+                                "slo_page"})):
+        s = scenarios[name]
+        assert s["final_state"] == "rolled_back", (name, s)
+        assert s["rollback"]["trigger"] in triggers, (name, s["rollback"])
+        assert s["rollback"]["offending_version"] == s["version"], s
+        assert s["bundle"]["reason"] == "rollout_rollback", s["bundle"]
+        assert s["fleet_versions"] == ["v1"], s
+        assert s["blast_radius"]["bounded"] if "blast_radius" in s \
+            else True, s
+
+    good = scenarios["rollout_good"]
+    assert good["final_state"] == "done", good
+    assert good["fleet_versions"] == [good["version"]], good
+    assert good["load"]["errors"] == 0, good["load"]
+
+    assert record["all_pass"]
