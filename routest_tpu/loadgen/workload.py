@@ -114,17 +114,21 @@ class ZipfODWorkload:
                                self.body_for_pair(p), "/api/predict_eta")
                 for p in self.pair_indices(n)]
 
-    def route_body_for_pair(self, pair_id: int, stops: int = 2) -> dict:
+    def route_body_for_pair(self, pair_id: int, stops: int = 2,
+                            road_graph: bool = False) -> dict:
         """A ``/api/request_route``-shaped body over the same pair
         vocabulary (source = pair's origin, destinations walk the
-        location list from the pair's target)."""
+        location list from the pair's target). ``road_graph=True``
+        routes over the street network (true shortest paths through
+        the partition overlay) instead of great-circle legs — the
+        metro-extract serving workload."""
         i, j = self.pairs[int(pair_id)]
         _, lat1, lon1 = self._locations[i]
         dests = []
         for k in range(stops):
             _, lat, lon = self._locations[(j + k) % len(self._locations)]
             dests.append({"lat": lat, "lon": lon, "payload": 1})
-        return {
+        body = {
             "source_point": {"lat": lat1, "lon": lon1},
             "destination_points": dests,
             "driver_details": {"vehicle_type": "car",
@@ -132,6 +136,9 @@ class ZipfODWorkload:
                                "maximum_distance": 300_000},
             "use_ml_eta": True,
         }
+        if road_graph:
+            body["road_graph"] = True
+        return body
 
 
 DEFAULT_MIX: Dict[str, float] = {
@@ -157,7 +164,8 @@ class MixedWorkload:
     def __init__(self, mix: Optional[Dict[str, float]] = None,
                  s: float = 1.1, seed: int = 0,
                  batch_rows: int = 64,
-                 sse_channel: str = "loadgen") -> None:
+                 sse_channel: str = "loadgen",
+                 road_graph: bool = False) -> None:
         mix = dict(mix if mix is not None else DEFAULT_MIX)
         unknown = set(mix) - set(self.KINDS)
         if unknown:
@@ -169,6 +177,7 @@ class MixedWorkload:
         self.seed = seed
         self.batch_rows = batch_rows
         self.sse_channel = sse_channel
+        self.road_graph = road_graph
         self.od = ZipfODWorkload(s=s, seed=seed)
 
     def sequence(self, n: int) -> List[PlannedRequest]:
@@ -188,7 +197,8 @@ class MixedWorkload:
             elif kind == "request_route":
                 out.append(PlannedRequest(
                     "POST", "/api/request_route",
-                    self.od.route_body_for_pair(pair),
+                    self.od.route_body_for_pair(
+                        pair, road_graph=self.road_graph),
                     "/api/request_route"))
             elif kind == "history":
                 out.append(PlannedRequest(
@@ -224,4 +234,5 @@ class MixedWorkload:
         return {"mix": dict(self.mix), "zipf_s": self.od.s,
                 "seed": self.seed, "od_pairs": len(self.od.pairs),
                 "batch_rows": self.batch_rows,
-                "sse_channel": self.sse_channel}
+                "sse_channel": self.sse_channel,
+                "road_graph": self.road_graph}

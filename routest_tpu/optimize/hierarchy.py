@@ -1,61 +1,72 @@
-"""Two-level partition overlay for metro-scale shortest paths.
+"""Multi-level partition overlay for metro-scale shortest paths.
 
 The flat batched Bellman-Ford in ``optimize/road_router.py`` is
 *diameter-bound*: every sweep advances the frontier one hop, so a
 street network's O(sqrt(N)) hop diameter costs ~900 dependent device
 sweeps at 50k nodes and grows without bound (VERDICT r3 weak #2 — the
 rented engine this framework replaces, ORS, answers matrix calls on
-country-scale graphs in tens of ms;
-``/root/reference/backend/route_optimizer_twx2/Flaskr/utils.py:97-103``).
+country-scale graphs in tens of ms).
 
-This module removes the diameter from the critical path with the
-classic two-level *overlay* decomposition (the "customizable route
-planning" family), re-designed for the TPU's strength — big dense
-batched relaxations instead of priority queues:
+This module removes the diameter from the critical path with a
+*recursive* overlay decomposition (the "customizable route planning"
+family applied level over level), re-designed for the TPU's strength —
+big dense batched relaxations instead of priority queues:
 
-1. **Partition**: recursive coordinate bisection splits the node set
-   into geometrically compact cells of bounded size. Pure numpy, one
-   time, O(N log N).
-2. **Precompute** (device, batched over every cell at once): a
-   restricted Bellman-Ford *inside each cell* from each of its
+1. **Partition**: ONE recursive coordinate-bisection tree, cut at
+   several size thresholds, gives a NESTED multi-level partition:
+   every level-(k+1) cell is a union of level-k cells. Nesting is what
+   makes the recursive query exact — the boundary nodes of a level-k
+   cell always live inside one level-(k+1) cell.
+2. **Precompute** (device, batched over every cell of a level at
+   once): a restricted Bellman-Ford inside each cell from each of its
    boundary nodes (nodes incident to a cell-crossing edge) gives
-   - ``table[cell, b, v]``: exact in-cell distance boundary→node, and
-   - a boundary→boundary *clique* per cell (the overlay shortcuts),
-     pruned of edges implied by two-hop boundary paths.
-   Cells are independent, so the sweep vmaps over (cell, boundary
-   source) — exactly the wide, regular batch shape XLA tiles well.
+   ``table[cell, b, v]`` — exact in-cell distance boundary→node — and
+   a boundary→boundary *clique* per cell, pruned of edges implied by
+   two-hop boundary paths. The cliques plus the original cell-crossing
+   edges form the level's *overlay graph*, which is the next level's
+   input graph; levels stack until the top overlay is small.
 3. **Query** (device): for S sources at once,
-   - phase 1: tiny restricted BF inside each source's cell;
-   - phase 2: Bellman-Ford over the *overlay graph* (boundary nodes,
-     clique + original cross-cell edges), seeded with phase 1 — its
-     hop count is the number of cells across the metro, not nodes;
-   - phase 3: a min-plus stitch ``min_b(overlay[s,b] + table[cell,b,v])``
-     folds boundary distances through the precomputed tables to every
-     node, as a fori accumulation over the boundary axis (never
-     materializing the (S, P, b, c) proposal tensor).
+   - *ascend*: a tiny restricted BF inside the source's level-1 cell,
+     then per level a restricted BF inside the source's level-k cell
+     over the level-(k-1) overlay graph, seeded with the previous
+     level's boundary distances;
+   - *top*: Bellman-Ford over the topmost overlay graph — its hop
+     count is the number of top-level cells across the metro, not
+     nodes, not even level-1 cells;
+   - *descend*: per level, a min-plus stitch
+     ``min_b(ovl[s,b] + table[cell,b,v])`` folds boundary distances
+     through the precomputed tables down one graph, as a fori
+     accumulation over the boundary axis (never materializing the
+     (S, P, b, c) proposal tensor). Cells are ordered by DESCENDING
+     boundary count at build time so the fold runs in tiers, paying
+     each tier's actual boundary count instead of the global ``b_max``.
 
-Exactness: any shortest path decomposes at cell crossings into
-maximal within-cell segments between boundary nodes; each segment's
-restricted length equals a clique weight, so the overlay metric is the
-true metric on boundary nodes, and the stitched suffix is the true
-in-cell tail. Same-cell journeys that never leave the cell are covered
-by phase 1; journeys that leave and re-enter are covered by phase 3.
-The query therefore returns *exact* distances (up to f32 rounding from
-re-associated sums), and ``road_router.shortest`` re-uses its existing
-tight-edge predecessor recovery unchanged — after a few polish sweeps
-of the flat relaxation that re-anchor ties to bit-identical
-``dist[s] + w`` assignments.
+Exactness (per level, hence by induction for the stack): any shortest
+path decomposes at cell crossings into maximal within-cell segments
+between boundary nodes; each segment's restricted length equals a
+clique weight, so the overlay metric is the true metric on boundary
+nodes, and the stitched suffix is the true in-cell tail. Same-cell
+journeys that never leave the cell are covered by the ascend locals
+(folded back in during descent); journeys that leave and re-enter are
+covered by the stitch. The query therefore returns *exact* distances
+(up to f32 rounding from re-associated sums), and
+``road_router.shortest`` re-uses its existing tight-edge predecessor
+recovery unchanged — after a couple of polish sweeps of the flat
+relaxation that re-anchor ties to bit-identical ``dist[s] + w``
+assignments.
 
 Directed graphs (OSM one-ways) are handled: tables, cliques and the
-phase-3 stitch are all forward-direction restricted distances.
+stitches are all forward-direction restricted distances.
 """
 
 from __future__ import annotations
 
 import functools
+import hashlib
+import json
 import os
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -68,7 +79,15 @@ _INF_NP = np.float32(3e38)
 # (measured in road_router._bellman_ford — same constant, same reason).
 _K_SWEEPS = 4
 
-_CACHE_VERSION = 1
+# v2: multi-level payload (per-level arrays + top overlay graph),
+# content-hash cache filenames, per-level build stats.
+_CACHE_VERSION = 2
+
+
+def _log():
+    from routest_tpu.utils.logging import get_logger
+
+    return get_logger("routest.hier")
 
 
 # ---------------------------------------------------------------------------
@@ -108,6 +127,28 @@ def relax_from(senders: jax.Array, receivers: jax.Array, w: jax.Array,
         keep_going, relax,
         (dist0, jnp.asarray(True), jnp.zeros((), jnp.int32)))
     return dist, jnp.logical_not(still_changing)
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_sweeps"))
+def polish(senders: jax.Array, receivers: jax.Array, w: jax.Array,
+           dist: jax.Array, *, n_nodes: int, n_sweeps: int) -> jax.Array:
+    """``n_sweeps`` UNROLLED relaxation sweeps with no convergence
+    check. Overlay distances are already exact ± a few ulps of f32
+    re-association; what predecessor recovery needs is that every
+    node's value was *assigned* from a ``dist[s] + w`` proposal so the
+    minimal-slack edge is ~0 bitwise — one sweep re-anchors that, a
+    second covers senders that moved in the first. The while_loop in
+    :func:`relax_from` would pay a device-synced ``any()`` per round
+    for a loop that, by construction, never exits early here."""
+
+    def seg_min(p):
+        return jax.ops.segment_min(p, receivers, num_segments=n_nodes,
+                                   indices_are_sorted=True)
+
+    for _ in range(n_sweeps):
+        proposals = dist[:, senders] + w[None, :]
+        dist = jnp.minimum(dist, jax.vmap(seg_min)(proposals))
+    return dist
 
 
 @functools.partial(jax.jit, static_argnames=("n_nodes",))
@@ -152,62 +193,401 @@ def tight_pred(senders: jax.Array, receivers: jax.Array, w: jax.Array,
 # Partition
 # ---------------------------------------------------------------------------
 
-def partition_cells(coords: np.ndarray,
-                    cell_target: int) -> Tuple[np.ndarray, int]:
-    """(N, 2) coords → (N,) cell ids via recursive median bisection on
-    the wider coordinate axis: cells are size-balanced (≤ cell_target)
-    and geometrically compact, which keeps boundary sets small — the
-    quantity every overlay cost scales with."""
+def partition_cells_nested(
+        coords: np.ndarray,
+        targets: Sequence[int]) -> List[Tuple[np.ndarray, int]]:
+    """(N, 2) coords + finest-first cell-size targets → one (N,) cell
+    assignment per level, finest first, **nested**: every level-(k+1)
+    cell is a union of level-k cells, because all levels are cuts of
+    the SAME recursive-median-bisection tree at different size
+    thresholds. Cells are size-balanced (≤ target) and geometrically
+    compact, which keeps boundary sets small — the quantity every
+    overlay cost scales with."""
     n = len(coords)
-    cell = np.zeros(n, np.int32)
-    stack = [np.arange(n)]
-    parts = []
+    L = len(targets)
+    cells = [np.zeros(n, np.int32) for _ in range(L)]
+    counts = [0] * L
+    stack: List[Tuple[np.ndarray, int]] = [(np.arange(n), L - 1)]
     while stack:
-        idx = stack.pop()
-        if len(idx) <= cell_target:
-            parts.append(idx)
+        idx, lvl = stack.pop()
+        if len(idx) <= targets[lvl]:
+            cells[lvl][idx] = counts[lvl]
+            counts[lvl] += 1
+            if lvl > 0:
+                stack.append((idx, lvl - 1))
             continue
         c = coords[idx]
         axis = int(np.argmax(c.max(axis=0) - c.min(axis=0)))
         order = np.argsort(c[:, axis], kind="stable")
         half = len(idx) // 2
-        stack.append(idx[order[:half]])
-        stack.append(idx[order[half:]])
-    for ci, idx in enumerate(parts):
-        cell[idx] = ci
-    return cell, len(parts)
+        stack.append((idx[order[:half]], lvl))
+        stack.append((idx[order[half:]], lvl))
+    return [(cells[k], counts[k]) for k in range(L)]
+
+
+def partition_cells(coords: np.ndarray,
+                    cell_target: int) -> Tuple[np.ndarray, int]:
+    """Single-level cut of the bisection tree (the multi-level
+    machinery with one threshold)."""
+    (cell, n_cells), = partition_cells_nested(
+        np.asarray(coords, np.float32), [cell_target])
+    return cell, n_cells
+
+
+def _level_targets(n: int, cell_target: Optional[int] = None,
+                   max_levels: Optional[int] = None) -> List[int]:
+    """Finest-first cell-size ladder. Each coarser level groups ~ratio
+    finer cells; levels stack while the next one would still have ≥ 4
+    cells — past that the top overlay BF is already tiny."""
+    if cell_target is None:
+        try:
+            cell_target = int(
+                os.environ.get("ROUTEST_HIER_CELL_TARGET", "0") or 0)
+        except ValueError:
+            cell_target = 0
+    if not cell_target:
+        # Balance the phases: cell work ~ c, overlay hops ~ sqrt(N/c).
+        cell_target = max(192, int(2.2 * np.sqrt(n)))
+    try:
+        ratio = int(os.environ.get("ROUTEST_HIER_RATIO", "16") or 16)
+    except ValueError:
+        ratio = 16
+    ratio = max(2, ratio)
+    if max_levels is None:
+        try:
+            max_levels = int(
+                os.environ.get("ROUTEST_HIER_MAX_LEVELS", "0") or 0)
+        except ValueError:
+            max_levels = 0
+    max_levels = max_levels or 8
+    targets = [int(cell_target)]
+    while len(targets) < max_levels and n // (targets[-1] * ratio) >= 4:
+        targets.append(targets[-1] * ratio)
+    return targets
+
+
+def _prune_slack() -> float:
+    try:
+        return float(os.environ.get("ROUTEST_HIER_PRUNE_SLACK", "2e-7"))
+    except ValueError:
+        return 2e-7
+
+
+def _contract_interior() -> int:
+    """Max interior nodes per contracted chain segment
+    (``ROUTEST_HIER_CONTRACT``; 0 disables contraction). The router's
+    polish pass must run at least this many sweeps — that is what fills
+    chain-interior distances back in — so the two knobs are coupled in
+    ``road_router``."""
+    try:
+        return max(0, int(os.environ.get("ROUTEST_HIER_CONTRACT", "2")))
+    except ValueError:
+        return 2
 
 
 # ---------------------------------------------------------------------------
-# Batched within-cell relaxation (precompute + query phase 1)
+# Degree-2 chain contraction
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("c_max", "max_iters"))
-def _relax_cells(cs: jax.Array, cr: jax.Array, cw: jax.Array,
-                 dist0: jax.Array, *, c_max: int,
-                 max_iters: int) -> jax.Array:
-    """Restricted Bellman-Ford inside many cells at once.
+def _contract_chains(coords: np.ndarray, senders: np.ndarray,
+                     receivers: np.ndarray, w: np.ndarray,
+                     max_interior: int) -> Optional[Dict[str, np.ndarray]]:
+    """Collapse degree-2 chains (OSM bend nodes — ~80% of a real street
+    extract) into single weighted edges before the overlay is built.
+
+    Every overlay cost scales with the boundary-node count, and bend
+    nodes on cell-border streets are boundary nodes that carry zero
+    routing information: contracting them shrinks the overlay's node,
+    clique and edge counts by the bend ratio (~2.5–6×) while keeping
+    the metric EXACT — a chain is a forced path, so its length is a
+    constant.
+
+    A node is chain-interior iff it has exactly two distinct neighbors
+    and is a pure pass-through (two-way to both, or one-in/one-out
+    across them); mixed two-way/one-way junctions, parallel-edge and
+    self-loop endpoints stay. Chains longer than ``max_interior`` are
+    split (every ``max_interior``-th interior node is promoted) so the
+    router's polish sweeps — which re-derive interior distances from
+    the contracted solution — need only ``max_interior`` sweeps.
+    All-interior cycles (roundabouts) promote their smallest node.
+
+    Returns None when nothing contracts, else:
+      ``cid_of``      (N,) contracted id per original node, -1 interior
+      ``kept``        (N',) original id per contracted node
+      ``c_senders``/``c_receivers``/``c_w`` contracted edge list
+      ``seed_node``   (N, 2) contracted ids reachable FROM each
+                      original node along its chain (pad -1)
+      ``seed_w``      (N, 2) the along-chain cost to each (pad INF)
+    """
+    n = len(coords)
+    senders = np.asarray(senders, np.int64)
+    receivers = np.asarray(receivers, np.int64)
+    w = np.asarray(w, np.float32)
+    loop = senders == receivers
+    out_deg = np.bincount(senders, minlength=n)
+    in_deg = np.bincount(receivers, minlength=n)
+    # Distinct undirected neighbors + parallel-edge detection.
+    a = np.minimum(senders, receivers)
+    b = np.maximum(senders, receivers)
+    und = np.unique(a * n + b)
+    ua, ub = und // n, und % n
+    und_deg = np.bincount(ua, minlength=n) + np.bincount(ub, minlength=n)
+    ordered, counts = np.unique(senders * n + receivers, return_counts=True)
+    dup = ordered[counts > 1]
+    blocked = np.zeros(n, bool)
+    blocked[senders[loop]] = True
+    blocked[(dup // n)] = True
+    blocked[(dup % n)] = True
+    interior = (~blocked & (und_deg == 2)
+                & (((out_deg == 2) & (in_deg == 2))
+                   | ((out_deg == 1) & (in_deg == 1))))
+    if not interior.any():
+        return None
+
+    # Adjacency restricted to edges touching interiors (python walk —
+    # chains are short and each interior is visited once).
+    touch = interior[senders] | interior[receivers]
+    ew: Dict[Tuple[int, int], float] = {}
+    for s, r, wt in zip(senders[touch], receivers[touch], w[touch]):
+        key = (int(s), int(r))
+        if key not in ew or wt < ew[key]:
+            ew[key] = float(wt)
+
+    # Undirected neighbor map for interiors (both directions known from
+    # the degree pattern: 2-2 has adj both ways; 1-1 only forward, so
+    # fold the reverse in from the incoming side).
+    nbrs: Dict[int, List[int]] = {}
+    for s, r in zip(senders[touch], receivers[touch]):
+        s, r = int(s), int(r)
+        if interior[s]:
+            nbrs.setdefault(s, [])
+            if r not in nbrs[s]:
+                nbrs[s].append(r)
+        if interior[r]:
+            nbrs.setdefault(r, [])
+            if s not in nbrs[r]:
+                nbrs[r].append(s)
+
+    promoted = np.zeros(n, bool)
+    visited = np.zeros(n, bool)
+    chains: List[List[int]] = []
+    for v0 in np.flatnonzero(interior):
+        v0 = int(v0)
+        if visited[v0]:
+            continue
+        # Expand to both ends.
+        chain = [v0]
+        visited[v0] = True
+        for direction in (0, 1):
+            prev, cur = v0, nbrs[v0][direction] if len(
+                nbrs[v0]) > direction else None
+            if cur is None:
+                continue
+            while interior[cur] and not visited[cur]:
+                visited[cur] = True
+                if direction == 0:
+                    chain.append(cur)
+                else:
+                    chain.insert(0, cur)
+                nxt = [x for x in nbrs[cur] if x != prev]
+                if not nxt:
+                    cur = None
+                    break
+                prev, cur = cur, nxt[0]
+            if cur is not None and not interior[cur]:
+                if direction == 0:
+                    chain.append(cur)
+                else:
+                    chain.insert(0, cur)
+            elif cur is not None and visited[cur] and cur == (
+                    chain[0] if direction == 0 else chain[-1]):
+                # closed all-interior cycle: break it at the smallest id
+                break
+        # Ensure endpoints are non-interior; cycles promote min node.
+        if interior[chain[0]] and interior[chain[-1]]:
+            keep_node = min(chain)
+            promoted[keep_node] = True
+            i = chain.index(keep_node)
+            chain = chain[i:] + chain[:i + 1]
+        # Split long runs: promote every max_interior-th interior.
+        run = 0
+        for node in chain[1:-1]:
+            run += 1
+            if run > max_interior:
+                promoted[node] = True
+                run = 0
+        chains.append(chain)
+
+    interior &= ~promoted
+    cid_of = np.full(n, -1, np.int64)
+    kept = np.flatnonzero(~interior)
+    cid_of[kept] = np.arange(len(kept))
+
+    # Contracted edges: originals not touching interiors + one summed
+    # edge per traversable chain-segment direction.
+    keep_edge = ~(interior[senders] | interior[receivers])
+    c_s = [cid_of[senders[keep_edge]]]
+    c_r = [cid_of[receivers[keep_edge]]]
+    c_w = [w[keep_edge]]
+    seed_node = np.full((n, 2), -1, np.int64)
+    seed_w = np.full((n, 2), np.inf, np.float64)
+    seed_node[kept, 0] = cid_of[kept]
+    seed_w[kept, 0] = 0.0
+
+    def emit(seg: List[int]) -> None:
+        """One kept→kept segment: summed edges per direction + seeds
+        for its interiors."""
+        for s_dir in (0, 1):
+            nodes = seg if s_dir == 0 else seg[::-1]
+            total = 0.0
+            ok = True
+            partial = [0.0]
+            for x, y in zip(nodes[:-1], nodes[1:]):
+                wt = ew.get((x, y))
+                if wt is None:
+                    ok = False
+                    break
+                total += wt
+                partial.append(total)
+            if not ok:
+                continue
+            c_s.append(np.asarray([cid_of[nodes[0]]]))
+            c_r.append(np.asarray([cid_of[nodes[-1]]]))
+            c_w.append(np.asarray([total], np.float32))
+            # Seeds: every interior can reach the segment's END in this
+            # direction at cost (total - partial).
+            for i, node in enumerate(nodes[1:-1], start=1):
+                slot = 0 if seed_node[node, 0] < 0 else 1
+                seed_node[node, slot] = cid_of[nodes[-1]]
+                seed_w[node, slot] = total - partial[i]
+
+    for chain in chains:
+        seg: List[int] = [chain[0]]
+        for node in chain[1:]:
+            seg.append(node)
+            if not interior[node]:
+                if len(seg) > 1:
+                    emit(seg)
+                seg = [node]
+        if len(seg) > 1:
+            emit(seg)
+
+    c_senders = np.concatenate(c_s)
+    c_receivers = np.concatenate(c_r)
+    c_weights = np.concatenate(c_w).astype(np.float32)
+    return {
+        "cid_of": cid_of, "kept": kept,
+        "c_senders": c_senders, "c_receivers": c_receivers,
+        "c_w": c_weights,
+        "seed_node": seed_node.astype(np.int64),
+        "seed_w": np.where(np.isfinite(seed_w), seed_w,
+                           _INF_NP).astype(np.float32),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Batched within-cell relaxation (precompute + query ascend)
+# ---------------------------------------------------------------------------
+
+def _relax_blockdiag(cs: jax.Array, cr: jax.Array, cw: jax.Array,
+                     dist0: jax.Array, *, c_max: int,
+                     max_iters: int) -> jax.Array:
+    """Restricted Bellman-Ford inside many cells at once, as ONE
+    block-diagonal graph.
 
     ``cs``/``cr``/``cw``: (G, e_max) cell-local edge arrays, sorted by
     local receiver, padded with (0, c_max-1, INF) edges whose proposals
-    can never win. ``dist0``: (G, R, c_max) initial distances (R source
-    rows per cell). One while_loop converges the whole batch."""
+    can never win. ``dist0``: (R, G*c_max) distance rows laid out
+    cell-major. Offsetting each cell's local ids by ``g*c_max`` turns
+    the G independent cells into one graph whose edge list stays
+    receiver-sorted, so each sweep is a single wide
+    ``segment_min(indices_are_sorted=True)`` — the layout the flat
+    solver is fast in. The previous vmap-of-vmap (cells × rows of tiny
+    segment reductions) measured ~10× slower PER ELEMENT on CPU than
+    this flattening at identical sweep counts."""
+    G, e_max = cs.shape
+    offs = (jnp.arange(G, dtype=jnp.int32) * c_max)[:, None]
+    s_flat = (cs + offs).reshape(-1)
+    r_flat = (cr + offs).reshape(-1)
+    w_flat = cw.reshape(-1)
+    dist, _ = relax_from(s_flat, r_flat, w_flat, dist0,
+                         n_nodes=G * c_max, max_iters=max_iters)
+    return dist
 
-    def seg_min(p, r):
-        return jax.ops.segment_min(p, r, num_segments=c_max,
-                                   indices_are_sorted=True)
 
-    def cell_sweep(dist, s, r, w):          # (R, c_max) one cell
-        proposals = dist[:, s] + w[None, :]
-        return jnp.minimum(dist, jax.vmap(lambda p: seg_min(p, r))(proposals))
+# ELL minirow width: per-receiver edge runs pad to multiples of this
+# and reduce densely. 8 keeps street-node padding waste ≤ ~40% while
+# cutting the (single-row) segment reduction to m_max elements.
+_ELL_W = 8
 
-    sweep_all = jax.vmap(cell_sweep)
+
+def _ell_pack(ie_cell: np.ndarray, ie_s: np.ndarray, ie_r: np.ndarray,
+              ie_w: np.ndarray, P: int,
+              c_max: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Cell-grouped receiver-sorted edges → per-cell ELL minirows:
+    ``(P, m_max, W)`` senders/weights + ``(P, m_max)`` minirow
+    receivers. Each receiver's edge run is chunked into width-W
+    minirows, so a query sweep is one dense ``(m, W)`` gather+min (the
+    fast layout at ANY row count) followed by a segment-min over only
+    ``m ≈ E/W`` elements instead of E. Pad lanes carry (0, INF); pad
+    minirows receive into local ``c_max - 1`` (sorted order kept, INF
+    never wins)."""
+    E = len(ie_cell)
+    if E == 0:
+        return (np.zeros((P, 1, _ELL_W), np.int32),
+                np.full((P, 1, _ELL_W), _INF_NP, np.float32),
+                np.full((P, 1), max(c_max - 1, 0), np.int32))
+    key = ie_cell.astype(np.int64) * c_max + ie_r
+    new_run = np.empty(E, bool)
+    new_run[0] = True
+    new_run[1:] = key[1:] != key[:-1]
+    run_start = np.maximum.accumulate(np.where(new_run, np.arange(E), 0))
+    rank = np.arange(E) - run_start
+    new_mini = new_run | (rank % _ELL_W == 0)
+    mini_id = np.cumsum(new_mini) - 1                 # global minirow id
+    lane = rank % _ELL_W
+    mini_cell = ie_cell[new_mini]
+    m_counts = np.bincount(mini_cell, minlength=P)
+    m_max = max(1, int(m_counts.max()))
+    m_starts = np.zeros(P + 1, np.int64)
+    np.cumsum(m_counts, out=m_starts[1:])
+    mini_local = mini_id - m_starts[ie_cell]
+    ell_s = np.zeros((P, m_max, _ELL_W), np.int32)
+    ell_w = np.full((P, m_max, _ELL_W), _INF_NP, np.float32)
+    ell_r = np.full((P, m_max), max(c_max - 1, 0), np.int32)
+    ell_s[ie_cell, mini_local, lane] = ie_s
+    ell_w[ie_cell, mini_local, lane] = ie_w
+    ell_r[ie_cell, mini_local] = ie_r
+    return ell_s, ell_w, ell_r
+
+
+def _relax_ell(es: jax.Array, ew_: jax.Array, er: jax.Array,
+               dist0: jax.Array, *, c_max: int,
+               max_iters: int) -> jax.Array:
+    """Block-diagonal restricted Bellman-Ford over ELL-packed cells —
+    the ONE-ROW query layout (one selected cell per source). ``es``/
+    ``ew_``: (S, m_max, W); ``er``: (S, m_max); ``dist0``: (S, c_max).
+    Per sweep: dense (S*m, W) gather+lane-min, then a segment-min over
+    S*m minirows — ~5× less segment traffic than edge-wise reduction,
+    which is what the single-row shape is slow at."""
+    S, m_max, W = es.shape
+    offs = (jnp.arange(S, dtype=jnp.int32) * c_max)
+    s_flat = (es + offs[:, None, None]).reshape(S * m_max, W)
+    r_flat = (er + offs[:, None]).reshape(-1)
+    w_flat = ew_.reshape(S * m_max, W)
+    n_flat = S * c_max
+
+    def one_sweep(dist):                         # dist (n_flat,)
+        prop = (dist[s_flat] + w_flat).min(axis=1)
+        seg = jax.ops.segment_min(prop, r_flat, num_segments=n_flat,
+                                  indices_are_sorted=True)
+        return jnp.minimum(dist, seg)
 
     def relax(state):
         dist, _, it = state
         new = dist
         for _ in range(_K_SWEEPS):
-            new = sweep_all(new, cs, cr, cw)
+            new = one_sweep(new)
         return new, jnp.any(new < dist), it + _K_SWEEPS
 
     def keep_going(state):
@@ -216,20 +596,30 @@ def _relax_cells(cs: jax.Array, cr: jax.Array, cw: jax.Array,
 
     dist, _, _ = jax.lax.while_loop(
         keep_going, relax,
-        (dist0, jnp.asarray(True), jnp.zeros((), jnp.int32)))
-    return dist
+        (dist0.reshape(-1), jnp.asarray(True), jnp.zeros((), jnp.int32)))
+    return dist.reshape(S, c_max)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _prune_cliques(T: jax.Array) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("slack",))
+def _prune_cliques(T: jax.Array, *, slack: float = 2e-7) -> jax.Array:
     """(P, b, b) restricted boundary metric → keep mask for clique
     edges. An edge (i, j) is *implied* when some third boundary node k
-    gives ``T[i,k] + T[k,j] ≤ T[i,j]`` (within rounding): the overlay
+    gives ``T[i,k] + T[k,j] ≤ T[i,j]`` (within ``slack``): the overlay
     metric closure is unchanged by dropping it, because T is itself the
     restricted metric (triangle inequality holds), both legs are
     strictly shorter than the whole (legs below 1 m are excluded so the
     induction bottoms out), and the implication chain therefore
-    terminates at kept edges."""
+    terminates at kept edges.
+
+    ``slack`` trades exactness for edge count: a pruned near-tie's
+    traffic reroutes over a bypass at most ``(1+slack)`` longer, and
+    bypasses chain, so the overlay metric can inflate by ~slack ×
+    cascade-depth per level. At the default ~2 ulps the inflation stays
+    inside the f32 rounding the module already owns; the knob
+    (``ROUTEST_HIER_PRUNE_SLACK``) exists because upper-level cliques
+    on grid-like street networks are dominated by near-ties whose
+    pruning is worth a bounded, measured error (the scale benches
+    record oracle parity per run — the budget is ≤ 1e-5 relative)."""
     P, b, _ = T.shape
     inf = _INF
 
@@ -243,41 +633,306 @@ def _prune_cliques(T: jax.Array) -> jax.Array:
         return jnp.minimum(acc, a[:, :, None] + c[:, None, :])
 
     via = jax.lax.fori_loop(0, b, body, jnp.full_like(T, inf))
-    # Ulp-tight: a positive absolute slack here would *inflate* the
-    # overlay metric by that slack per pruning level (a pruned edge's
-    # traffic reroutes over the bypass, which may itself be pruned). At
-    # ~2 ulps relative, the inflation stays inside the f32 rounding the
-    # module already owns; near-ties the slack would have pruned are
-    # merely kept — a few % more clique edges, never a wrong distance.
-    implied = via <= T * (1 + 2e-7)
+    implied = via <= T * (1 + slack)
     finite = T < 1e37
     eye = jnp.eye(b, dtype=bool)[None]
     return finite & ~eye & ~implied
 
 
+# ---------------------------------------------------------------------------
+# One level of the stack
+# ---------------------------------------------------------------------------
+
+_LEVEL_KEYS = ("cell", "local_of_node", "src_cell", "ell_s", "ell_w",
+               "ell_r", "bl", "cbo", "table", "perm_of_node", "b_global")
+
+
+def _stitch_tiers(bcounts: np.ndarray, max_tiers: int = 4,
+                  min_cells: int = 8) -> Tuple[Tuple[int, int, int], ...]:
+    """Cells are build-ordered by DESCENDING boundary count; split them
+    into ≤ ``max_tiers`` contiguous ranges, each folding only its own
+    max boundary count. The descend stitch then pays
+    Σ tier_cells × tier_b instead of P × b_max — and trailing
+    boundary-free cells (disconnected pockets) cost zero iterations."""
+    P = len(bcounts)
+    tiers: List[Tuple[int, int, int]] = []
+    lo = 0
+    while lo < P:
+        bb = int(bcounts[lo])
+        if bb == 0 or len(tiers) == max_tiers - 1:
+            tiers.append((lo, P, bb))
+            break
+        hi = lo + 1
+        while hi < P and (int(bcounts[hi]) * 2 > bb or hi - lo < min_cells):
+            hi += 1
+        tiers.append((lo, hi, bb))
+        lo = hi
+    return tuple(tiers)
+
+
+def _table_chunk(P: int, b_max: int, e_max: int, c_max: int) -> int:
+    """Cells per batched precompute dispatch, from a ~256 MB budget on
+    the (chunk, b_max, max(e_max, c_max)) proposal tensor: big graphs
+    chunk to bound memory, small ones batch the whole level in one
+    dispatch instead of 64-cell driblets (the 1M-node build spent most
+    of its wall time on dispatch count, not FLOPs)."""
+    per_cell = 4 * max(b_max, 1) * max(e_max, c_max, 1)
+    return int(np.clip((256 << 20) // per_cell, 8, max(P, 8)))
+
+
+class _Level:
+    """Device-resident arrays + query metadata for one level."""
+
+    def __init__(self, p: Dict[str, np.ndarray], stats: Dict) -> None:
+        self.cell = np.asarray(p["cell"])
+        self.local_of_node = np.asarray(p["local_of_node"])
+        self.src_cell = np.asarray(p["src_cell"])
+        self.b_global = np.asarray(p["b_global"])
+        P, b_max = p["cbo"].shape
+        self.n_cells = P
+        self.b_max = b_max
+        self.c_max = int(p["table"].shape[2])
+        self.n_overlay = int(len(p["b_global"]))
+        self.d_ell_s = jnp.asarray(p["ell_s"])
+        self.d_ell_w = jnp.asarray(p["ell_w"])
+        self.d_ell_r = jnp.asarray(p["ell_r"])
+        self.d_bl = jnp.asarray(p["bl"])
+        self.d_cbo = jnp.asarray(p["cbo"])
+        self.d_table = jnp.asarray(p["table"])
+        self.d_perm = jnp.asarray(p["perm_of_node"])
+        # G_{k-1}-node → local slot, padded with a dump slot (= c_max)
+        # so the next level's seed scatter can route pad entries there.
+        self.d_local_pad = jnp.asarray(np.concatenate(
+            [np.asarray(p["local_of_node"], np.int32),
+             np.asarray([self.c_max], np.int32)]))
+        bcounts = (np.asarray(p["cbo"]) < self.n_overlay).sum(axis=1)
+        self.tiers = _stitch_tiers(bcounts)
+        self.stats = stats
+
+    def payload(self) -> Dict[str, np.ndarray]:
+        return {
+            "cell": self.cell, "local_of_node": self.local_of_node,
+            "src_cell": self.src_cell, "b_global": self.b_global,
+            "ell_s": np.asarray(self.d_ell_s),
+            "ell_w": np.asarray(self.d_ell_w),
+            "ell_r": np.asarray(self.d_ell_r),
+            "bl": np.asarray(self.d_bl), "cbo": np.asarray(self.d_cbo),
+            "table": np.asarray(self.d_table),
+            "perm_of_node": np.asarray(self.d_perm),
+        }
+
+
+def _build_level(senders: np.ndarray, receivers: np.ndarray, w: np.ndarray,
+                 cell: np.ndarray, n_cells: int, *,
+                 chunk_cells: Optional[int] = None,
+                 prune_slack: float = 2e-7) -> Optional[Tuple[Dict, Dict,
+                                                              Tuple]]:
+    """One overlay level over an arbitrary input graph: cell-grouped
+    edge arrays, boundary tables, pruned cliques. Returns
+    ``(payload, stats, (ovl_s, ovl_r, ovl_w))`` — the overlay graph is
+    the next level's input — or None when the level cannot help (a
+    single cell, or no cell-crossing edges)."""
+    n = len(cell)
+    P = int(n_cells)
+    if P < 2:
+        return None
+    senders = np.asarray(senders, np.int64)
+    receivers = np.asarray(receivers, np.int64)
+    w = np.asarray(w, np.float32)
+
+    s_cell, r_cell = cell[senders], cell[receivers]
+    internal = s_cell == r_cell
+    cross = np.flatnonzero(~internal)
+    if len(cross) == 0:
+        return None
+
+    # Boundary nodes: endpoints of cell-crossing edges. Cells are
+    # RENUMBERED by descending boundary count so the descend stitch can
+    # run in contiguous tiers (``_stitch_tiers``).
+    is_b = np.zeros(n, bool)
+    is_b[senders[cross]] = True
+    is_b[receivers[cross]] = True
+    bcounts_raw = np.bincount(cell[is_b], minlength=P)
+    remap = np.empty(P, np.int32)
+    remap[np.argsort(-bcounts_raw, kind="stable")] = np.arange(
+        P, dtype=np.int32)
+    cell = remap[cell]
+    s_cell, r_cell = cell[senders], cell[receivers]
+
+    order = np.argsort(cell, kind="stable")
+    sizes = np.bincount(cell, minlength=P)
+    starts = np.zeros(P + 1, np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    c_max = int(sizes.max())
+    local_of_node = np.empty(n, np.int32)
+    local_of_node[order] = (np.arange(n) - starts[cell[order]]).astype(
+        np.int32)
+
+    # Internal edges, grouped by cell and sorted by local receiver.
+    ie = np.flatnonzero(internal)
+    ie_cell = s_cell[ie]
+    ie_s = local_of_node[senders[ie]]
+    ie_r = local_of_node[receivers[ie]]
+    ie_w = w[ie]
+    eorder = np.lexsort((ie_r, ie_cell))
+    ie_cell, ie_s, ie_r, ie_w = (a[eorder] for a in (ie_cell, ie_s, ie_r,
+                                                     ie_w))
+    ecounts = np.bincount(ie_cell, minlength=P)
+    e_max = max(1, int(ecounts.max()))
+    ces = np.zeros((P, e_max), np.int32)
+    cer = np.full((P, e_max), c_max - 1, np.int32)
+    cew = np.full((P, e_max), _INF_NP, np.float32)
+    estarts = np.zeros(P + 1, np.int64)
+    np.cumsum(ecounts, out=estarts[1:])
+    flat_pos = np.arange(len(ie)) - estarts[ie_cell]
+    ces[ie_cell, flat_pos] = ie_s
+    cer[ie_cell, flat_pos] = ie_r
+    cew[ie_cell, flat_pos] = ie_w
+
+    b_global = order[is_b[order]]            # cell-grouped boundary list
+    b_cell = cell[b_global]
+    bcounts = np.bincount(b_cell, minlength=P)
+    b_max = int(bcounts.max())
+    B = len(b_global)
+    bstarts = np.zeros(P + 1, np.int64)
+    np.cumsum(bcounts, out=bstarts[1:])
+    b_pos = np.arange(B) - bstarts[b_cell]
+    bl = np.zeros((P, b_max), np.int32)      # local idx, pad 0 (masked later)
+    bl[b_cell, b_pos] = local_of_node[b_global]
+    ovl_of_node = np.full(n, -1, np.int64)
+    ovl_of_node[b_global] = np.arange(B)
+    cbo = np.full((P, b_max), B, np.int32)   # overlay id, pad B (= INF slot)
+    cbo[b_cell, b_pos] = np.arange(B)
+
+    # Batched in-cell tables, chunked so the (chunk, b_max, e_max)
+    # proposal tensor stays bounded whatever the graph size — and so
+    # small levels run in ONE dispatch rather than many.
+    if chunk_cells is None:
+        chunk_cells = _table_chunk(P, b_max, e_max, c_max)
+    chunk_cells = min(chunk_cells, P)
+    table = np.empty((P, b_max, c_max), np.float32)
+    max_iters = c_max + _K_SWEEPS
+    for lo in range(0, P, chunk_cells):
+        hi = min(lo + chunk_cells, P)
+        pad = chunk_cells - (hi - lo)
+        g_ces = np.concatenate([ces[lo:hi], np.zeros((pad, e_max), np.int32)])
+        g_cer = np.concatenate([cer[lo:hi],
+                                np.full((pad, e_max), c_max - 1, np.int32)])
+        g_cew = np.concatenate([cew[lo:hi],
+                                np.full((pad, e_max), _INF_NP, np.float32)])
+        g_bl = np.concatenate([bl[lo:hi], np.zeros((pad, b_max), np.int32)])
+        # Row b of the block-flat table seeds boundary b of EVERY cell
+        # in the chunk at once: (b_max, chunk*c_max).
+        d0 = jnp.full((b_max, chunk_cells * c_max), _INF)
+        pos = (np.arange(chunk_cells, dtype=np.int64)[:, None] * c_max
+               + g_bl).T                                  # (b_max, chunk)
+        d0 = d0.at[jnp.arange(b_max)[:, None], jnp.asarray(pos)].set(0.0)
+        out = _relax_blockdiag(jnp.asarray(g_ces), jnp.asarray(g_cer),
+                               jnp.asarray(g_cew), d0,
+                               c_max=c_max, max_iters=max_iters)
+        out = np.asarray(out).reshape(b_max, chunk_cells, c_max)
+        table[lo:hi] = out.transpose(1, 0, 2)[: hi - lo]
+    # Pad boundary rows carry garbage (seeded at local 0): mask.
+    row = np.arange(b_max)[None, :]
+    table[row >= bcounts[:, None]] = _INF_NP
+
+    # Cliques: the boundary↔boundary submatrix of each table.
+    T = table[np.arange(P)[:, None, None],
+              np.arange(b_max)[None, :, None], bl[:, None, :]]
+    T = np.where((row[..., None] >= bcounts[:, None, None])
+                 | (row[:, None, :] >= bcounts[:, None, None]),
+                 _INF_NP, T)
+    keep = np.asarray(_prune_cliques(jnp.asarray(T), slack=prune_slack))
+    candidates = ((T < 1e37) & ~np.eye(b_max, dtype=bool)[None])
+    kp, ki, kj = np.nonzero(keep)
+    clique_s = cbo[kp, ki].astype(np.int64)
+    clique_r = cbo[kp, kj].astype(np.int64)
+    clique_w = T[kp, ki, kj]
+
+    # Overlay graph: pruned cliques + the original crossing edges.
+    ovl_s = np.concatenate([clique_s, ovl_of_node[senders[cross]]])
+    ovl_r = np.concatenate([clique_r, ovl_of_node[receivers[cross]]])
+    ovl_w = np.concatenate([clique_w, w[cross]]).astype(np.float32)
+    oorder = np.argsort(ovl_r, kind="stable")
+    ovl_s = ovl_s[oorder].astype(np.int32)
+    ovl_r = ovl_r[oorder].astype(np.int32)
+    ovl_w = ovl_w[oorder]
+
+    ell_s, ell_w, ell_r = _ell_pack(ie_cell, ie_s, ie_r, ie_w, P, c_max)
+    perm_of_node = (cell.astype(np.int64) * c_max
+                    + local_of_node).astype(np.int32)
+    stats = {
+        "n_nodes": n, "n_cells": P, "c_max": c_max, "b_max": b_max,
+        "n_overlay_nodes": B, "n_overlay_edges": int(len(ovl_s)),
+        "clique_edges_kept": int(len(clique_s)),
+        "clique_edges_pruned": int(candidates.sum() - keep.sum()),
+    }
+    payload = {
+        "cell": cell.astype(np.int32), "local_of_node": local_of_node,
+        "ell_s": ell_s, "ell_w": ell_w, "ell_r": ell_r,
+        "bl": bl, "cbo": cbo,
+        "table": table, "perm_of_node": perm_of_node,
+        "b_global": b_global.astype(np.int64),
+        "cell_remap": remap,
+    }
+    return payload, stats, (ovl_s, ovl_r, ovl_w)
+
+
+# ---------------------------------------------------------------------------
+# The index
+# ---------------------------------------------------------------------------
+
 class HierarchicalIndex:
     """Built once per graph; answers batched exact multi-source
-    shortest-path distance queries in O(cells-across) device sweeps."""
+    shortest-path distance queries in O(top-cells-across) device sweeps
+    regardless of node count."""
 
-    def __init__(self, *, cell: np.ndarray, n_cells: int,
-                 local_of_node: np.ndarray, c_max: int, b_max: int,
-                 d_ces: jax.Array, d_cer: jax.Array, d_cew: jax.Array,
-                 d_bl: jax.Array, d_cbo: jax.Array, d_table: jax.Array,
-                 d_perm_of_node: jax.Array, d_ovl_s: jax.Array,
-                 d_ovl_r: jax.Array, d_ovl_w: jax.Array, n_overlay: int,
-                 stats: Dict[str, float]) -> None:
-        self.cell = cell
-        self.n_cells = n_cells
-        self.local_of_node = local_of_node
-        self.n_nodes = len(cell)
-        self.c_max = c_max
-        self.b_max = b_max
-        self._d_ces, self._d_cer, self._d_cew = d_ces, d_cer, d_cew
-        self._d_bl, self._d_cbo, self._d_table = d_bl, d_cbo, d_table
-        self._d_perm_of_node = d_perm_of_node
-        self._d_ovl_s, self._d_ovl_r, self._d_ovl_w = d_ovl_s, d_ovl_r, d_ovl_w
-        self.n_overlay = n_overlay
+    def __init__(self, levels: List[_Level], top_s: np.ndarray,
+                 top_r: np.ndarray, top_w: np.ndarray, stats: Dict, *,
+                 expand_idx: np.ndarray, seed_node: np.ndarray,
+                 seed_w: np.ndarray) -> None:
+        self.levels = levels
+        self.n_levels = len(levels)
+        l1 = levels[0]
+        self.cell = l1.cell
+        self.n_cells = l1.n_cells
+        self.local_of_node = l1.local_of_node
+        self.c_max = l1.c_max
+        self.b_max = l1.b_max
+        self.n_overlay = l1.n_overlay
+        self.n_top = levels[-1].n_overlay
+        # Chain contraction mapping: the overlay lives on the
+        # contracted graph; ``expand_idx`` gathers contracted rows back
+        # to full-graph node order (pad slot = INF), ``seed_node``/
+        # ``seed_w`` turn an arbitrary full-graph source into ≤2
+        # (contracted node, along-chain offset) seeds.
+        self._expand_idx = np.asarray(expand_idx, np.int64)
+        self._seed_node = np.asarray(seed_node, np.int64)
+        self._seed_w = np.asarray(seed_w, np.float32)
+        self.n_contracted = len(l1.cell)
+        self.n_nodes = len(expand_idx)
+        self._contracted = self.n_nodes != self.n_contracted or bool(
+            (self._expand_idx != np.arange(self.n_nodes)).any())
+        self._d_expand = jnp.asarray(np.where(
+            self._expand_idx >= 0, self._expand_idx,
+            self.n_contracted).astype(np.int32))
+        # contracted node → its G_k overlay id per level (-1 when the
+        # node is not a level-k boundary node) — seed entry lookup.
+        gk = [np.arange(self.n_contracted, dtype=np.int64)]
+        for lvl in levels:
+            inv = np.full(len(lvl.cell), -1, np.int64)
+            inv[lvl.b_global] = np.arange(lvl.n_overlay)
+            prev = gk[-1]
+            gk.append(np.where(prev >= 0, inv[np.maximum(prev, 0)], -1))
+        self._gk = gk
+        self._top_s = np.asarray(top_s, np.int32)
+        self._top_r = np.asarray(top_r, np.int32)
+        self._top_w = np.asarray(top_w, np.float32)
+        self._d_top_s = jnp.asarray(self._top_s)
+        self._d_top_r = jnp.asarray(self._top_r)
+        self._d_top_w = jnp.asarray(self._top_w)
         self.stats = stats
+        self._stage_jits: Optional[List[Tuple[str, object]]] = None
         # ``query_fn`` is the raw traceable function: callers chain
         # further device work (the router's polish + predecessor
         # recovery) by inlining it inside ONE outer jit, so a warm
@@ -291,9 +946,12 @@ class HierarchicalIndex:
     def build(cls, coords: np.ndarray, senders: np.ndarray,
               receivers: np.ndarray, w: np.ndarray, *,
               cell_target: Optional[int] = None,
-              chunk_cells: int = 64,
+              cell_targets: Optional[Sequence[int]] = None,
+              max_levels: Optional[int] = None,
+              chunk_cells: Optional[int] = None,
               cache_path: Optional[str] = None,
-              fingerprint: Optional[Dict] = None) -> Optional["HierarchicalIndex"]:
+              fingerprint: Optional[Dict] = None
+              ) -> Optional["HierarchicalIndex"]:
         """Returns None when the graph is too small to benefit (a
         single cell, or no cell-crossing edges). With ``cache_path``,
         the host-side payload is written there (npz) before device
@@ -302,265 +960,428 @@ class HierarchicalIndex:
         each would otherwise pay the batched in-cell relaxation);
         ``fingerprint`` (the router's graph fingerprint) is embedded so
         a loaded payload is bound to ITS graph by content, not by the
-        predictable cache filename."""
+        predictable cache filename. ``cell_targets`` (finest first)
+        overrides the auto ladder — tests force deep stacks on small
+        graphs with it."""
         t0 = time.perf_counter()
-        n = len(coords)
-        if cell_target is None:
-            # Balance the phases: cell work ~ c, overlay hops ~ sqrt(N/c).
-            cell_target = max(192, int(2.2 * np.sqrt(n)))
-        cell, P = partition_cells(np.asarray(coords, np.float32), cell_target)
-        if P < 2:
+        n_full = len(coords)
+        coords = np.asarray(coords, np.float32)
+        senders = np.asarray(senders, np.int64)
+        receivers = np.asarray(receivers, np.int64)
+        w = np.asarray(w, np.float32)
+        # Degree-2 chain contraction: the overlay is built on the
+        # contracted graph (intersections + chain shortcuts), which
+        # shrinks every boundary-scaled cost by the bend ratio.
+        interior_cap = _contract_interior()
+        contraction = (_contract_chains(coords, senders, receivers, w,
+                                        interior_cap)
+                       if interior_cap else None)
+        if contraction is not None:
+            kept = contraction["kept"]
+            c_coords = coords[kept]
+            g_s = contraction["c_senders"]
+            g_r = contraction["c_receivers"]
+            g_w = contraction["c_w"]
+            expand_idx = contraction["cid_of"]
+            seed_node = contraction["seed_node"]
+            seed_w = contraction["seed_w"]
+        else:
+            c_coords = coords
+            g_s, g_r, g_w = senders, receivers, w
+            expand_idx = np.arange(n_full, dtype=np.int64)
+            seed_node = np.stack([np.arange(n_full, dtype=np.int64),
+                                  np.full(n_full, -1, np.int64)], axis=1)
+            seed_w = np.stack([np.zeros(n_full, np.float32),
+                               np.full(n_full, _INF_NP, np.float32)], axis=1)
+        n = len(c_coords)
+        contract_s = round(time.perf_counter() - t0, 3)
+        if cell_targets is None:
+            cell_targets = _level_targets(n, cell_target,
+                                          max_levels=max_levels)
+        t_part = time.perf_counter()
+        parts = partition_cells_nested(c_coords,
+                                       [int(t) for t in cell_targets])
+        partition_s = round(time.perf_counter() - t_part, 3)
+        prune_slack = _prune_slack()
+        node_origin = np.arange(n)        # current-graph node → G0 node
+        levels: List[_Level] = []
+        for li, (cell0, P) in enumerate(parts):
+            t_lvl = time.perf_counter()
+            built = _build_level(g_s, g_r, g_w,
+                                 cell0[node_origin].astype(np.int32), P,
+                                 chunk_cells=chunk_cells,
+                                 prune_slack=prune_slack)
+            if built is None:
+                if li == 0:
+                    return None
+                break
+            payload, lstats, ovl = built
+            B = len(payload["b_global"])
+            if li > 0 and 2 * B > len(node_origin):
+                # The overlay stopped shrinking — another level would
+                # cost more stitch work than its BF saves.
+                break
+            # Source lookup: G0 node → this level's (renumbered) cell.
+            payload["src_cell"] = payload["cell_remap"][
+                cell0].astype(np.int32)
+            lstats["level"] = li + 1
+            lstats["build_s"] = round(time.perf_counter() - t_lvl, 3)
+            levels.append(_Level(payload, lstats))
+            g_s, g_r, g_w = ovl
+            node_origin = node_origin[payload["b_global"]]
+        if not levels:
             return None
 
-        order = np.argsort(cell, kind="stable")
-        sizes = np.bincount(cell, minlength=P)
-        starts = np.zeros(P + 1, np.int64)
-        np.cumsum(sizes, out=starts[1:])
-        c_max = int(sizes.max())
-        local_of_node = np.empty(n, np.int32)
-        local_of_node[order] = (np.arange(n) - starts[cell[order]]).astype(np.int32)
-
-        # Internal edges, grouped by cell and sorted by local receiver.
-        s_cell, r_cell = cell[senders], cell[receivers]
-        internal = s_cell == r_cell
-        ie = np.flatnonzero(internal)
-        ie_cell = s_cell[ie]
-        ie_s = local_of_node[senders[ie]]
-        ie_r = local_of_node[receivers[ie]]
-        ie_w = np.asarray(w, np.float32)[ie]
-        eorder = np.lexsort((ie_r, ie_cell))
-        ie_cell, ie_s, ie_r, ie_w = (a[eorder] for a in (ie_cell, ie_s, ie_r, ie_w))
-        ecounts = np.bincount(ie_cell, minlength=P)
-        e_max = max(1, int(ecounts.max()))
-        ces = np.zeros((P, e_max), np.int32)
-        cer = np.full((P, e_max), c_max - 1, np.int32)
-        cew = np.full((P, e_max), _INF_NP, np.float32)
-        estarts = np.zeros(P + 1, np.int64)
-        np.cumsum(ecounts, out=estarts[1:])
-        flat_pos = np.arange(len(ie)) - estarts[ie_cell]
-        ces[ie_cell, flat_pos] = ie_s
-        cer[ie_cell, flat_pos] = ie_r
-        cew[ie_cell, flat_pos] = ie_w
-
-        # Boundary nodes: endpoints of cell-crossing edges.
-        cross = np.flatnonzero(~internal)
-        if len(cross) == 0:
-            return None
-        is_b = np.zeros(n, bool)
-        is_b[senders[cross]] = True
-        is_b[receivers[cross]] = True
-        b_global = order[is_b[order]]            # cell-grouped boundary list
-        b_cell = cell[b_global]
-        bcounts = np.bincount(b_cell, minlength=P)
-        b_max = int(bcounts.max())
-        B = len(b_global)
-        bstarts = np.zeros(P + 1, np.int64)
-        np.cumsum(bcounts, out=bstarts[1:])
-        b_pos = np.arange(B) - bstarts[b_cell]
-        bl = np.zeros((P, b_max), np.int32)      # local idx, pad 0 (masked later)
-        bl[b_cell, b_pos] = local_of_node[b_global]
-        ovl_of_node = np.full(n, -1, np.int64)
-        ovl_of_node[b_global] = np.arange(B)
-        cbo = np.full((P, b_max), B, np.int32)   # overlay id, pad B (= INF slot)
-        cbo[b_cell, b_pos] = np.arange(B)
-
-        # Batched in-cell tables, chunked so the (chunk, b_max, e_max)
-        # proposal tensor stays bounded whatever the graph size.
-        table = np.empty((P, b_max, c_max), np.float32)
-        max_iters = c_max + _K_SWEEPS
-        for lo in range(0, P, chunk_cells):
-            hi = min(lo + chunk_cells, P)
-            pad = chunk_cells - (hi - lo)
-            g_ces = np.concatenate([ces[lo:hi], np.zeros((pad, e_max), np.int32)])
-            g_cer = np.concatenate([cer[lo:hi],
-                                    np.full((pad, e_max), c_max - 1, np.int32)])
-            g_cew = np.concatenate([cew[lo:hi],
-                                    np.full((pad, e_max), _INF_NP, np.float32)])
-            g_bl = np.concatenate([bl[lo:hi], np.zeros((pad, b_max), np.int32)])
-            d0 = jnp.full((chunk_cells, b_max, c_max), _INF)
-            d0 = d0.at[jnp.arange(chunk_cells)[:, None],
-                       jnp.arange(b_max)[None, :], jnp.asarray(g_bl)].set(0.0)
-            out = _relax_cells(jnp.asarray(g_ces), jnp.asarray(g_cer),
-                               jnp.asarray(g_cew), d0,
-                               c_max=c_max, max_iters=max_iters)
-            table[lo:hi] = np.asarray(out)[: hi - lo]
-        # Pad boundary rows carry garbage (seeded at local 0): mask.
-        row = np.arange(b_max)[None, :]
-        table[row >= bcounts[:, None]] = _INF_NP
-
-        # Cliques: the boundary↔boundary submatrix of each table.
-        T = table[np.arange(P)[:, None, None],
-                  np.arange(b_max)[None, :, None], bl[:, None, :]]
-        T = np.where((row[..., None] >= bcounts[:, None, None])
-                     | (row[:, None, :] >= bcounts[:, None, None]),
-                     _INF_NP, T)
-        keep = np.asarray(_prune_cliques(jnp.asarray(T)))
-        candidates = ((T < 1e37)
-                      & ~np.eye(b_max, dtype=bool)[None])
-        kp, ki, kj = np.nonzero(keep)
-        clique_s = cbo[kp, ki].astype(np.int64)
-        clique_r = cbo[kp, kj].astype(np.int64)
-        clique_w = T[kp, ki, kj]
-
-        # Overlay graph: pruned cliques + the original crossing edges.
-        ovl_s = np.concatenate([clique_s, ovl_of_node[senders[cross]]])
-        ovl_r = np.concatenate([clique_r, ovl_of_node[receivers[cross]]])
-        ovl_w = np.concatenate([clique_w,
-                                np.asarray(w, np.float32)[cross]]).astype(np.float32)
-        oorder = np.argsort(ovl_r, kind="stable")
-        ovl_s, ovl_r, ovl_w = ovl_s[oorder], ovl_r[oorder], ovl_w[oorder]
-
-        perm_of_node = (cell.astype(np.int64) * c_max + local_of_node).astype(np.int32)
+        l1 = levels[0].stats
         stats = {
-            "n_cells": P, "c_max": c_max, "b_max": b_max,
-            "n_overlay_nodes": B, "n_overlay_edges": int(len(ovl_s)),
-            "clique_edges_kept": int(len(clique_s)),
-            "clique_edges_pruned": int(candidates.sum() - keep.sum()),
+            # Legacy single-level keys = level 1 (health/test consumers).
+            "n_cells": l1["n_cells"], "c_max": l1["c_max"],
+            "b_max": l1["b_max"],
+            "n_overlay_nodes": l1["n_overlay_nodes"],
+            "n_overlay_edges": l1["n_overlay_edges"],
+            "clique_edges_kept": l1["clique_edges_kept"],
+            "clique_edges_pruned": l1["clique_edges_pruned"],
+            "n_levels": len(levels),
+            "top_nodes": levels[-1].n_overlay,
+            "top_edges": int(len(g_s)),
+            "prune_slack": prune_slack,
+            "partition_s": partition_s,
+            "contraction": {
+                "interior_cap": interior_cap,
+                "n_full": n_full, "n_contracted": n,
+                "contract_s": contract_s,
+            },
+            "levels": [dict(lvl.stats) for lvl in levels],
             "build_s": 0.0,
         }
-        payload = {
-            "cell": cell, "local_of_node": local_of_node,
-            "ces": ces, "cer": cer, "cew": cew, "bl": bl, "cbo": cbo,
-            "table": table, "perm_of_node": perm_of_node,
-            "ovl_s": ovl_s.astype(np.int32),
-            "ovl_r": ovl_r.astype(np.int32), "ovl_w": ovl_w,
-        }
+        index = cls(levels, g_s, g_r, g_w, stats,
+                    expand_idx=expand_idx, seed_node=seed_node,
+                    seed_w=seed_w)
         stats["build_s"] = round(time.perf_counter() - t0, 3)
         if cache_path:
-            import json
+            index._save(cache_path, fingerprint)
+        return index
 
-            tmp = f"{cache_path}.tmp{os.getpid()}.npz"
+    def _save(self, cache_path: str, fingerprint: Optional[Dict]) -> None:
+        flat: Dict[str, np.ndarray] = {
+            "top_s": self._top_s, "top_r": self._top_r, "top_w": self._top_w,
+            "expand_idx": self._expand_idx,
+            "seed_node": self._seed_node, "seed_w": self._seed_w,
+        }
+        for k, lvl in enumerate(self.levels):
+            p = lvl.payload()
+            for name in _LEVEL_KEYS:
+                flat[f"l{k}_{name}"] = p[name]
+        tmp = f"{cache_path}.tmp{os.getpid()}.npz"
+        try:
+            np.savez_compressed(
+                tmp, _version=np.int64(_CACHE_VERSION),
+                _n_levels=np.int64(self.n_levels),
+                _stats=np.frombuffer(json.dumps(self.stats).encode(),
+                                     dtype=np.uint8),
+                _fp=np.frombuffer(
+                    json.dumps(fingerprint or {},
+                               sort_keys=True).encode(), dtype=np.uint8),
+                **flat)
+            os.replace(tmp, cache_path)
+        except OSError:
+            # cache is an optimization, never a dependency — but a
+            # half-written tmp must not accumulate
             try:
-                np.savez_compressed(
-                    tmp, _version=np.int64(_CACHE_VERSION),
-                    _stats=np.frombuffer(json.dumps(stats).encode(),
-                                         dtype=np.uint8),
-                    _fp=np.frombuffer(
-                        json.dumps(fingerprint or {},
-                                   sort_keys=True).encode(), dtype=np.uint8),
-                    **payload)
-                os.replace(tmp, cache_path)
+                os.unlink(tmp)
             except OSError:
-                # cache is an optimization, never a dependency — but a
-                # half-written tmp must not accumulate
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-        return cls._from_payload(payload, stats)
-
-    @classmethod
-    def _from_payload(cls, p: Dict[str, np.ndarray],
-                      stats: Dict) -> "HierarchicalIndex":
-        P, b_max = p["cbo"].shape
-        c_max = p["table"].shape[2]
-        return cls(
-            cell=np.asarray(p["cell"]), n_cells=P,
-            local_of_node=np.asarray(p["local_of_node"]),
-            c_max=c_max, b_max=b_max,
-            d_ces=jnp.asarray(p["ces"]), d_cer=jnp.asarray(p["cer"]),
-            d_cew=jnp.asarray(p["cew"]), d_bl=jnp.asarray(p["bl"]),
-            d_cbo=jnp.asarray(p["cbo"]), d_table=jnp.asarray(p["table"]),
-            d_perm_of_node=jnp.asarray(p["perm_of_node"]),
-            d_ovl_s=jnp.asarray(p["ovl_s"]), d_ovl_r=jnp.asarray(p["ovl_r"]),
-            d_ovl_w=jnp.asarray(p["ovl_w"]),
-            n_overlay=int(stats["n_overlay_nodes"]), stats=stats)
+                pass
 
     @classmethod
     def load(cls, cache_path: str,
-             fingerprint: Optional[Dict] = None) -> Optional["HierarchicalIndex"]:
+             fingerprint: Optional[Dict] = None
+             ) -> Optional["HierarchicalIndex"]:
         """Rehydrate a cached overlay; None on any mismatch/corruption
-        (callers rebuild). The embedded fingerprint must match the
-        caller's graph — the filename alone is predictable, so a
-        payload at the right name but for the wrong (or tampered)
-        graph is rejected by content, and the worst a poisoned entry
-        can do is force a rebuild."""
+        (callers rebuild) — LOUDLY, so a fleet whose replicas silently
+        re-spend minutes of precompute per boot is visible in logs. The
+        embedded fingerprint must match the caller's graph — the
+        filename alone is predictable, so a payload at the right name
+        but for the wrong (or tampered) graph is rejected by content,
+        and the worst a poisoned entry can do is force a rebuild."""
         try:
-            import json
-
             with np.load(cache_path, allow_pickle=False) as z:
-                if int(z["_version"]) != _CACHE_VERSION:
+                version = int(z["_version"])
+                if version != _CACHE_VERSION:
+                    _log().warning("overlay_cache_rejected",
+                                   path=cache_path, reason="version",
+                                   found=version, want=_CACHE_VERSION)
                     return None
                 if fingerprint is not None:
                     cached_fp = json.loads(bytes(z["_fp"]).decode())
                     if cached_fp != json.loads(
                             json.dumps(fingerprint, sort_keys=True)):
+                        _log().warning("overlay_cache_rejected",
+                                       path=cache_path,
+                                       reason="fingerprint_mismatch",
+                                       found=cached_fp, want=fingerprint)
                         return None
                 stats = json.loads(bytes(z["_stats"]).decode())
-                payload = {k: z[k] for k in
-                           ("cell", "local_of_node", "ces", "cer", "cew",
-                            "bl", "cbo", "table", "perm_of_node",
-                            "ovl_s", "ovl_r", "ovl_w")}
-            stats["loaded_from_cache"] = True
-            return cls._from_payload(payload, stats)
-        except Exception:
+                n_levels = int(z["_n_levels"])
+                levels = []
+                for k in range(n_levels):
+                    p = {name: z[f"l{k}_{name}"] for name in _LEVEL_KEYS}
+                    levels.append(_Level(p, stats["levels"][k]))
+                top_s, top_r, top_w = z["top_s"], z["top_r"], z["top_w"]
+                expand_idx = z["expand_idx"]
+                seed_node, seed_w = z["seed_node"], z["seed_w"]
+        except Exception as e:
+            _log().warning("overlay_cache_rejected", path=cache_path,
+                           reason=f"{type(e).__name__}: {e}")
             return None
+        stats["loaded_from_cache"] = True
+        return cls(levels, top_s, top_r, top_w, stats,
+                   expand_idx=expand_idx, seed_node=seed_node,
+                   seed_w=seed_w)
 
     # -- query ------------------------------------------------------------
 
-    def _build_query(self):
-        ces, cer, cew = self._d_ces, self._d_cer, self._d_cew
-        bl, cbo, table = self._d_bl, self._d_cbo, self._d_table
-        perm_of_node = self._d_perm_of_node
-        ovl_s, ovl_r, ovl_w = self._d_ovl_s, self._d_ovl_r, self._d_ovl_w
-        P, c_max, b_max, B = self.n_cells, self.c_max, self.b_max, self.n_overlay
-        cell_iters = c_max + _K_SWEEPS
-        ovl_iters = B + _K_SWEEPS
+    def _stages(self) -> List[Tuple[str, object]]:
+        """The query pipeline as (name, traceable fn) pairs over a
+        carry dict — ONE decomposition shared by the fused
+        ``query_fn`` (single dispatch, serving) and ``timed_query``
+        (stage-per-dispatch, the benches' per-phase breakdown)."""
+        lvls = self.levels
+        L = self.n_levels
+        top_s, top_r, top_w = self._d_top_s, self._d_top_r, self._d_top_w
+        Bt = self.n_top
 
-        def query(p_s: jax.Array, src_local: jax.Array) -> jax.Array:
-            S = p_s.shape[0]
+        def phase1(c: Dict) -> Dict:
+            l = lvls[0]
+            p = c["p_cells"][0]
+            sp = c["seed_pos"][0]                # (S, 2) local ids|dump
+            sv = c["seed_val"][0]
+            S = sp.shape[0]
             rows = jnp.arange(S)
-            # Phase 1: restricted BF inside each source's cell.
-            d0 = jnp.full((S, 1, c_max), _INF)
-            d0 = d0.at[rows, 0, src_local].set(0.0)
-            local = _relax_cells(ces[p_s], cer[p_s], cew[p_s], d0,
-                                 c_max=c_max, max_iters=cell_iters)[:, 0]
-            # Phase 2: overlay BF seeded with the cell-exit distances.
-            seed = jnp.take_along_axis(local, bl[p_s], axis=1)   # (S, b_max)
-            ovl0 = jnp.full((S, B + 1), _INF)
-            ovl0 = ovl0.at[rows[:, None], cbo[p_s]].min(seed)
-            ovl, _ = relax_from(ovl_s, ovl_r, ovl_w, ovl0[:, :B],
-                                n_nodes=B, max_iters=ovl_iters)
-            ovl_pad = jnp.concatenate([ovl, jnp.full((S, 1), _INF)], axis=1)
-            # Phase 3: stitch through the tables, accumulating over the
-            # boundary axis so no (S, P, b, c) tensor ever materializes.
+            d0 = jnp.full((S, l.c_max + 1), _INF)
+            d0 = d0.at[rows[:, None], sp].min(sv)[:, :l.c_max]
+            local = _relax_ell(l.d_ell_s[p], l.d_ell_w[p], l.d_ell_r[p],
+                               d0, c_max=l.c_max,
+                               max_iters=l.c_max + _K_SWEEPS)
+            return {**c, "local0": local}
 
-            def body(b, acc):
-                o_b = ovl_pad[:, cbo[:, b]]                       # (S, P)
-                return jnp.minimum(acc, o_b[:, :, None] + table[None, :, b, :])
+        def make_ascend(k: int):
+            lp, l = lvls[k - 1], lvls[k]
 
-            acc = jax.lax.fori_loop(
-                0, b_max, body, jnp.full((S, P, c_max), _INF))
-            flat = acc.reshape(S, P * c_max)
-            # Fold in phase 1 (the only candidate for paths that never
-            # leave the source cell); layout is already cell-major, so
-            # the final answer is one gather, not a scatter.
-            pos = (p_s * c_max)[:, None] + jnp.arange(c_max)[None, :]
-            flat = flat.at[rows[:, None], pos].min(local)
-            # Unreachable sums overflow f32 (3e38 + 3e38 = inf); clamp
-            # back to the finite sentinel so downstream slack arithmetic
-            # (tight_pred) never sees inf - inf = nan.
-            return jnp.minimum(flat[:, perm_of_node], _INF)
+            def ascend(c: Dict) -> Dict:
+                p_prev = c["p_cells"][k - 1]
+                p = c["p_cells"][k]
+                local_prev = c[f"local{k - 1}"]
+                S = local_prev.shape[0]
+                rows = jnp.arange(S)
+                seed = jnp.take_along_axis(local_prev, lp.d_bl[p_prev],
+                                           axis=1)
+                pos = l.d_local_pad[lp.d_cbo[p_prev]]
+                d0 = jnp.full((S, l.c_max + 1), _INF)
+                d0 = d0.at[rows[:, None], pos].min(seed)
+                # Chain-interior sources whose second endpoint lands in
+                # a different cell below this level enter here.
+                d0 = d0.at[rows[:, None], c["seed_pos"][k]].min(
+                    c["seed_val"][k])
+                d0 = d0[:, :l.c_max]
+                local = _relax_ell(l.d_ell_s[p], l.d_ell_w[p], l.d_ell_r[p],
+                                   d0, c_max=l.c_max,
+                                   max_iters=l.c_max + _K_SWEEPS)
+                return {**c, f"local{k}": local}
+
+            return ascend
+
+        def top_bf(c: Dict) -> Dict:
+            l = lvls[L - 1]
+            p = c["p_cells"][L - 1]
+            local = c[f"local{L - 1}"]
+            S = local.shape[0]
+            rows = jnp.arange(S)
+            seed = jnp.take_along_axis(local, l.d_bl[p], axis=1)
+            ovl0 = jnp.full((S, Bt + 1), _INF)
+            ovl0 = ovl0.at[rows[:, None], l.d_cbo[p]].min(seed)
+            ovl0 = ovl0.at[rows[:, None], c["seed_pos"][L]].min(
+                c["seed_val"][L])
+            ovl, _ = relax_from(top_s, top_r, top_w, ovl0[:, :Bt],
+                                n_nodes=Bt, max_iters=Bt + _K_SWEEPS)
+            return {**c, "ovl": ovl}
+
+        def make_descend(k: int):
+            l = lvls[k]
+
+            def descend(c: Dict) -> Dict:
+                p = c["p_cells"][k]
+                local = c[f"local{k}"]
+                ovl = c["ovl"]
+                S = ovl.shape[0]
+                rows = jnp.arange(S)
+                ovl_pad = jnp.concatenate(
+                    [ovl, jnp.full((S, 1), _INF)], axis=1)
+                parts = []
+                for lo, hi, bb in l.tiers:
+                    cbo_t = l.d_cbo[lo:hi]
+                    tab_t = l.d_table[lo:hi]
+
+                    def body(b, acc, cbo_t=cbo_t, tab_t=tab_t):
+                        o_b = ovl_pad[:, cbo_t[:, b]]       # (S, tier)
+                        return jnp.minimum(
+                            acc, o_b[:, :, None] + tab_t[None, :, b, :])
+
+                    parts.append(jax.lax.fori_loop(
+                        0, bb, body,
+                        jnp.full((S, hi - lo, l.c_max), _INF)))
+                acc = (jnp.concatenate(parts, axis=1)
+                       if len(parts) > 1 else parts[0])
+                flat = acc.reshape(S, l.n_cells * l.c_max)
+                # Fold in the ascend local (the only candidate for paths
+                # that never leave the source's cell at this level);
+                # layout is already cell-major, so the final answer is
+                # one gather, not a scatter.
+                pos = (p * l.c_max)[:, None] + jnp.arange(l.c_max)[None, :]
+                flat = flat.at[rows[:, None], pos].min(local)
+                # Unreachable sums overflow f32 (3e38 + 3e38 = inf);
+                # clamp back to the finite sentinel so downstream slack
+                # arithmetic (tight_pred) never sees inf - inf = nan.
+                return {**c, "ovl": jnp.minimum(flat[:, l.d_perm], _INF)}
+
+            return descend
+
+        def expand(c: Dict) -> Dict:
+            ovl = c["ovl"]                        # (S, n_contracted)
+            S = ovl.shape[0]
+            pad = jnp.concatenate([ovl, jnp.full((S, 1), _INF)], axis=1)
+            return {**c, "ovl": pad[:, self._d_expand]}
+
+        stages: List[Tuple[str, object]] = [("phase1", phase1)]
+        for k in range(1, L):
+            stages.append((f"ascend_l{k + 1}", make_ascend(k)))
+        stages.append(("top_bf", top_bf))
+        for k in range(L - 1, -1, -1):
+            stages.append((f"descend_l{k + 1}", make_descend(k)))
+        if self._contracted:
+            stages.append(("expand", expand))
+        return stages
+
+    def _build_query(self):
+        stages = self._stages()
+
+        def query(p_cells: jax.Array, seed_pos: jax.Array,
+                  seed_val: jax.Array) -> jax.Array:
+            carry = {"p_cells": p_cells, "seed_pos": seed_pos,
+                     "seed_val": seed_val}
+            for _name, fn in stages:
+                carry = fn(carry)
+            return carry["ovl"]
 
         return query
 
-    def prep_sources(self, sources: np.ndarray) -> Tuple[jax.Array, jax.Array]:
-        """(S,) global source nodes → the ``query_fn`` argument pair
-        (source cell ids, source cell-local ids). The ONE place the
-        source encoding lives — every query goes through it."""
+    def timed_query(self, sources: np.ndarray
+                    ) -> Tuple[np.ndarray, Dict[str, float]]:
+        """(S, N) distances + per-stage wall milliseconds, each stage
+        its own jitted dispatch (bench instrumentation — serving uses
+        the fused ``query_fn``). Stage jits are cached on the index so
+        repeat calls measure warm execution, not tracing."""
+        if self._stage_jits is None:
+            self._stage_jits = [(name, jax.jit(fn))
+                                for name, fn in self._stages()]
+        p_cells, seed_pos, seed_val = self.prep_sources(np.asarray(sources))
+        carry = {"p_cells": p_cells, "seed_pos": seed_pos,
+                 "seed_val": seed_val}
+        phases: Dict[str, float] = {}
+        for name, fn in self._stage_jits:
+            t0 = time.perf_counter()
+            carry = fn(carry)
+            jax.block_until_ready(carry)
+            phases[name] = round(1000 * (time.perf_counter() - t0), 2)
+        return np.asarray(carry["ovl"]), phases
+
+    def prep_sources(self, sources: np.ndarray
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """(S,) global source nodes → the ``query_fn`` argument triple:
+        (L, S) per-level cell ids of each source's PRIMARY seed, plus
+        (L+1, S, 2) seed positions / values. The ONE place the source
+        encoding lives — every query goes through it.
+
+        A contracted (kept) source is one zero-weight seed in its own
+        level-1 cell. A chain-interior source becomes ≤2 (endpoint,
+        along-chain offset) seeds; each enters the query at the FIRST
+        level whose cell contains both it and the primary — nesting
+        guarantees a seed that differs below level k is a level-k
+        boundary node, so the entry position always exists (the top
+        row of ``seed_pos`` holds raw overlay ids)."""
         sources = np.asarray(sources, np.int64)
-        return (jnp.asarray(self.cell[sources]),
-                jnp.asarray(self.local_of_node[sources]))
+        S = len(sources)
+        L = self.n_levels
+        sn = self._seed_node[sources]            # (S, 2) contracted ids
+        sw = self._seed_w[sources]               # (S, 2)
+        primary = np.maximum(sn[:, 0], 0)
+        p_cells = np.stack([lvl.src_cell[primary].astype(np.int64)
+                            for lvl in self.levels])
+        seed_pos = np.empty((L + 1, S, 2), np.int32)
+        seed_val = np.full((L + 1, S, 2), _INF_NP, np.float32)
+        for k, lvl in enumerate(self.levels):
+            seed_pos[k] = lvl.c_max              # dump slot
+        seed_pos[L] = self.n_top
+        for j in (0, 1):
+            cv = sn[:, j]
+            cvs = np.maximum(cv, 0)
+            remaining = cv >= 0
+            for k, lvl in enumerate(self.levels):
+                g = self._gk[k][cvs]
+                ok = (remaining & (lvl.src_cell[cvs] == p_cells[k])
+                      & (g >= 0))
+                pos = lvl.local_of_node[np.maximum(g, 0)]
+                seed_pos[k][ok, j] = pos[ok]
+                seed_val[k][ok, j] = sw[ok, j]
+                remaining &= ~ok
+            g = self._gk[L][cvs]
+            ok = remaining & (g >= 0)
+            seed_pos[L][ok, j] = g[ok]
+            seed_val[L][ok, j] = sw[ok, j]
+        return (jnp.asarray(p_cells.astype(np.int32)),
+                jnp.asarray(seed_pos), jnp.asarray(seed_val))
+
+
+def build_params() -> Dict:
+    """The env-tunable knobs that change a BUILT overlay's content for
+    the same graph — part of the cache key, so flipping a knob can
+    never serve a payload built under the old one."""
+    try:
+        ratio = int(os.environ.get("ROUTEST_HIER_RATIO", "16") or 16)
+    except ValueError:
+        ratio = 16
+    try:
+        max_levels = int(os.environ.get("ROUTEST_HIER_MAX_LEVELS", "0") or 0)
+    except ValueError:
+        max_levels = 0
+    try:
+        cell_target = int(
+            os.environ.get("ROUTEST_HIER_CELL_TARGET", "0") or 0)
+    except ValueError:
+        cell_target = 0
+    return {"prune_slack": _prune_slack(), "ratio": ratio,
+            "max_levels": max_levels, "cell_target": cell_target,
+            "contract": _contract_interior()}
+
+
+def _fingerprint_digest(fingerprint: Dict) -> str:
+    """Short stable content hash of the graph fingerprint AND the
+    build knobs — the cache FILENAME key, so ``ls`` on the cache dir
+    maps files to graphs and a changed extract (or changed build
+    parameters) changes the name (the embedded copy still guards
+    against collisions/tampering by content)."""
+    blob = json.dumps({"fp": fingerprint, "params": build_params()},
+                      sort_keys=True).encode()
+    return hashlib.blake2b(blob, digest_size=10).hexdigest()
 
 
 def hier_cache_path(fingerprint: Dict) -> Optional[str]:
     """Where this graph's overlay payload caches, or None when caching
     is off (``ROUTEST_HIER_CACHE=0``; a path value overrides the
-    per-user secure default). Keyed by the same graph fingerprint that
-    gates learned leg models, so a changed extract can never be served
-    a stale overlay — and the payload format is npz with pickling
-    disabled, so a poisoned cache can at worst fail to load (callers
-    rebuild)."""
+    per-user secure default). Keyed by a content hash of the same graph
+    fingerprint that gates learned leg models, so a changed extract can
+    never be served a stale overlay — and the payload format is npz
+    with pickling disabled, so a poisoned cache can at worst fail to
+    load (callers rebuild)."""
     knob = os.environ.get("ROUTEST_HIER_CACHE", "")
     if knob.lower() in ("0", "off", "false", "no"):
         return None
@@ -576,7 +1397,7 @@ def hier_cache_path(fingerprint: Dict) -> Optional[str]:
         base = secure_user_cache_dir("routest-hier")
         if base is None:
             return None
-    key = "-".join(str(fingerprint[k]) for k in sorted(fingerprint))
+    key = _fingerprint_digest(fingerprint)
     return os.path.join(base, f"hier-v{_CACHE_VERSION}-{key}.npz")
 
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -45,12 +46,43 @@ from routest_tpu.optimize.hierarchy import (
     HierarchicalIndex,
     hier_cache_path,
     hier_min_nodes,
+    polish,
     relax_from,
     tight_pred,
 )
 from routest_tpu.utils.logging import get_logger
 
 _INF = jnp.float32(3e38)
+
+_metrics = None
+
+
+def _router_metrics():
+    """Process-registry families for the router hot path, created
+    lazily (importing the obs registry at module import would make the
+    optimizer depend on serving wiring). Phase labels: ``snap``
+    (lat/lon → node), ``solve`` (the fused device program incl. fetch),
+    ``matrix`` (device duration table), ``walk`` (host predecessor
+    walk per leg) — histogram exemplars link a slow solve to its trace
+    id like every other stage histogram."""
+    global _metrics
+    if _metrics is None:
+        from routest_tpu.obs import get_registry
+
+        reg = get_registry()
+        _metrics = {
+            "phase": reg.histogram(
+                "rtpu_router_phase_seconds",
+                "Road-router request-path phase latency.", ("phase",)),
+            "info": reg.gauge(
+                "rtpu_router_overlay_info",
+                "Overlay build stats by level and stat.",
+                ("level", "stat")),
+            "build": reg.gauge(
+                "rtpu_router_overlay_build_seconds",
+                "Overlay precompute seconds by level.", ("level",)),
+        }
+    return _metrics
 
 
 @functools.partial(jax.jit, static_argnames=("n_rounds",))
@@ -104,10 +136,16 @@ def _time_table(bf_senders: jax.Array, pred: jax.Array, time_bf: jax.Array,
 
 # Flat-relaxation sweeps run over hierarchy distances before
 # predecessor recovery: the overlay's re-associated sums round a few
-# ulps away from the sweep's own ``dist[s] + w`` assignments; a handful
-# of sweeps re-anchors ties near-bitwise (values are already exact, so
-# these are O(1), not O(diameter)).
-_POLISH_SWEEPS = 8
+# ulps away from the sweep's own ``dist[s] + w`` assignments; a couple
+# of UNROLLED sweeps re-anchor ties near-bitwise (values are already
+# exact, so these are O(1), not O(diameter)) — each sweep is a full
+# (S, N)×E pass, so the count is a first-order term in metro warm
+# latency (8 sweeps cost ~700 ms of the 250k solve on one core).
+def _polish_sweeps() -> int:
+    try:
+        return max(1, int(os.environ.get("ROUTEST_POLISH_SWEEPS", "2")))
+    except ValueError:
+        return 2
 
 
 @functools.partial(jax.jit, static_argnames=("n_nodes", "max_iters"))
@@ -221,6 +259,8 @@ class RoadRouter:
                     self.coords, self.senders, self.receivers,
                     self.length_m, cache_path=cache,
                     fingerprint=self._fingerprint)
+        self._aot: Dict[int, object] = {}
+        self._aot_compile_s = 0.0
         if self._hier is not None:
             # Overlay query + polish sweeps + predecessor recovery
             # fused into ONE jitted program: a warm solve is a single
@@ -229,19 +269,48 @@ class RoadRouter:
             # measured), which dominated metro-scale warm latency; it
             # also collapses three per-bucket compiles into one.
             hier = self._hier
+            # Polish must run at least ``interior_cap`` sweeps: it is
+            # what re-derives chain-interior distances from the
+            # contracted overlay solution (every interior node is ≤ cap
+            # hops downstream of a solved node).
+            n_sweeps = max(_polish_sweeps(),
+                           hier.stats.get("contraction",
+                                          {}).get("interior_cap", 0))
 
             @jax.jit
-            def _overlay_solve(p_s, src_local, padded_d):
-                dist = hier.query_fn(p_s, src_local)
-                dist, _ = relax_from(
+            def _overlay_solve(p_cells, seed_pos, seed_val, padded_d):
+                dist = hier.query_fn(p_cells, seed_pos, seed_val)
+                # A chain-interior source's own row re-seeds at 0 so the
+                # polish sweeps fill its own chain (its overlay seeds
+                # carried the along-chain offsets, not the origin).
+                dist = dist.at[jnp.arange(dist.shape[0]),
+                               padded_d].min(0.0)
+                dist = polish(
                     self._bf_senders, self._bf_receivers, self._bf_length,
-                    dist, n_nodes=self.n_nodes, max_iters=_POLISH_SWEEPS)
+                    dist, n_nodes=self.n_nodes, n_sweeps=n_sweeps)
                 pred = tight_pred(
                     self._bf_senders, self._bf_receivers, self._bf_length,
                     dist, padded_d, n_nodes=self.n_nodes)
                 return dist, pred
 
             self._overlay_solve = _overlay_solve
+            # AOT-compile the query entry per (graph, overlay) shape at
+            # init (``jit(...).lower().compile()``): warm latency then
+            # excludes dispatch/trace overhead and the FIRST request of
+            # a replica's life stops paying the multi-second trace +
+            # compile (4.8 s recorded at 250k). With the persistent XLA
+            # compile cache on, the executable round-trips disk across
+            # processes, so a fleet boot pays it once per machine.
+            t0 = time.perf_counter()
+            L = self._hier.n_levels
+            for bucket in self._aot_buckets():
+                spec = (jnp.zeros((L, bucket), jnp.int32),
+                        jnp.zeros((L + 1, bucket, 2), jnp.int32),
+                        jnp.zeros((L + 1, bucket, 2), jnp.float32),
+                        jnp.zeros((bucket,), jnp.int32))
+                self._aot[bucket] = _overlay_solve.lower(*spec).compile()
+            self._aot_compile_s = round(time.perf_counter() - t0, 3)
+            self._publish_overlay_metrics()
         # Learned leg costs: load the trained road-GNN when its training
         # graph fingerprint matches this router's node set.
         self._hour_times: Dict[int, np.ndarray] = {}
@@ -270,6 +339,47 @@ class RoadRouter:
         self._model_gen = 0  # bumped per swap: stale cache writes discard
         self._maybe_reload_models()
 
+    @staticmethod
+    def _aot_buckets() -> List[int]:
+        """Source-bucket sizes to AOT-compile at init.
+        ``ROUTEST_ROUTER_AOT``: "auto" (default — the serving
+        point-to-point bucket and the bench/matrix 16-waypoint bucket),
+        "off"/"0" to disable, or a comma list of waypoint counts
+        (rounded up to their power-of-two buckets)."""
+        raw = os.environ.get("ROUTEST_ROUTER_AOT", "auto").strip().lower()
+        if raw in ("", "0", "off", "false", "no"):
+            return []
+        if raw == "auto":
+            return [2, 16]
+        out = set()
+        for tok in raw.split(","):
+            tok = tok.strip()
+            if tok.isdigit() and int(tok) > 0:
+                out.add(1 << max(0, (int(tok) - 1).bit_length()))
+        return sorted(out)
+
+    def _publish_overlay_metrics(self) -> None:
+        """Overlay build stats → the process registry: per-level
+        ``rtpu_router_overlay_info{level, stat}`` gauges plus
+        ``rtpu_router_overlay_build_seconds{level}`` — the provenance a
+        dashboard (or a postmortem bundle) reads without a /api/health
+        round trip."""
+        if self._hier is None:
+            return
+        m = _router_metrics()
+        for lvl in self._hier.stats.get("levels", []):
+            level = str(lvl.get("level", 1))
+            for stat in ("n_cells", "c_max", "b_max", "n_overlay_nodes",
+                         "n_overlay_edges", "clique_edges_kept",
+                         "clique_edges_pruned"):
+                if stat in lvl:
+                    m["info"].labels(level=level, stat=stat).set(lvl[stat])
+            m["build"].labels(level=level).set(lvl.get("build_s", 0.0))
+        m["info"].labels(level="top", stat="n_overlay_nodes").set(
+            self._hier.stats.get("top_nodes", 0))
+        m["info"].labels(level="top", stat="n_overlay_edges").set(
+            self._hier.stats.get("top_edges", 0))
+
     @property
     def leg_cost_model(self) -> str:
         """"gnn" when learned per-edge times serve requests, else
@@ -280,9 +390,21 @@ class RoadRouter:
     def solver_info(self) -> Dict:
         """Which shortest-path regime serves this graph, with the
         overlay's build stats when the partition hierarchy is active —
-        ONE shape shared by the health gauge and the scale benchmark."""
+        ONE shape shared by the health gauge and the scale benchmark.
+        ``overlay.levels`` carries the per-level breakdown,
+        ``overlay.loaded_from_cache``/``cache_version`` the provenance,
+        ``aot_buckets`` the solve shapes compiled at init."""
         if self._hier is not None:
-            return {"solver": "hierarchy", "overlay": dict(self._hier.stats)}
+            from routest_tpu.optimize.hierarchy import _CACHE_VERSION
+
+            info = {"solver": "hierarchy",
+                    "overlay": dict(self._hier.stats)}
+            info["overlay"].setdefault("loaded_from_cache", False)
+            info["overlay"]["cache_version"] = _CACHE_VERSION
+            info["aot_buckets"] = sorted(self._aot)
+            if self._aot:
+                info["aot_compile_s"] = self._aot_compile_s
+            return info
         return {"solver": "flat_bf", "max_iters_bound": self.max_iters}
 
     def graph_dict(self) -> Dict[str, np.ndarray]:
@@ -534,14 +656,20 @@ class RoadRouter:
         padded = np.full(bucket, source_nodes[0] if n_src else 0, np.int32)
         padded[:n_src] = source_nodes
         if self._hier is not None:
-            # Overlay path: exact distances in O(cells-across) sweeps,
-            # then a few polish sweeps so the tight-edge recovery sees
-            # the flat relaxation's own tie structure. Convergence is
-            # guaranteed by construction (the overlay loop's bound is
-            # its exact node count), so no exhaustion re-run exists.
-            p_s, src_local = self._hier.prep_sources(padded)
-            dist, pred = jax.device_get(self._overlay_solve(
-                p_s, src_local, jnp.asarray(padded)))
+            # Overlay path: exact distances in O(top-cells-across)
+            # sweeps, then a couple of polish sweeps so the tight-edge
+            # recovery sees the flat relaxation's own tie structure.
+            # Convergence is guaranteed by construction (the overlay
+            # loop's bound is its exact node count), so no exhaustion
+            # re-run exists. Buckets AOT-compiled at init dispatch the
+            # ready executable directly.
+            t0 = time.perf_counter()
+            p_cells, seed_pos, seed_val = self._hier.prep_sources(padded)
+            solve = self._aot.get(bucket, self._overlay_solve)
+            dist, pred = jax.device_get(solve(
+                p_cells, seed_pos, seed_val, jnp.asarray(padded)))
+            _router_metrics()["phase"].labels(phase="solve").observe(
+                time.perf_counter() - t0)
             pred = pred[:n_src]
             pred = np.where(pred >= 0, self._bf_perm[np.maximum(pred, 0)], -1)
             return dist[:n_src], pred
@@ -549,6 +677,7 @@ class RoadRouter:
         # np.asarray fetches each pay a full tunnel round trip (~70 ms),
         # which dominated small-graph request latency (252 → 102 ms
         # measured on the 2k serving graph).
+        t0 = time.perf_counter()
         dist, pred, converged = jax.device_get(_bellman_ford(
             self._bf_senders, self._bf_receivers, self._bf_length,
             jnp.asarray(padded),
@@ -566,6 +695,8 @@ class RoadRouter:
                 self._bf_senders, self._bf_receivers, self._bf_length,
                 jnp.asarray(padded),
                 n_nodes=self.n_nodes, max_iters=self.n_nodes))
+        _router_metrics()["phase"].labels(phase="solve").observe(
+            time.perf_counter() - t0)
         pred = pred[:n_src]
         # sorted-edge ids → original edge ids (RoadLegs/_walk index the
         # original arrays, which also carry the GNN's per-edge times)
@@ -631,10 +762,13 @@ class RoadRouter:
         # snap() materializes an (M, N) haversine table — chunk its row
         # axis too, or a full road batch on a country-scale graph would
         # build the multi-GB host tensor the solve grouping avoids.
+        t0 = time.perf_counter()
         snap_chunk = max(1, (16 << 20) // max(self.n_nodes, 1))
         all_nodes = np.concatenate([
             self.snap(all_pts[i:i + snap_chunk])
             for i in range(0, len(all_pts), snap_chunk)])
+        _router_metrics()["phase"].labels(phase="snap").observe(
+            time.perf_counter() - t0)
         # First/last mile: the request point is rarely ON the network;
         # charge the point↔snapped-node gap into every leg (at collector
         # free-flow for the duration) so far-off-network points see
@@ -724,8 +858,11 @@ class RoadLegs:
         cached = self._cost_memo.get((i, j))
         if cached is not None:
             return cached
+        t0 = time.perf_counter()
         node_seq = self._r._walk(self._pred[i], int(self._nodes[i]),
                                  int(self._nodes[j]))
+        _router_metrics()["phase"].labels(phase="walk").observe(
+            time.perf_counter() - t0)
         if not node_seq:
             out = ([], float("inf"), float("inf"))
         else:
@@ -887,6 +1024,7 @@ class RoadLegs:
         the walk to f32 rounding (sums re-associate). Computed lazily,
         once per solve."""
         if self._dur_rows is None:
+            t0 = time.perf_counter()
             r = self._r
             n_rounds = max(1, (max(r.n_nodes - 1, 1)).bit_length())
             # Same bucket trick as shortest(): pad the waypoint axis to
@@ -901,6 +1039,8 @@ class RoadLegs:
                 jnp.asarray(self._time_s),
                 jnp.asarray(np.pad(self._dist_rows, pad, mode="edge")),
                 n_rounds=n_rounds))[:m]
+            _router_metrics()["phase"].labels(phase="matrix").observe(
+                time.perf_counter() - t0)
         dur = self._dur_rows[:, self._nodes].astype(np.float64)
         dur = self._time_scale * (
             dur + (self._snap_m[:, None] + self._snap_m[None, :])

@@ -1,14 +1,27 @@
-"""Metro-scale OSM ingest + routing benchmark → artifacts/osm_scale.json.
+"""Metro-scale OSM ingest + routing curve → artifacts/osm_scale.json.
 
 The OSM path (``data/osm.py`` → ``RoadRouter``) was proven on an
 18-node fixture; this script proves it at city scale without shipping a
-licensed extract: generate a metro-sized street network, WRITE it as
-OSM XML (``save_osm``), then ingest it back through the exact parser a
-real extract would use and route over it. Reported: parse time, router
-build time, cold/warm 16-waypoint solve — the numbers that decide
-whether a deploy can point ``ROAD_GRAPH_OSM`` at a city.
+licensed extract: per size it generates a metro street network with OSM
+topology (degree-2 bend chains + one-ways via ``subdivide_graph``),
+WRITES it as OSM XML (``save_osm``), ingests it back through the exact
+parser a real extract would use, and routes over it. Per row it
+records:
 
-Usage: python scripts/bench_osm_scale.py [--nodes 8192] [--cpu]
+- parse + router-build time, with the overlay build broken down per
+  level (partition / contraction / per-level precompute),
+- cold and warm 16-waypoint solves, plus the warm solve's PER-PHASE
+  breakdown (``HierarchicalIndex.timed_query``: in-cell phase 1,
+  per-level ascends, top overlay BF, per-level descend stitches,
+  chain expansion) so a future regression localizes to a phase
+  instead of a single opaque ``solve_warm_ms``,
+- the full matrix operation (solve + M×M distances AND durations —
+  the ORS-comparable call), and
+- oracle parity vs a float64 scipy Dijkstra (disagreement in EITHER
+  direction on reachability is a failure).
+
+Usage: python scripts/bench_osm_scale.py [--sizes 50000 100000 250000]
+       [--quick] [--cpu] [--no-verify] [--out artifacts/osm_scale.json]
 (…then ``python scripts/train_gnn.py --osm <written path>`` trains the
 learned leg costs on the same extract.)
 """
@@ -24,15 +37,118 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _verify(router, nodes, dist, np):
+    """Max relative error vs a float64 Dijkstra oracle (scipy)."""
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import dijkstra
+
+    n = router.n_nodes
+    adj = sp.coo_matrix(
+        (router.length_m, (router.senders, router.receivers)),
+        shape=(n, n)).tocsr()
+    want = dijkstra(adj, directed=True, indices=np.asarray(nodes, np.int64))
+    finite = np.isfinite(want)
+    if (dist[finite] > 1e37).any() or (dist[~finite] < 1e37).any():
+        return float("inf")
+    err = np.abs(dist[finite] - want[finite]) / np.maximum(want[finite], 1.0)
+    return float(err.max())
+
+
+def bench_size(n_nodes: int, waypoints: int, verify: bool, np, rng) -> dict:
+    from routest_tpu.data.osm import load_osm, save_osm
+    from routest_tpu.data.road_graph import generate_road_graph, subdivide_graph
+    from routest_tpu.optimize.road_router import RoadRouter
+
+    # intersections + 2 bends/street ≈ 5.86 nodes per intersection for
+    # the k=4 kNN street graph (same constant as bench_router_scale).
+    n_int = max(1024, int(n_nodes / 5.86))
+    t0 = time.perf_counter()
+    base = generate_road_graph(n_nodes=n_int, k=4, seed=0)
+    streets = subdivide_graph(base, bends_per_edge=2, oneway_frac=0.1, seed=0)
+    gen_s = time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "metro.osm.gz")
+        t0 = time.perf_counter()
+        save_osm(path, streets)
+        write_s = time.perf_counter() - t0
+        size_mb = os.path.getsize(path) / 1e6
+        t0 = time.perf_counter()
+        extract = load_osm(path)
+        parse_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    router = RoadRouter(graph=extract, use_gnn=False, use_transformer=False)
+    build_s = time.perf_counter() - t0
+
+    pts = np.stack([
+        rng.uniform(14.40, 14.68, waypoints),
+        rng.uniform(120.96, 121.10, waypoints),
+    ], axis=1).astype(np.float32)
+    nodes = router.snap(pts)
+
+    t0 = time.perf_counter()
+    dist, _ = router.shortest(nodes)
+    cold_ms = 1000 * (time.perf_counter() - t0)
+    warm = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dist, _ = router.shortest(nodes)
+        warm.append(1000 * (time.perf_counter() - t0))
+    warm_ms = min(warm)
+
+    # Per-phase breakdown of the warm query (own dispatches; the fused
+    # serving program is what cold/warm above measure).
+    phases = {}
+    if router._hier is not None:
+        router._hier.timed_query(np.asarray(nodes, np.int32))  # warm jits
+        _, phases = router._hier.timed_query(np.asarray(nodes, np.int32))
+
+    # Full matrix op: solve + M×M distance and duration matrices,
+    # exactly as /api/matrix serves them (min-of-3, fresh RoadLegs).
+    matrix_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        legs = router.route_legs(pts, 1.0, hour=8)
+        legs.duration_matrix()
+        matrix_times.append(time.perf_counter() - t0)
+
+    row = {
+        "nodes": int(router.n_nodes),
+        "edges": int(len(router.senders)),
+        "waypoints": waypoints,
+        "extract_mb": round(size_mb, 2),
+        "generate_s": round(gen_s, 2),
+        "write_s": round(write_s, 2),
+        "parse_s": round(parse_s, 2),
+        "router_build_s": round(build_s, 2),
+        "solve_cold_ms": round(cold_ms, 1),
+        "solve_warm_ms": round(warm_ms, 1),
+        "matrix_warm_ms": round(1000 * min(matrix_times), 1),
+        "reachable_frac": round(float((dist < 1e37).mean()), 4),
+        "query_phases_ms": phases,
+        **router.solver_info,
+    }
+    if verify:
+        row["oracle_max_rel_err"] = _verify(router, nodes, dist, np)
+    return row
+
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--nodes", type=int, default=8192)
+    parser.add_argument("--sizes", type=int, nargs="+",
+                        default=[50_000, 100_000, 250_000])
+    parser.add_argument("--quick", action="store_true",
+                        help="small curve for the slow-marked test "
+                             "(20k/50k, still multi-level at the top)")
     parser.add_argument("--waypoints", type=int, default=16)
-    parser.add_argument("--keep", metavar="PATH", default=None,
-                        help="also write the generated extract here "
-                             "(e.g. to feed train_gnn --osm)")
+    parser.add_argument("--no-verify", action="store_true",
+                        help="skip the scipy Dijkstra oracle per row")
     parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--out", default=None)
     args = parser.parse_args()
     if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
         flags = os.environ.get("XLA_FLAGS", "")
@@ -47,73 +163,59 @@ def main() -> None:
     import numpy as np
 
     from routest_tpu.core.cache import enable_compile_cache
-    from routest_tpu.data.osm import load_osm, save_osm
-    from routest_tpu.data.road_graph import generate_road_graph
-    from routest_tpu.optimize.road_router import RoadRouter
 
     enable_compile_cache()
-    backend = jax.default_backend()
-    print(f"[1/4] generating {args.nodes}-node street network…")
-    graph = generate_road_graph(n_nodes=args.nodes, seed=0)
+    sizes = [20_000, 50_000] if args.quick else args.sizes
+    rng = np.random.default_rng(7)
+    rows = []
+    for n in sizes:
+        print(f"[{n:,} nodes] generating + ingesting…", flush=True)
+        row = bench_size(n, args.waypoints, not args.no_verify, np, rng)
+        rows.append(row)
+        print(f"  {row['nodes']:>9,} nodes {row['edges']:>9,} edges | "
+              f"build {row['router_build_s']}s | cold "
+              f"{row['solve_cold_ms']}ms warm {row['solve_warm_ms']}ms "
+              f"matrix {row['matrix_warm_ms']}ms"
+              + (f" | oracle {row.get('oracle_max_rel_err'):.2e}"
+                 if "oracle_max_rel_err" in row else ""), flush=True)
+        if row.get("query_phases_ms"):
+            print(f"  phases: {json.dumps(row['query_phases_ms'])}",
+                  flush=True)
 
-    path = args.keep or os.path.join(tempfile.gettempdir(),
-                                     f"metro_{args.nodes}.osm.gz")
-    t0 = time.time()
-    save_osm(path, graph)
-    write_s = time.time() - t0
-    size_mb = os.path.getsize(path) / 1e6
-    print(f"      extract → {path} ({size_mb:.1f} MB, {write_s:.1f}s)")
-
-    print("[2/4] ingesting through the OSM parser…")
-    t0 = time.time()
-    loaded = load_osm(path)
-    parse_s = time.time() - t0
-    n_edges = len(loaded["senders"])
-    print(f"      {len(loaded['node_coords'])} nodes / {n_edges} edges "
-          f"in {parse_s:.1f}s")
-
-    print("[3/4] building router (bridging + device upload)…")
-    t0 = time.time()
-    router = RoadRouter(graph=loaded, use_gnn=False)
-    build_s = time.time() - t0
-
-    print(f"[4/4] {args.waypoints}-waypoint solves on {backend}…")
-    rng = np.random.default_rng(0)
-    lat = rng.uniform(14.40, 14.80, args.waypoints)
-    lon = rng.uniform(120.90, 121.15, args.waypoints)
-    pts = np.stack([lat, lon], axis=1).astype(np.float32)
-    t0 = time.time()
-    legs = router.route_legs(pts)
-    cold_ms = (time.time() - t0) * 1000
-    t0 = time.time()
-    legs = router.route_legs(pts + 1e-3)
-    warm_ms = (time.time() - t0) * 1000
-    finite = float(np.isfinite(legs.dist_m).mean())
-    print(f"      cold {cold_ms:.0f} ms, warm {warm_ms:.0f} ms, "
-          f"matrix finite {finite:.2f}")
-
+    try:
+        n_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:
+        n_cpus = os.cpu_count() or 1
     report = {
-        "backend": backend,
-        "extract": (args.keep if args.keep else "regenerate via --keep"),
-        "generator": f"routest_tpu.data.road_graph.generate_road_graph("
-                     f"n_nodes={args.nodes}, seed=0) via this script",
-        "nodes": int(router.n_nodes),
-        "edges": int(len(router.senders)),
-        "extract_mb": round(size_mb, 2),
-        "write_s": round(write_s, 2),
-        "parse_s": round(parse_s, 2),
-        "router_build_s": round(build_s, 2),
+        "backend": jax.default_backend(),
+        "host": {
+            "cpus": n_cpus,
+            "note": "wall times scale with host cores; the per-phase "
+                    "breakdown is the portable signal",
+        },
         "waypoints": args.waypoints,
-        "solve_cold_ms": round(cold_ms, 1),
-        "solve_warm_ms": round(warm_ms, 1),
-        "matrix_finite_frac": finite,
+        "rows": rows,
     }
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    out = os.path.join(repo, "artifacts", "osm_scale.json")
+    out = args.out or os.path.join(REPO, "artifacts", "osm_scale.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
-    print(f"      report → {out}")
-    sys.exit(0 if finite == 1.0 else 1)
+
+    print(f"\n| nodes | edges | solver | levels | warm solve | matrix | "
+          f"oracle err |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        ov = r.get("overlay", {})
+        err = r.get("oracle_max_rel_err")
+        print(f"| {r['nodes']:,} | {r['edges']:,} | {r['solver']} | "
+              f"{ov.get('n_levels', '-')} | {r['solve_warm_ms']} ms | "
+              f"{r['matrix_warm_ms']} ms | "
+              f"{(f'{err:.1e}' if err is not None else '-')} |")
+    print(f"\nbackend={report['backend']} cpus={n_cpus} → {out}")
+    bad = [r for r in rows
+           if r.get("oracle_max_rel_err", 0.0) > 1e-5
+           or r["reachable_frac"] < 0.99]
+    sys.exit(1 if bad else 0)
 
 
 if __name__ == "__main__":

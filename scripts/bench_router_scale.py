@@ -61,6 +61,12 @@ def _bench_router(router, args, np, rng):
     ], axis=1).astype(np.float32)
     nodes = router.snap(pts)
     dist, t_cold, t_warm = _time_solves(router, nodes)
+    phases = {}
+    if router._hier is not None:
+        # Per-phase breakdown (own dispatches — the fused program is
+        # what t_warm measures): regressions localize to a phase.
+        router._hier.timed_query(np.asarray(nodes, np.int32))
+        _, phases = router._hier.timed_query(np.asarray(nodes, np.int32))
     # Full matrix operation (the ORS-comparable call the reference
     # rents per optimize request): solve + the M x M distance AND
     # duration matrices, exactly as /api/matrix serves them (durations
@@ -73,7 +79,7 @@ def _bench_router(router, args, np, rng):
         legs = router.route_legs(pts, 1.0, hour=8)
         legs.duration_matrix()
         matrix_times.append(time.perf_counter() - t0)
-    return nodes, dist, t_cold, t_warm, min(matrix_times)
+    return nodes, dist, t_cold, t_warm, min(matrix_times), phases
 
 
 def _verify(router, nodes, dist, np):
@@ -132,7 +138,22 @@ def main() -> None:
                              "count (the diameter-bound sweep takes "
                              "minutes per solve there — the wall being "
                              "demonstrated)")
+    parser.add_argument("--ml-compare", action="store_true",
+                        help="for multi-level rows, also time a "
+                             "SINGLE-level overlay on the same graph "
+                             "(ROUTEST_HIER_MAX_LEVELS=1), recording "
+                             "single_level_warm_ms + multi_level_speedup")
+    parser.add_argument("--quick", action="store_true",
+                        help="small preset for the slow-marked test: "
+                             "one flat row, one overlay row with both "
+                             "comparisons, no committed-extract row")
     args = parser.parse_args()
+    if args.quick:
+        args.sizes = [2048, 24_000]
+        args.osm_nodes = 0
+        args.osm_file = "none"
+        args.flat_compare = True
+        args.ml_compare = True
     if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -151,11 +172,22 @@ def main() -> None:
     rows = []
     rng = np.random.default_rng(7)
 
+    def _with_env(key, value, fn):
+        old = os.environ.get(key)
+        os.environ[key] = value
+        try:
+            return fn()
+        finally:
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
     def run_case(graph, t_gen, topology):
         t0 = time.perf_counter()
         router = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
         t_init = time.perf_counter() - t0
-        nodes, dist, t_cold, t_warm, t_matrix = _bench_router(
+        nodes, dist, t_cold, t_warm, t_matrix, phases = _bench_router(
             router, args, np, rng)
         reach = float((dist < 1e37).mean())
         row = {
@@ -169,28 +201,34 @@ def main() -> None:
             "solve_warm_ms": round(1000 * t_warm, 1),
             "matrix_warm_ms": round(1000 * t_matrix, 1),
             "reachable_frac": round(reach, 4),
+            "query_phases_ms": phases,
             **router.solver_info,
         }
         if args.verify:
             row["oracle_max_rel_err"] = _verify(router, nodes, dist, np)
         if (args.flat_compare and row.get("solver") == "hierarchy"
                 and router.n_nodes <= args.flat_compare_max):
-            old = os.environ.get("ROUTEST_HIER_MIN_NODES")
-            os.environ["ROUTEST_HIER_MIN_NODES"] = "0"
-            try:
-                flat = RoadRouter(graph=graph, use_gnn=False,
-                                  use_transformer=False)
-            finally:
-                if old is None:
-                    os.environ.pop("ROUTEST_HIER_MIN_NODES", None)
-                else:
-                    os.environ["ROUTEST_HIER_MIN_NODES"] = old
+            flat = _with_env("ROUTEST_HIER_MIN_NODES", "0",
+                             lambda: RoadRouter(graph=graph, use_gnn=False,
+                                                use_transformer=False))
             _, _, flat_warm = _time_solves(flat, nodes)  # same waypoints
             row["flat_warm_ms"] = round(1000 * flat_warm, 1)
             row["overlay_speedup"] = round(flat_warm / max(t_warm, 1e-9), 1)
             print(f"      flat_bf same graph/backend: warm "
                   f"{row['flat_warm_ms']}ms → overlay speedup "
                   f"{row['overlay_speedup']}x", flush=True)
+        if (args.ml_compare and row.get("solver") == "hierarchy"
+                and row.get("overlay", {}).get("n_levels", 1) > 1):
+            single = _with_env("ROUTEST_HIER_MAX_LEVELS", "1",
+                               lambda: RoadRouter(graph=graph, use_gnn=False,
+                                                  use_transformer=False))
+            _, _, single_warm = _time_solves(single, nodes)
+            row["single_level_warm_ms"] = round(1000 * single_warm, 1)
+            row["multi_level_speedup"] = round(
+                single_warm / max(t_warm, 1e-9), 2)
+            print(f"      single-level same graph/backend: warm "
+                  f"{row['single_level_warm_ms']}ms → multi-level "
+                  f"speedup {row['multi_level_speedup']}x", flush=True)
         rows.append(row)
         print(f"  {row['nodes']:>7,} nodes {row['edges']:>9,} edges "
               f"[{topology}/{row['solver']}] | build {row['graph_build_s']}s "

@@ -1,0 +1,223 @@
+"""Metro routing as a SERVING workload → artifacts/router_serving.json.
+
+The scale bench (``bench_osm_scale.py``) proves the solver; this one
+proves the serving claim: a real fleet (supervisor + worker process +
+gateway) pointed at a metro-scale OSM extract (``ROAD_GRAPH_OSM``)
+answers ``/api/request_route`` with ``road_graph: true`` — street-
+network shortest paths through the multi-level partition overlay —
+under the open-loop load generator, with the SLO engine judging the
+result. Recorded: per-route CO-correct latency percentiles, the
+configured SLO latency threshold, and both tiers' SLO states; the run
+passes iff request_route p95 is inside the threshold and no SLO
+objective pages.
+
+The worker rehydrates the overlay from the shared
+``ROUTEST_HIER_CACHE`` dir (this process builds it first) and reuses
+this process's XLA compile cache, so replica boot measures cache-warm
+fleet bring-up — the deployment path, not a cold lab build.
+
+Usage: python scripts/bench_router_serving.py [--nodes 250000]
+       [--rps 1.0] [--duration 90] [--quick] [--slo-ms 2500]
+       [--out artifacts/router_serving.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODEL = os.path.join(REPO, "artifacts", "eta_mlp.msgpack")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def build_extract(n_nodes: int, out_dir: str) -> str:
+    """Generate the OSM-topology metro extract (same recipe as the
+    scale benches) and pre-build its overlay cache in-process."""
+    from routest_tpu.data.osm import load_osm, save_osm
+    from routest_tpu.data.road_graph import generate_road_graph, subdivide_graph
+    from routest_tpu.optimize.road_router import RoadRouter
+
+    n_int = max(1024, int(n_nodes / 5.86))
+    base = generate_road_graph(n_nodes=n_int, k=4, seed=0)
+    streets = subdivide_graph(base, bends_per_edge=2, oneway_frac=0.1, seed=0)
+    path = os.path.join(out_dir, f"metro_{n_nodes}.osm.gz")
+    save_osm(path, streets)
+    extract = load_osm(path)
+    t0 = time.perf_counter()
+    router = RoadRouter(graph=extract, use_gnn=False, use_transformer=False)
+    print(f"  overlay prebuilt in {time.perf_counter() - t0:.1f}s "
+          f"({router.n_nodes:,} nodes, "
+          f"{router.solver_info.get('overlay', {}).get('n_levels')} levels)",
+          flush=True)
+    return path
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=250_000)
+    parser.add_argument("--rps", type=float, default=1.0,
+                        help="offered open-loop arrival rate")
+    parser.add_argument("--duration", type=float, default=90.0)
+    parser.add_argument("--slo-ms", type=float, default=2500.0,
+                        help="request_route latency SLO threshold "
+                             "(registry bucket edges: 1000/2500/5000)")
+    parser.add_argument("--quick", action="store_true",
+                        help="50k extract, 45 s run — the slow-test "
+                             "preset")
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+    if args.quick:
+        args.nodes = min(args.nodes, 50_000)
+        args.duration = min(args.duration, 45.0)
+
+    os.environ.setdefault("ROUTEST_FORCE_CPU", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from routest_tpu.core.cache import enable_compile_cache
+    from routest_tpu.core.config import FleetConfig
+    from routest_tpu.loadgen import (MixedWorkload, RateCurve,
+                                     KeepAliveClient, poisson_schedule,
+                                     run_open_loop, summarize)
+    from routest_tpu.serve.fleet.gateway import Gateway
+    from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
+
+    work_dir = tempfile.mkdtemp(prefix="router-serving-")
+    hier_cache = os.path.join(work_dir, "hier")
+    xla_cache = os.path.join(work_dir, "xla")
+    os.environ["ROUTEST_HIER_CACHE"] = hier_cache
+    # Postmortem bundles from warm-phase SLO edges (the first road
+    # request pays the router build) belong to the run dir, not the
+    # repo's artifacts/.
+    os.environ["RTPU_RECORDER_DIR"] = os.path.join(work_dir, "postmortems")
+    enable_compile_cache(xla_cache)
+    slo_spec = (f"/api/request_route:latency_ms={args.slo_ms:.0f},"
+                f"latency_target=0.95,availability=0.99;"
+                f"/api/predict_eta:latency_ms=1000,latency_target=0.95,"
+                f"availability=0.999")
+    os.environ["RTPU_SLO_OBJECTIVES"] = slo_spec
+
+    print(f"[1/4] building {args.nodes:,}-node extract + overlay cache…",
+          flush=True)
+    extract = build_extract(args.nodes, work_dir)
+
+    print("[2/4] booting fleet (1 worker + gateway)…", flush=True)
+    env = dict(os.environ)
+    env.update({
+        "ROAD_GRAPH_OSM": extract,
+        "ROUTEST_HIER_CACHE": hier_cache,
+        "RTPU_COMPILE_CACHE": xla_cache,
+        "ROUTEST_MESH": "0",
+        "ROUTEST_WARM_BUCKETS": "0",
+        "ETA_MODEL_PATH": MODEL,
+        "RTPU_SLO_OBJECTIVES": slo_spec,
+    })
+    ports = [_free_port()]
+    sup = ReplicaSupervisor(ports, env=env, cwd=REPO,
+                            probe_interval_s=0.5, backoff_base_s=0.2,
+                            backoff_cap_s=2.0)
+    sup.start()
+    gw = httpd = None
+    try:
+        if not sup.ready(timeout=600):
+            raise RuntimeError("fleet worker never became ready")
+        gw = Gateway([("127.0.0.1", p) for p in ports],
+                     FleetConfig(hedge=False, max_inflight=32,
+                                 queue_depth=64), supervisor=sup)
+        httpd = gw.serve("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+        workload = MixedWorkload(
+            mix={"request_route": 0.7, "predict_eta": 0.3},
+            seed=args.seed, road_graph=True)
+        print("[3/4] warming (first road request builds the worker's "
+              "router from cache)…", flush=True)
+        client = KeepAliveClient(base, timeout=600.0)
+        t0 = time.perf_counter()
+        try:
+            for req in workload.sequence(6):
+                client.send(req)
+        finally:
+            client.close()
+        warm_s = time.perf_counter() - t0
+
+        print(f"[4/4] open loop: {args.rps} rps × {args.duration:.0f}s…",
+              flush=True)
+        curve = RateCurve.constant(args.rps)
+        offsets = poisson_schedule(curve, args.duration, seed=args.seed)
+        requests = workload.sequence(len(offsets))
+        records = run_open_loop([base], offsets, requests, workers=16,
+                                timeout=max(60.0, 4 * args.slo_ms / 1000))
+        report = summarize(records, args.duration, len(offsets))
+
+        # SLO judgement, both tiers: the gateway engine in this
+        # process, the replica's via its API.
+        gw.slo.tick()
+        gateway_slo = gw.slo.snapshot()
+        import urllib.request
+
+        with urllib.request.urlopen(f"{base}/api/slo", timeout=30) as r:
+            replica_slo = json.loads(r.read())
+        health = json.loads(urllib.request.urlopen(
+            f"{base}/api/health", timeout=30).read())
+    finally:
+        try:
+            if httpd is not None:
+                gw.drain(timeout=5)
+        finally:
+            sup.drain(timeout=20)
+
+    rr = report["routes"].get("/api/request_route", {})
+    p95_ms = rr.get("latency", {}).get("p95_ms", float("inf"))
+    slo_green = (gateway_slo.get("state") == "ok"
+                 and replica_slo.get("state") == "ok")
+    passed = (p95_ms <= args.slo_ms and slo_green
+              and report["error_rate"] <= 0.01)
+    try:
+        n_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:
+        n_cpus = os.cpu_count() or 1
+    record = {
+        "host": {"cpus": n_cpus,
+                 "note": "1 worker; wall latency scales with cores"},
+        "extract_nodes": args.nodes,
+        "workload": workload.describe(),
+        "warm_first_requests_s": round(warm_s, 1),
+        "slo_threshold_ms": args.slo_ms,
+        "load": report,
+        "request_route_p95_ms": p95_ms,
+        "slo": {"gateway_state": gateway_slo.get("state"),
+                "replica_state": replica_slo.get("state"),
+                "green": slo_green},
+        "road_router": (health.get("checks", {}).get("engine", {})
+                        .get("road_router")),
+        "pass": passed,
+    }
+    out = args.out or os.path.join(REPO, "artifacts", "router_serving.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"\nrequest_route p95 {p95_ms} ms (SLO {args.slo_ms:.0f} ms) | "
+          f"slo gateway={record['slo']['gateway_state']} "
+          f"replica={record['slo']['replica_state']} | "
+          f"errors {report['error_rate']:.2%} → {out}")
+    sys.exit(0 if passed else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -259,3 +259,228 @@ def test_overlay_disk_cache_roundtrip(force_hier, monkeypatch, tmp_path, rng):
     assert not rebuilt._hier.stats.get("loaded_from_cache")
     d_rebuilt, _ = rebuilt.shortest(sources)
     np.testing.assert_allclose(d_built, d_rebuilt, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Multi-level stack (PR 8): recursive overlay, chain contraction,
+# multi-seed sources, cache format v2.
+# ---------------------------------------------------------------------------
+
+
+def test_multi_level_stack_matches_oracle(force_hier, monkeypatch, rng):
+    """≥2 levels on a directed OSM-topology graph (bend chains force
+    the contraction path; one-ways force direction handling): random,
+    BOUNDARY-NODE and chain-interior sources all match the oracle, and
+    oracle-unreachable stays unreachable."""
+    monkeypatch.setenv("ROUTEST_HIER_RATIO", "4")
+    monkeypatch.setenv("ROUTEST_HIER_CELL_TARGET", "24")
+    base = generate_road_graph(n_nodes=600, seed=11)
+    streets = subdivide_graph(base, bends_per_edge=2, oneway_frac=0.2,
+                              seed=2)
+    router = RoadRouter(graph=streets, use_gnn=False, use_transformer=False)
+    h = router._hier
+    assert h is not None and h.stats["n_levels"] >= 2, h and h.stats
+    assert h.stats["contraction"]["n_contracted"] < h.n_nodes
+    # Source mix: random nodes, level-1 boundary nodes (kept), and
+    # chain interiors (contracted away — the multi-seed path).
+    kept_full = np.flatnonzero(np.asarray(h._expand_idx) >= 0)
+    interior_full = np.flatnonzero(np.asarray(h._expand_idx) < 0)
+    cid_to_full = np.full(h.n_contracted, -1, np.int64)
+    cid_to_full[np.asarray(h._expand_idx)[kept_full]] = kept_full
+    boundary_full = cid_to_full[np.asarray(h.levels[0].b_global)]
+    sources = np.concatenate([
+        rng.integers(0, router.n_nodes, 3),
+        rng.choice(boundary_full, 3, replace=False),
+        rng.choice(interior_full, 3, replace=False),
+    ]).astype(np.int64)
+    dist, pred = router.shortest(sources)
+    want = _oracle(router, sources)
+    finite = np.isfinite(want)
+    assert finite.mean() > 0.5
+    np.testing.assert_allclose(dist[finite], want[finite], rtol=1e-4)
+    assert (dist[~finite] > 1e37).all()
+    # Walks reconstruct through contracted chains.
+    for si in range(len(sources)):
+        for tgt in rng.integers(0, router.n_nodes, 4):
+            if not np.isfinite(want[si, tgt]) or int(tgt) == int(sources[si]):
+                continue
+            seq = router._walk(pred[si], int(sources[si]), int(tgt))
+            assert seq and seq[0] == int(sources[si]) and seq[-1] == int(tgt)
+
+
+def test_deep_stack_explicit_targets_exact(monkeypatch, rng):
+    """Three explicit levels on a small graph: the recursion is exact
+    at every depth, not just the tuned two-level default."""
+    monkeypatch.setenv("ROUTEST_HIER_CONTRACT", "0")
+    base = generate_road_graph(n_nodes=410, seed=13)
+    g = subdivide_graph(base, bends_per_edge=2, oneway_frac=0.1, seed=0)
+    idx = HierarchicalIndex.build(g["node_coords"], g["senders"],
+                                  g["receivers"], g["length_m"],
+                                  cell_targets=[24, 96, 384])
+    assert idx is not None and idx.n_levels == 3
+    sources = rng.integers(0, len(g["node_coords"]), 6)
+    p_cells, seed_pos, seed_val = idx.prep_sources(sources)
+    dist = np.asarray(idx.query_fn(p_cells, seed_pos, seed_val))
+    import scipy.sparse as sp
+
+    adj = sp.coo_matrix(
+        (g["length_m"], (g["senders"], g["receivers"])),
+        shape=(idx.n_nodes, idx.n_nodes)).tocsr()
+    want = dijkstra(adj, directed=True, indices=np.asarray(sources, np.int64))
+    finite = np.isfinite(want)
+    np.testing.assert_allclose(dist[finite], want[finite], rtol=1e-4)
+    assert (dist[~finite] > 1e37).all()
+
+
+def test_same_cell_leave_and_reenter(monkeypatch):
+    """Source and target in the SAME cell whose shortest path exits and
+    re-enters: the descend stitch must beat the in-cell-only value."""
+    monkeypatch.setenv("ROUTEST_HIER_CONTRACT", "0")
+    # Cell A: x ∈ {0..3}, cell B: x ∈ {4..7} (median bisection on x).
+    coords = np.asarray([[0.0, x] for x in range(8)], np.float32)
+    s, r, w = [], [], []
+
+    def edge(a, b, wt):
+        s.extend([a, b])
+        r.extend([b, a])
+        w.extend([wt, wt])
+
+    edge(0, 1, 100.0)
+    edge(1, 2, 100.0)
+    edge(2, 3, 100.0)   # in-cell 0→3 = 300
+    edge(0, 4, 2.0)
+    edge(4, 5, 2.0)
+    edge(5, 6, 2.0)
+    edge(6, 7, 2.0)
+    edge(7, 3, 2.0)     # detour through B = 10
+    idx = HierarchicalIndex.build(
+        coords, np.asarray(s), np.asarray(r),
+        np.asarray(w, np.float32), cell_targets=[4])
+    assert idx is not None
+    p_cells, seed_pos, seed_val = idx.prep_sources(np.asarray([0]))
+    dist = np.asarray(idx.query_fn(p_cells, seed_pos, seed_val))
+    np.testing.assert_allclose(dist[0, 3], 10.0, rtol=1e-6)
+    # 0→2 also re-enters: detour to 3 (10) + back-edge 3→2 (100)
+    # beats the 200 in-cell path.
+    np.testing.assert_allclose(dist[0, 2], 110.0, rtol=1e-6)
+    np.testing.assert_allclose(dist[0, 1], 100.0, rtol=1e-6)  # stays in A
+
+
+def test_unreachable_pocket_stays_unreachable(force_hier, monkeypatch, rng):
+    """A pocket with only OUTGOING edges to the main graph is
+    undirected-connected (no component bridging) but directionally
+    unreachable — the overlay must report INF, same as flat BF."""
+    monkeypatch.setenv("ROUTEST_HIER_CELL_TARGET", "48")
+    g = generate_road_graph(n_nodes=400, seed=17)
+    n = len(g["node_coords"])
+    pocket = 6
+    coords = np.concatenate([
+        g["node_coords"],
+        g["node_coords"][:1] + 0.001 * (1 + np.arange(pocket))[:, None]],
+        axis=0).astype(np.float32)
+    ps = np.arange(n, n + pocket - 1)
+    add_s = np.concatenate([ps, ps + 1, [n]])          # two-way inside…
+    add_r = np.concatenate([ps + 1, ps, [0]])          # …one-way OUT only
+    senders = np.concatenate([g["senders"], add_s]).astype(np.int32)
+    receivers = np.concatenate([g["receivers"], add_r]).astype(np.int32)
+    length = np.concatenate(
+        [g["length_m"], np.full(len(add_s), 50.0)]).astype(np.float32)
+    graph = {
+        "node_coords": coords, "senders": senders, "receivers": receivers,
+        "length_m": length,
+        "road_class": np.ones(len(senders), np.int32),
+        "speed_limit": np.full(len(senders), 8.3, np.float32),
+    }
+    router = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
+    assert router._hier is not None
+    sources = rng.integers(0, n, 4)
+    dist, _ = router.shortest(sources)
+    want = _oracle(router, sources)
+    assert (dist[:, n:] > 1e37).all()                  # pocket unreachable
+    finite = np.isfinite(want)
+    np.testing.assert_allclose(dist[finite], want[finite], rtol=1e-4)
+
+
+def test_contraction_roundabout_cycle_exact(monkeypatch):
+    """An all-degree-2 cycle (roundabout) has no natural chain
+    endpoint; contraction must break it, not hang or corrupt."""
+    m = 24
+    theta = 2 * np.pi * np.arange(m) / m
+    coords = np.stack([np.sin(theta), np.cos(theta)], axis=1).astype(
+        np.float32)
+    s = np.concatenate([np.arange(m), (np.arange(m) + 1) % m])
+    r = np.concatenate([(np.arange(m) + 1) % m, np.arange(m)])
+    w = np.full(len(s), 10.0, np.float32)
+    idx = HierarchicalIndex.build(coords, s, r, w, cell_targets=[3])
+    assert idx is not None
+    sources = np.asarray([0, 5])
+    p_cells, seed_pos, seed_val = idx.prep_sources(sources)
+    dist = np.asarray(idx.query_fn(p_cells, seed_pos, seed_val))
+    # Contracted-away interiors come back via the router's polish; at
+    # the index level only KEPT nodes are finite — check those.
+    kept = np.flatnonzero(np.asarray(idx._expand_idx) >= 0)
+    ring = np.minimum(np.abs(sources[:, None] - kept[None, :]),
+                      m - np.abs(sources[:, None] - kept[None, :])) * 10.0
+    finite = dist[:, kept] < 1e37
+    np.testing.assert_allclose(dist[:, kept][finite], ring[finite],
+                               rtol=1e-6)
+
+
+def test_cache_wrong_version_rejected(force_hier, monkeypatch, tmp_path,
+                                      rng):
+    """A v(N≠current) payload at the right filename is rejected (and
+    the router rebuilds) instead of being deserialized on trust."""
+    import io
+
+    monkeypatch.setenv("ROUTEST_HIER_CACHE", str(tmp_path))
+    graph = generate_road_graph(n_nodes=1300, seed=21)
+    built = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
+    assert built._hier is not None
+    cache_file = next(tmp_path.glob("hier-*.npz"))
+    with np.load(cache_file, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["_version"] = np.int64(999)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    cache_file.write_bytes(buf.getvalue())
+    from routest_tpu.optimize.hierarchy import HierarchicalIndex as HI
+
+    assert HI.load(str(cache_file)) is None
+    rebuilt = RoadRouter(graph=graph, use_gnn=False, use_transformer=False)
+    assert rebuilt._hier is not None
+    assert not rebuilt._hier.stats.get("loaded_from_cache")
+    sources = rng.integers(0, built.n_nodes, 4)
+    d0, _ = built.shortest(sources)
+    d1, _ = rebuilt.shortest(sources)
+    np.testing.assert_allclose(d0, d1, rtol=1e-6)
+
+
+def test_build_params_change_cache_filename(monkeypatch):
+    from routest_tpu.optimize.hierarchy import hier_cache_path
+
+    monkeypatch.setenv("ROUTEST_HIER_CACHE", "/tmp/hier-param-test")
+    fp = {"n_nodes": 10, "coords_crc32": 1, "n_edges": 9, "edges_crc32": 2}
+    a = hier_cache_path(fp)
+    monkeypatch.setenv("ROUTEST_HIER_PRUNE_SLACK", "1e-6")
+    b = hier_cache_path(fp)
+    monkeypatch.delenv("ROUTEST_HIER_PRUNE_SLACK")
+    monkeypatch.setenv("ROUTEST_HIER_MAX_LEVELS", "1")
+    c = hier_cache_path(fp)
+    assert len({a, b, c}) == 3
+
+
+def test_aot_buckets_compiled_and_used(force_hier, monkeypatch, rng):
+    """AOT-compiled buckets serve solves without falling back to the
+    jitted path, and answers match the jitted path bit-for-bit."""
+    monkeypatch.setenv("ROUTEST_ROUTER_AOT", "2,16")
+    monkeypatch.setenv("ROUTEST_HIER_CELL_TARGET", "64")
+    router = RoadRouter(graph=generate_road_graph(n_nodes=900, seed=23),
+                        use_gnn=False, use_transformer=False)
+    assert sorted(router._aot) == [2, 16]
+    assert router.solver_info["aot_buckets"] == [2, 16]
+    sources = rng.integers(0, router.n_nodes, 2)  # bucket 2 → AOT
+    d_aot, p_aot = router.shortest(sources)
+    del router._aot[2]                            # force jitted fallback
+    d_jit, p_jit = router.shortest(sources)
+    np.testing.assert_array_equal(d_aot, d_jit)
+    np.testing.assert_array_equal(p_aot, p_jit)

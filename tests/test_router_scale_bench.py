@@ -1,0 +1,73 @@
+"""Slow: the router scale bench end-to-end (``--quick``), with the
+ISSUE-8 acceptance invariants as DIRECTION guardbands (a 1-core CI
+host proves the algorithmic ordering, not absolute wall times —
+``test_fastlane_bench.py`` / ``test_autoscale_bench.py`` pattern):
+the overlay beats flat Bellman-Ford on the same graph and backend,
+the multi-level stack beats the single-level overlay at the largest
+quick size, oracle parity holds, and the per-phase breakdown is
+recorded so regressions localize."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_router_scale_quick(tmp_path):
+    out = tmp_path / "router_scale.json"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "bench_router_scale.py"),
+         "--quick", "--verify", "--cpu", "--out", str(out)],
+        cwd=REPO, timeout=1800, capture_output=True, text=True,
+        env={**os.environ, "ROUTEST_HIER_CACHE": str(tmp_path / "hier")})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    record = json.loads(out.read_text())
+    rows = record["rows"]
+    assert len(rows) == 2
+
+    flat_row = rows[0]
+    assert flat_row["solver"] == "flat_bf"
+    assert flat_row["oracle_max_rel_err"] <= 1e-5
+
+    hier = rows[1]
+    assert hier["solver"] == "hierarchy", hier
+    assert hier["oracle_max_rel_err"] <= 1e-5, hier
+    assert hier["reachable_frac"] >= 0.99
+    # Direction guardbands: hierarchy beats flat BF on the same graph,
+    # multi-level beats single-level at the largest quick size.
+    assert hier["flat_warm_ms"] > hier["solve_warm_ms"], hier
+    assert hier["overlay_speedup"] >= 1.5, hier
+    assert hier["overlay"]["n_levels"] >= 2, hier["overlay"]
+    assert hier["multi_level_speedup"] >= 1.2, hier
+    # The per-phase breakdown localizes regressions: every stage of the
+    # stack must be present and account for most of the warm solve.
+    phases = hier["query_phases_ms"]
+    assert "phase1" in phases and "top_bf" in phases
+    assert any(k.startswith("ascend_l") for k in phases)
+    assert any(k.startswith("descend_l") for k in phases)
+    # Per-level build stats recorded (cache-hygiene satellite).
+    for lvl in hier["overlay"]["levels"]:
+        assert lvl["build_s"] >= 0.0 and lvl["n_cells"] >= 2
+
+
+@pytest.mark.slow
+def test_committed_osm_scale_artifact():
+    """The committed measurement of record must itself satisfy the
+    acceptance bar (a stale artifact from before a regression would
+    otherwise keep "passing")."""
+    path = os.path.join(REPO, "artifacts", "osm_scale.json")
+    record = json.load(open(path))
+    rows = record["rows"]
+    assert len(rows) >= 3
+    big = max(rows, key=lambda r: r["nodes"])
+    assert big["nodes"] >= 249_000
+    assert big["solver"] == "hierarchy"
+    assert big["overlay"]["n_levels"] >= 2
+    assert big["oracle_max_rel_err"] <= 1e-5
+    assert big["query_phases_ms"]
