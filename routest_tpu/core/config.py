@@ -316,6 +316,37 @@ class RecorderConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class LiveConfig:
+    """Live traffic (``routest_tpu/live``): probe-stream ingest,
+    incremental congestion state, periodic metric refresh on the
+    partition overlay, optional continuous GNN retrain. All knobs are
+    ``RTPU_LIVE_*`` env vars; disabled by default (the frozen-world
+    behavior every earlier PR pinned stays the default).
+
+    ``customize_s`` bounds served-route staleness from above: a probe
+    observation is reflected in routes/ETAs within one ingest hop plus
+    one customize interval. ``half_life_s``/``stale_s``/``conf_obs``
+    shape the estimator (EWMA decay, staleness window, observations
+    to full confidence). ``route_metric=False`` prices legs live but
+    keeps route CHOICE on the distance metric. ``retrain_s > 0`` runs
+    the continuous trainer inside the replica (default off — a
+    sidecar/bench driver usually owns training)."""
+
+    enabled: bool = False
+    channel: str = "rtpu.probes"
+    customize_s: float = 10.0
+    half_life_s: float = 60.0
+    stale_s: float = 300.0
+    conf_obs: float = 3.0
+    min_obs_edges: int = 1
+    window: int = 65536
+    route_metric: bool = True
+    retrain_s: float = 0.0
+    retrain_steps: int = 40
+    retrain_min_obs: int = 256
+
+
+@dataclasses.dataclass(frozen=True)
 class ChaosConfig:
     """Fault injection (``routest_tpu/chaos``): a seeded, deterministic
     chaos layer wrapping every IO boundary. Disabled unless
@@ -340,6 +371,7 @@ class Config:
     rollout: RolloutConfig = dataclasses.field(
         default_factory=RolloutConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    live: LiveConfig = dataclasses.field(default_factory=LiveConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     recorder: RecorderConfig = dataclasses.field(
@@ -444,9 +476,31 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     return Config(mesh=mesh, model=model, train=train, serve=serve,
                   fleet=fleet, autoscale=load_autoscale_config(env),
                   rollout=load_rollout_config(env),
-                  obs=obs, chaos=load_chaos_config(env),
+                  obs=obs, live=load_live_config(env),
+                  chaos=load_chaos_config(env),
                   slo=load_slo_config(env),
                   recorder=load_recorder_config(env))
+
+
+def load_live_config(env: Optional[Mapping[str, str]] = None) -> LiveConfig:
+    """Just the live-traffic knobs (read by ``routest_tpu/live`` and
+    serving bring-up without paying for a full Config build)."""
+    env = dict(env if env is not None else os.environ)
+    return LiveConfig(
+        enabled=env.get("RTPU_LIVE", "0") == "1",
+        channel=env.get("RTPU_LIVE_CHANNEL") or "rtpu.probes",
+        customize_s=_env_num(env, "RTPU_LIVE_CUSTOMIZE_S", 10.0, float),
+        half_life_s=_env_num(env, "RTPU_LIVE_HALF_LIFE_S", 60.0, float),
+        stale_s=_env_num(env, "RTPU_LIVE_STALE_S", 300.0, float),
+        conf_obs=_env_num(env, "RTPU_LIVE_CONF_OBS", 3.0, float),
+        min_obs_edges=_env_num(env, "RTPU_LIVE_MIN_OBS_EDGES", 1, int),
+        window=_env_num(env, "RTPU_LIVE_WINDOW", 65536, int),
+        route_metric=env.get("RTPU_LIVE_ROUTE_METRIC", "1") != "0",
+        retrain_s=_env_num(env, "RTPU_LIVE_RETRAIN_S", 0.0, float),
+        retrain_steps=_env_num(env, "RTPU_LIVE_RETRAIN_STEPS", 40, int),
+        retrain_min_obs=_env_num(env, "RTPU_LIVE_RETRAIN_MIN_OBS",
+                                 256, int),
+    )
 
 
 def load_chaos_config(env: Optional[Mapping[str, str]] = None) -> ChaosConfig:

@@ -1,0 +1,67 @@
+"""Live traffic: the loop from city-wide probes to served routes.
+
+The stack below this package serves a frozen world — edge costs are
+free-flow physics or a once-trained GNN regime, and nothing changes
+while the fleet runs. This package closes ROADMAP item 4's loop
+(docs/ARCHITECTURE.md "Live traffic"):
+
+- ``probes``    — fleet-scale simulated probe source (seeded drivers
+  random-walking the road graph, publishing per-edge speed
+  observations over the bus) + the scenario driver that injects
+  corridor congestion at a named time;
+- ``state``     — per-edge decayed/EWMA congestion estimator with
+  staleness windows and observation-count confidence, exported as a
+  dense edge-time array with an epoch counter;
+- ``ingest``    — the bus subscriber folding observation batches into
+  the state (chaos point ``live.ingest``);
+- ``customize`` — the background metric customizer re-pricing the
+  partition overlay against the live metric and flipping the router
+  (chaos point ``live.customize``);
+- ``trainer``   — periodic GNN re-fit on the recent observation
+  window, landing through the router's verified hot-swap;
+- ``service``   — the serving-side wiring (``RTPU_LIVE=1``).
+
+This module itself stays import-light: the metric-epoch global lives
+here so the serving fast lane can key its prediction cache on
+``(model generation, metric epoch)`` without importing any of the
+heavy machinery.
+"""
+
+from __future__ import annotations
+
+_METRIC_EPOCH = 0
+
+
+def metric_epoch() -> int:
+    """The live-metric generation currently serving in this process
+    (0 = frozen world). Part of the fast-lane cache key, so no cached
+    result outlives a metric flip."""
+    return _METRIC_EPOCH
+
+
+def set_metric_epoch(epoch: int) -> None:
+    """Called by ``RoadRouter.install_live_metric`` at flip time."""
+    global _METRIC_EPOCH
+    _METRIC_EPOCH = int(epoch)
+
+
+_LAZY = {
+    "CongestionState": "routest_tpu.live.state",
+    "LiveSnapshot": "routest_tpu.live.state",
+    "ProbeFleet": "routest_tpu.live.probes",
+    "CongestionScenario": "routest_tpu.live.probes",
+    "corridor_edges": "routest_tpu.live.probes",
+    "ProbeIngester": "routest_tpu.live.ingest",
+    "MetricCustomizer": "routest_tpu.live.customize",
+    "ContinuousTrainer": "routest_tpu.live.trainer",
+    "LiveTrafficService": "routest_tpu.live.service",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,246 @@
+"""Continuous GNN refresh: recent observation window → verified swap.
+
+Periodically re-fits the road-GNN congestion head on the estimator's
+recent observation window and writes the artifact atomically
+(``save_gnn`` → temp-then-rename); the serving router's
+fingerprint-gated hot reload picks the new mtime up on its next
+request and lands it through the VERIFIED swap
+(``RoadRouter._verify_gnn_swap`` — finiteness + divergence gates, the
+road-side twin of PR 7's ETA golden-batch gate). The trainer never
+touches a router directly: the artifact file IS the interface, so the
+same trainer runs in-replica, in a sidecar, or in a bench driver.
+
+Training shape (the ``loss_weights`` split in ``models/gnn.py``):
+every graph edge carries messages (the aggregation the model serves
+under), but the loss reads only window-observed edges — targets are
+each observed edge's window-mean seconds at its last observed hour.
+Warm start: parameters continue from the previous cycle (or the
+current artifact when fingerprints match), so a few dozen steps per
+cycle track a drifting world instead of re-learning it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from routest_tpu.live.state import CongestionState
+
+_metrics = None
+
+
+def _trainer_metrics():
+    global _metrics
+    if _metrics is None:
+        from routest_tpu.obs import get_registry
+
+        reg = get_registry()
+        _metrics = {
+            "runs": reg.counter(
+                "rtpu_live_retrain_total",
+                "Continuous-retrain cycles, by result "
+                "(saved / skipped / rejected / failed).", ("result",)),
+            "dur": reg.histogram(
+                "rtpu_live_retrain_seconds",
+                "One retrain cycle: window build + steps + save."),
+        }
+    return _metrics
+
+
+class ContinuousTrainer:
+    """Periodic re-fit of the road-GNN on the observation window."""
+
+    def __init__(self, router, state: CongestionState,
+                 artifact_path: Optional[str] = None, *,
+                 steps: int = 40, lr: float = 1e-3,
+                 min_obs: int = 256, hidden: int = 64,
+                 seed: int = 0) -> None:
+        from routest_tpu.train.checkpoint import default_gnn_path
+
+        self._router = router
+        self._state = state
+        self._path = (artifact_path or getattr(router, "_gnn_path", None)
+                      or default_gnn_path())
+        self.steps = int(steps)
+        self.lr = float(lr)
+        self.min_obs = int(min_obs)
+        self.hidden = int(hidden)
+        self.seed = int(seed)
+        self._graph = router.graph_dict()
+        self._model = None
+        self._params = None
+        self._opt = None
+        self._opt_state = None
+        self._step_fn = None
+        self.cycles = 0
+        self.last_result: Dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ── model bring-up ────────────────────────────────────────────────
+
+    def _ensure_model(self) -> None:
+        if self._model is not None:
+            return
+        import jax
+
+        from routest_tpu.core.dtypes import F32_POLICY
+        from routest_tpu.models.gnn import RoadGNN
+
+        # Warm start from the live artifact when it belongs to THIS
+        # graph — continuity is what makes few-step cycles converge.
+        try:
+            from routest_tpu.train.checkpoint import load_gnn
+
+            model, params, fp = load_gnn(self._path)
+            if fp == self._router._fingerprint:
+                import dataclasses
+
+                self._model = dataclasses.replace(model,
+                                                  policy=F32_POLICY)
+                self._params = params
+        except Exception:
+            self._model = None  # fresh init below; reason irrelevant
+        if self._model is None:
+            self._model = RoadGNN(n_nodes=len(self._graph["node_coords"]),
+                                  hidden=self.hidden, n_rounds=2,
+                                  policy=F32_POLICY)
+            self._params = self._model.init(
+                jax.random.PRNGKey(self.seed))
+
+    def _ensure_step(self) -> None:
+        if self._step_fn is not None:
+            return
+        import jax
+        import optax
+
+        self._opt = optax.adamw(self.lr, weight_decay=1e-4)
+        self._opt_state = self._opt.init(self._params)
+        model, opt = self._model, self._opt
+
+        @jax.jit
+        def step(params, opt_state, coords, batch, loss_weights):
+            loss, grads = jax.value_and_grad(model.loss)(
+                params, coords, batch, loss_weights=loss_weights)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._step_fn = step
+
+    # ── one cycle ─────────────────────────────────────────────────────
+
+    def run_once(self) -> Dict:
+        """One retrain cycle; returns a result dict, never raises."""
+        import jax.numpy as jnp
+
+        from routest_tpu.models.gnn import GraphBatch, edge_feature_array
+        from routest_tpu.utils.logging import get_logger
+
+        m = _trainer_metrics()
+        t0 = time.perf_counter()
+        log = get_logger("routest_tpu.live")
+        try:
+            win = self._state.window()
+            n_obs = len(win["edge"])
+            if n_obs < self.min_obs:
+                m["runs"].labels(result="skipped").inc()
+                self.last_result = {
+                    "trained": False,
+                    "reason": f"window {n_obs} < min_obs {self.min_obs}"}
+                return self.last_result
+            g = self._graph
+            E = len(g["senders"])
+            # Per-edge window aggregation: mean observed seconds, last
+            # observed hour (the window is oldest-first, so a plain
+            # index write leaves the LAST occurrence standing).
+            sums = np.zeros(E, np.float64)
+            counts = np.zeros(E, np.float64)
+            np.add.at(sums, win["edge"], win["time_s"])
+            np.add.at(counts, win["edge"], 1.0)
+            observed = counts > 0
+            targets = np.zeros(E, np.float32)
+            targets[observed] = (sums[observed]
+                                 / counts[observed]).astype(np.float32)
+            hours = np.full(E, time.localtime().tm_hour, np.int32)
+            hours[win["edge"]] = win["hour"]
+            self._ensure_model()
+            self._ensure_step()
+            batch = GraphBatch(
+                senders=jnp.asarray(np.asarray(g["senders"], np.int32)),
+                receivers=jnp.asarray(np.asarray(g["receivers"],
+                                                 np.int32)),
+                edge_feats=jnp.asarray(edge_feature_array(
+                    g["length_m"], g["speed_limit"], g["road_class"],
+                    hours)),
+                length_m=jnp.asarray(np.asarray(g["length_m"],
+                                                np.float32)),
+                speed_limit=jnp.asarray(np.asarray(g["speed_limit"],
+                                                   np.float32)),
+                targets=jnp.asarray(targets),
+                weights=jnp.ones((E,), jnp.float32))
+            loss_w = jnp.asarray(observed.astype(np.float32))
+            coords = jnp.asarray(np.asarray(g["node_coords"],
+                                            np.float32))
+            params, opt_state = self._params, self._opt_state
+            loss = float("nan")
+            for _ in range(self.steps):
+                params, opt_state, loss = self._step_fn(
+                    params, opt_state, coords, batch, loss_w)
+            loss = float(loss)
+            if not np.isfinite(loss):
+                m["runs"].labels(result="rejected").inc()
+                self.last_result = {"trained": False,
+                                    "reason": f"non-finite loss {loss}"}
+                return self.last_result
+            pred = np.asarray(self._model.apply(params, coords, batch))
+            if not np.isfinite(pred).all():
+                m["runs"].labels(result="rejected").inc()
+                self.last_result = {
+                    "trained": False,
+                    "reason": "non-finite predictions after fit"}
+                return self.last_result
+            # Accept the cycle: carry the optimizer state forward and
+            # land the artifact atomically (the router verifies again,
+            # independently, before ITS generation flips).
+            self._params, self._opt_state = params, opt_state
+            from routest_tpu.train.checkpoint import save_gnn
+
+            save_gnn(self._path, self._model, params, g)
+            dur = time.perf_counter() - t0
+            self.cycles += 1
+            m["runs"].labels(result="saved").inc()
+            m["dur"].observe(dur)
+            obs_rmse = float(np.sqrt(np.mean(
+                (pred[observed] - targets[observed]) ** 2)))
+            self.last_result = {
+                "trained": True, "observations": n_obs,
+                "edges_labeled": int(observed.sum()),
+                "loss": round(loss, 3),
+                "window_rmse_s": round(obs_rmse, 3),
+                "train_s": round(dur, 3), "path": self._path}
+            log.info("live_retrain_saved", **self.last_result)
+            return self.last_result
+        except Exception as e:
+            m["runs"].labels(result="failed").inc()
+            log.error("live_retrain_failed",
+                      error=f"{type(e).__name__}: {e}")
+            self.last_result = {"trained": False,
+                                "reason": f"{type(e).__name__}: {e}"}
+            return self.last_result
+
+    def start(self, interval_s: float = 30.0) -> None:
+        def run() -> None:
+            while not self._stop.wait(interval_s):
+                self.run_once()
+
+        self._thread = threading.Thread(target=run, name="live-trainer",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)

@@ -159,13 +159,15 @@ class MixedWorkload:
     engine holds those separately (``engine.SseClients``)."""
 
     KINDS = ("predict_eta", "request_route", "history",
-             "predict_eta_batch", "update_tracker")
+             "predict_eta_batch", "update_tracker", "probe")
 
     def __init__(self, mix: Optional[Dict[str, float]] = None,
                  s: float = 1.1, seed: int = 0,
                  batch_rows: int = 64,
                  sse_channel: str = "loadgen",
-                 road_graph: bool = False) -> None:
+                 road_graph: bool = False,
+                 probe_edges: int = 0,
+                 probe_obs: int = 4) -> None:
         mix = dict(mix if mix is not None else DEFAULT_MIX)
         unknown = set(mix) - set(self.KINDS)
         if unknown:
@@ -174,10 +176,16 @@ class MixedWorkload:
         if total <= 0:
             raise ValueError("mix weights must sum to > 0")
         self.mix = {k: v / total for k, v in mix.items() if v > 0}
+        if self.mix.get("probe") and probe_edges <= 0:
+            raise ValueError(
+                "a probe component needs probe_edges (the served road "
+                "graph's edge count) to draw valid edge ids")
         self.seed = seed
         self.batch_rows = batch_rows
         self.sse_channel = sse_channel
         self.road_graph = road_graph
+        self.probe_edges = int(probe_edges)
+        self.probe_obs = int(probe_obs)
         self.od = ZipfODWorkload(s=s, seed=seed)
 
     def sequence(self, n: int) -> List[PlannedRequest]:
@@ -220,6 +228,21 @@ class MixedWorkload:
                         "duration": 600, "distance": 5000, "trips": 1,
                         "pickup_time": "2026-08-04T18:00:00",
                     }, "/api/update_tracker"))
+            elif kind == "probe":
+                # Live-update traffic: one driver's per-edge speed
+                # observations, POSTed to /api/probe (which publishes
+                # to the probe channel — every replica's ingester folds
+                # it). Bodies are seeded like everything else, so the
+                # same (mix, seed) offers identical probe load.
+                edges = rng.integers(0, self.probe_edges,
+                                     size=self.probe_obs)
+                speeds = rng.uniform(2.0, 14.0, size=self.probe_obs)
+                out.append(PlannedRequest(
+                    "POST", "/api/probe", {
+                        "driver": f"lg-{pair % 97}",
+                        "obs": [[int(e), round(float(v), 3)]
+                                for e, v in zip(edges, speeds)],
+                    }, "/api/probe"))
             else:  # predict_eta_batch
                 rows = self.od.pair_indices(self.batch_rows,
                                             seed_offset=1000 + pair)
@@ -231,8 +254,12 @@ class MixedWorkload:
         return out
 
     def describe(self) -> dict:
-        return {"mix": dict(self.mix), "zipf_s": self.od.s,
-                "seed": self.seed, "od_pairs": len(self.od.pairs),
-                "batch_rows": self.batch_rows,
-                "sse_channel": self.sse_channel,
-                "road_graph": self.road_graph}
+        out = {"mix": dict(self.mix), "zipf_s": self.od.s,
+               "seed": self.seed, "od_pairs": len(self.od.pairs),
+               "batch_rows": self.batch_rows,
+               "sse_channel": self.sse_channel,
+               "road_graph": self.road_graph}
+        if self.mix.get("probe"):
+            out["probe_edges"] = self.probe_edges
+            out["probe_obs"] = self.probe_obs
+        return out

@@ -204,11 +204,19 @@ class RoadGNN:
 
     def loss(self, params: Params, node_coords: jax.Array,
              batch: GraphBatch, combine=lambda x: x,
-             reduce=lambda x: x) -> jax.Array:
+             reduce=lambda x: x, loss_weights=None) -> jax.Array:
+        """Weighted MSE. ``batch.weights`` masks MESSAGES (padding must
+        not inject aggregation); ``loss_weights`` (default: the same
+        mask) selects which edges the LOSS reads. The live-traffic
+        trainer needs the split: probes label a subset of edges, but
+        every real edge must still carry messages or the aggregation
+        the model serves under would differ from the one it trained
+        under."""
         pred = self._forward(params, node_coords, batch, combine)
-        err = (pred - batch.targets) ** 2 * batch.weights
+        lw = batch.weights if loss_weights is None else loss_weights
+        err = (pred - batch.targets) ** 2 * lw
         total = reduce(err.sum())
-        count = reduce(batch.weights.sum())
+        count = reduce(lw.sum())
         return total / jnp.maximum(count, 1.0)
 
     # ── mesh-parallel build ────────────────────────────────────────────

@@ -79,9 +79,11 @@ _INF_NP = np.float32(3e38)
 # (measured in road_router._bellman_ford — same constant, same reason).
 _K_SWEEPS = 4
 
-# v2: multi-level payload (per-level arrays + top overlay graph),
-# content-hash cache filenames, per-level build stats.
-_CACHE_VERSION = 2
+# v3: v2 (multi-level payload, content-hash filenames, per-level build
+# stats) + the topology-only customization structure (partition-tree
+# cuts, chain-contraction edge composition) that lets a loaded overlay
+# re-price itself against a live metric without re-partitioning.
+_CACHE_VERSION = 3
 
 
 def _log():
@@ -318,6 +320,12 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
       ``seed_node``   (N, 2) contracted ids reachable FROM each
                       original node along its chain (pad -1)
       ``seed_w``      (N, 2) the along-chain cost to each (pad INF)
+      ``edge_comp_ptr``/``edge_comp`` ragged ORIGINAL-edge composition
+                      per contracted edge — a contracted weight is the
+                      sum of its composition under ANY metric, which is
+                      what lets :meth:`HierarchicalIndex.customize`
+                      re-price the contraction without re-walking it
+      ``seed_comp_ptr``/``seed_comp`` same, per (node, slot) seed
     """
     n = len(coords)
     senders = np.asarray(senders, np.int64)
@@ -345,13 +353,20 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
         return None
 
     # Adjacency restricted to edges touching interiors (python walk —
-    # chains are short and each interior is visited once).
+    # chains are short and each interior is visited once). ``eid``
+    # remembers WHICH original edge carries each (s, r) hop so chain
+    # weights stay re-derivable under a different metric (interior
+    # endpoints are never parallel-edge endpoints — those are blocked —
+    # so the hop→edge mapping is unique).
     touch = interior[senders] | interior[receivers]
     ew: Dict[Tuple[int, int], float] = {}
-    for s, r, wt in zip(senders[touch], receivers[touch], w[touch]):
+    eid: Dict[Tuple[int, int], int] = {}
+    for e, s, r, wt in zip(np.flatnonzero(touch), senders[touch],
+                           receivers[touch], w[touch]):
         key = (int(s), int(r))
         if key not in ew or wt < ew[key]:
             ew[key] = float(wt)
+            eid[key] = int(e)
 
     # Undirected neighbor map for interiors (both directions known from
     # the degree pattern: 2-2 has adj both ways; 1-1 only forward, so
@@ -426,9 +441,12 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
     # Contracted edges: originals not touching interiors + one summed
     # edge per traversable chain-segment direction.
     keep_edge = ~(interior[senders] | interior[receivers])
+    kept_edge_ids = np.flatnonzero(keep_edge)
     c_s = [cid_of[senders[keep_edge]]]
     c_r = [cid_of[receivers[keep_edge]]]
     c_w = [w[keep_edge]]
+    chain_edge_comp: List[List[int]] = []      # per chain-emitted edge
+    seed_comp: Dict[int, List[int]] = {}       # (node*2 + slot) → edges
     seed_node = np.full((n, 2), -1, np.int64)
     seed_w = np.full((n, 2), np.inf, np.float64)
     seed_node[kept, 0] = cid_of[kept]
@@ -442,6 +460,7 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
             total = 0.0
             ok = True
             partial = [0.0]
+            hop_ids: List[int] = []
             for x, y in zip(nodes[:-1], nodes[1:]):
                 wt = ew.get((x, y))
                 if wt is None:
@@ -449,17 +468,20 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
                     break
                 total += wt
                 partial.append(total)
+                hop_ids.append(eid[(x, y)])
             if not ok:
                 continue
             c_s.append(np.asarray([cid_of[nodes[0]]]))
             c_r.append(np.asarray([cid_of[nodes[-1]]]))
             c_w.append(np.asarray([total], np.float32))
+            chain_edge_comp.append(hop_ids)
             # Seeds: every interior can reach the segment's END in this
             # direction at cost (total - partial).
             for i, node in enumerate(nodes[1:-1], start=1):
                 slot = 0 if seed_node[node, 0] < 0 else 1
                 seed_node[node, slot] = cid_of[nodes[-1]]
                 seed_w[node, slot] = total - partial[i]
+                seed_comp[node * 2 + slot] = hop_ids[i:]
 
     for chain in chains:
         seg: List[int] = [chain[0]]
@@ -475,6 +497,28 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
     c_senders = np.concatenate(c_s)
     c_receivers = np.concatenate(c_r)
     c_weights = np.concatenate(c_w).astype(np.float32)
+    # Ragged composition arrays: kept originals are singleton
+    # compositions (vectorized block), chain edges append their hop
+    # lists in emit order — aligned with c_senders.
+    chain_lens = np.asarray([len(ids) for ids in chain_edge_comp],
+                            np.int64)
+    k0 = len(kept_edge_ids)
+    edge_comp_ptr = np.concatenate([
+        np.arange(k0 + 1, dtype=np.int64),
+        k0 + np.cumsum(chain_lens)])
+    edge_comp = np.concatenate(
+        [kept_edge_ids]
+        + [np.asarray(ids, np.int64) for ids in chain_edge_comp]
+        if chain_edge_comp else [kept_edge_ids]).astype(np.int64)
+    seed_lens = np.zeros(2 * n, np.int64)
+    for slot_key, ids in seed_comp.items():
+        seed_lens[slot_key] = len(ids)
+    seed_comp_ptr = np.zeros(2 * n + 1, np.int64)
+    np.cumsum(seed_lens, out=seed_comp_ptr[1:])
+    seed_comp_flat = np.zeros(int(seed_comp_ptr[-1]), np.int64)
+    for slot_key, ids in seed_comp.items():
+        lo = seed_comp_ptr[slot_key]
+        seed_comp_flat[lo:lo + len(ids)] = ids
     return {
         "cid_of": cid_of, "kept": kept,
         "c_senders": c_senders, "c_receivers": c_receivers,
@@ -482,6 +526,10 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
         "seed_node": seed_node.astype(np.int64),
         "seed_w": np.where(np.isfinite(seed_w), seed_w,
                            _INF_NP).astype(np.float32),
+        "edge_comp_ptr": edge_comp_ptr,
+        "edge_comp": edge_comp,
+        "seed_comp_ptr": seed_comp_ptr,
+        "seed_comp": seed_comp_flat,
     }
 
 
@@ -932,6 +980,10 @@ class HierarchicalIndex:
         self._d_top_r = jnp.asarray(self._top_r)
         self._d_top_w = jnp.asarray(self._top_w)
         self.stats = stats
+        # Topology-only customization structure (partition-tree cuts +
+        # contraction composition), attached by ``build``/``load``/
+        # ``customize``; None for indexes constructed directly.
+        self._structure: Optional[Dict] = None
         self._stage_jits: Optional[List[Tuple[str, object]]] = None
         # ``query_fn`` is the raw traceable function: callers chain
         # further device work (the router's polish + predecessor
@@ -1002,6 +1054,21 @@ class HierarchicalIndex:
         parts = partition_cells_nested(c_coords,
                                        [int(t) for t in cell_targets])
         partition_s = round(time.perf_counter() - t_part, 3)
+        # Everything a metric customization can reuse: the level-0 input
+        # topology, the bisection-tree cuts, and the contraction's
+        # original-edge composition. All of it is weight-independent —
+        # re-pricing starts from here and skips the contraction walk and
+        # the partition entirely (the CRP customization/offline split).
+        structure: Dict = {
+            "c_senders": np.asarray(g_s, np.int64),
+            "c_receivers": np.asarray(g_r, np.int64),
+            "parts": [(np.asarray(c0, np.int32), int(P))
+                      for c0, P in parts],
+        }
+        if contraction is not None:
+            for key in ("edge_comp_ptr", "edge_comp",
+                        "seed_comp_ptr", "seed_comp"):
+                structure[key] = contraction[key]
         prune_slack = _prune_slack()
         node_origin = np.arange(n)        # current-graph node → G0 node
         levels: List[_Level] = []
@@ -1057,10 +1124,111 @@ class HierarchicalIndex:
         index = cls(levels, g_s, g_r, g_w, stats,
                     expand_idx=expand_idx, seed_node=seed_node,
                     seed_w=seed_w)
+        index._structure = structure
         stats["build_s"] = round(time.perf_counter() - t0, 3)
         if cache_path:
             index._save(cache_path, fingerprint)
         return index
+
+    # -- metric customization (CRP-style re-pricing) ----------------------
+
+    def customize(self, w_full: np.ndarray) -> "HierarchicalIndex":
+        """Re-price this overlay against a NEW per-edge metric without
+        rebuilding its structure — the CRP metric-customization phase.
+
+        ``w_full`` is the full-graph edge weight array (same edge order
+        as the ``senders``/``receivers`` the index was built from; any
+        positive metric — live travel seconds, tolled meters). Reused
+        as-is: the bisection-tree cuts, the chain-contraction walk
+        (new chain weights are composition sums over ``w_full``), every
+        level's cell membership and boundary sets (all topology-only —
+        boundaries are endpoints of cell-crossing edges, and nesting
+        keeps cliques inside cells at every level). Recomputed: in-cell
+        boundary tables, clique pruning, overlay weights — the batched
+        device relaxations, whose kernels are already compiled from the
+        build (same shapes → jit cache hits, no recompile).
+
+        Returns a NEW index (the current one keeps serving — callers
+        flip atomically); raises ``ValueError`` when the index carries
+        no structure (direct construction or a pre-v3 cache)."""
+        s = self._structure
+        if s is None:
+            raise ValueError(
+                "index has no customization structure (built by an "
+                "older cache version? rebuild the overlay)")
+        t0 = time.perf_counter()
+        w_full = np.asarray(w_full, np.float32)
+        ecp = s.get("edge_comp_ptr")
+        if ecp is not None:
+            # Chain-contracted graph: contracted edge k's weight is the
+            # sum of its original-edge composition; seed offsets
+            # likewise. Cumulative-sum ragged reduction (reduceat
+            # misbehaves on empty segments, which kept-node seeds are).
+            comp = s["edge_comp"]
+            cs = np.concatenate([
+                [0.0], np.cumsum(w_full[comp], dtype=np.float64)])
+            g_w = (cs[ecp[1:]] - cs[ecp[:-1]]).astype(np.float32)
+            scp = s["seed_comp_ptr"]
+            scs = np.concatenate([
+                [0.0], np.cumsum(w_full[s["seed_comp"]],
+                                 dtype=np.float64)])
+            seed_sums = (scs[scp[1:]] - scs[scp[:-1]]).reshape(-1, 2)
+            seed_w = np.where(self._seed_node >= 0, seed_sums,
+                              _INF_NP).astype(np.float32)
+        else:
+            g_w = w_full
+            seed_w = self._seed_w  # identity contraction: col0 = 0,
+            #                        col1 = INF — weight-independent
+        g_s = s["c_senders"]
+        g_r = s["c_receivers"]
+        prune_slack = float(self.stats.get("prune_slack", _prune_slack()))
+        node_origin = np.arange(len(self.levels[0].cell))
+        levels: List[_Level] = []
+        for li, (cell0, P) in enumerate(s["parts"]):
+            t_lvl = time.perf_counter()
+            built = _build_level(g_s, g_r, g_w,
+                                 cell0[node_origin].astype(np.int32), P,
+                                 prune_slack=prune_slack)
+            if built is None:
+                if li == 0:
+                    raise ValueError("customization built no levels — "
+                                     "graph/structure mismatch")
+                break
+            payload, lstats, ovl = built
+            B = len(payload["b_global"])
+            if li > 0 and 2 * B > len(node_origin):
+                break
+            payload["src_cell"] = payload["cell_remap"][
+                cell0].astype(np.int32)
+            lstats["level"] = li + 1
+            lstats["build_s"] = round(time.perf_counter() - t_lvl, 3)
+            levels.append(_Level(payload, lstats))
+            g_s, g_r, g_w = ovl
+            node_origin = node_origin[payload["b_global"]]
+        l1 = levels[0].stats
+        stats = {
+            "n_cells": l1["n_cells"], "c_max": l1["c_max"],
+            "b_max": l1["b_max"],
+            "n_overlay_nodes": l1["n_overlay_nodes"],
+            "n_overlay_edges": l1["n_overlay_edges"],
+            "clique_edges_kept": l1["clique_edges_kept"],
+            "clique_edges_pruned": l1["clique_edges_pruned"],
+            "n_levels": len(levels),
+            "top_nodes": levels[-1].n_overlay,
+            "top_edges": int(len(g_s)),
+            "prune_slack": prune_slack,
+            "partition_s": 0.0,        # reused — that is the point
+            "contraction": dict(self.stats.get("contraction", {})),
+            "levels": [dict(lvl.stats) for lvl in levels],
+            "customized": True,
+            "full_build_s": self.stats.get("build_s", 0.0),
+        }
+        out = type(self)(levels, g_s, g_r, g_w, stats,
+                         expand_idx=self._expand_idx,
+                         seed_node=self._seed_node, seed_w=seed_w)
+        out._structure = s
+        stats["build_s"] = round(time.perf_counter() - t0, 3)
+        return out
 
     def _save(self, cache_path: str, fingerprint: Optional[Dict]) -> None:
         flat: Dict[str, np.ndarray] = {
@@ -1072,6 +1240,22 @@ class HierarchicalIndex:
             p = lvl.payload()
             for name in _LEVEL_KEYS:
                 flat[f"l{k}_{name}"] = p[name]
+        # v3: the customization structure rides along, so a worker that
+        # REHYDRATES the overlay can still re-price it against a live
+        # metric (the whole point of shipping structure, not just
+        # payload).
+        s = self._structure
+        if s is not None:
+            flat["s_c_senders"] = s["c_senders"]
+            flat["s_c_receivers"] = s["c_receivers"]
+            flat["s_parts"] = np.stack(
+                [c0 for c0, _ in s["parts"]]).astype(np.int32)
+            flat["s_parts_counts"] = np.asarray(
+                [P for _, P in s["parts"]], np.int64)
+            if "edge_comp_ptr" in s:
+                for name in ("edge_comp_ptr", "edge_comp",
+                             "seed_comp_ptr", "seed_comp"):
+                    flat[f"s_{name}"] = s[name]
         tmp = f"{cache_path}.tmp{os.getpid()}.npz"
         try:
             np.savez_compressed(
@@ -1129,14 +1313,30 @@ class HierarchicalIndex:
                 top_s, top_r, top_w = z["top_s"], z["top_r"], z["top_w"]
                 expand_idx = z["expand_idx"]
                 seed_node, seed_w = z["seed_node"], z["seed_w"]
+                structure: Optional[Dict] = None
+                if "s_parts" in z.files:
+                    parts_arr = z["s_parts"]
+                    counts = z["s_parts_counts"]
+                    structure = {
+                        "c_senders": z["s_c_senders"],
+                        "c_receivers": z["s_c_receivers"],
+                        "parts": [(parts_arr[k], int(counts[k]))
+                                  for k in range(len(counts))],
+                    }
+                    if "s_edge_comp_ptr" in z.files:
+                        for name in ("edge_comp_ptr", "edge_comp",
+                                     "seed_comp_ptr", "seed_comp"):
+                            structure[name] = z[f"s_{name}"]
         except Exception as e:
             _log().warning("overlay_cache_rejected", path=cache_path,
                            reason=f"{type(e).__name__}: {e}")
             return None
         stats["loaded_from_cache"] = True
-        return cls(levels, top_s, top_r, top_w, stats,
-                   expand_idx=expand_idx, seed_node=seed_node,
-                   seed_w=seed_w)
+        index = cls(levels, top_s, top_r, top_w, stats,
+                    expand_idx=expand_idx, seed_node=seed_node,
+                    seed_w=seed_w)
+        index._structure = structure
+        return index
 
     # -- query ------------------------------------------------------------
 

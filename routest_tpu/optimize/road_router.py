@@ -81,6 +81,14 @@ def _router_metrics():
             "build": reg.gauge(
                 "rtpu_router_overlay_build_seconds",
                 "Overlay precompute seconds by level.", ("level",)),
+            "swaps": reg.counter(
+                "rtpu_road_model_swaps_total",
+                "Road-GNN hot-swap attempts, by result "
+                "(accepted / rejected / removed).", ("result",)),
+            "model_gen": reg.gauge(
+                "rtpu_road_model_generation",
+                "Generation id of the live road-GNN leg pricer "
+                "(monotonic per process; bumps on every swap)."),
         }
     return _metrics
 
@@ -177,6 +185,42 @@ def _bellman_ford(senders: jax.Array, receivers: jax.Array, w: jax.Array,
     return dist, pred, converged
 
 
+def _road_swap_divergence() -> float:
+    """Verified road-GNN hot-swap bound (median absolute edge-seconds
+    divergence from the live pricer; 0 disables the compare — the
+    finiteness gate always holds). Mirrors ``RTPU_SWAP_MAX_DIV`` on the
+    ETA model (docs/ROBUSTNESS.md "Safe change delivery")."""
+    try:
+        return float(os.environ.get("RTPU_ROAD_SWAP_MAX_DIV", "600"))
+    except ValueError:
+        return 600.0
+
+
+class _LiveMetric:
+    """One immutable live-traffic metric generation (docs/ARCHITECTURE
+    "Live traffic"): the blended per-edge travel seconds, the
+    customized time-metric overlay (when the router has one), and the
+    fused solve for it. Built OFF-PATH by ``install_live_metric`` and
+    installed with a single reference flip — requests snapshot
+    ``router._live`` once, so a flip can never tear a solve."""
+
+    __slots__ = ("epoch", "time_s", "d_time_bf", "hier", "solve", "aot",
+                 "route", "installed_unix", "timings")
+
+    def __init__(self, epoch: int, time_s: np.ndarray, d_time_bf,
+                 hier, solve, aot: Dict[int, object], route: bool,
+                 timings: Dict) -> None:
+        self.epoch = int(epoch)
+        self.time_s = time_s
+        self.d_time_bf = d_time_bf
+        self.hier = hier
+        self.solve = solve
+        self.aot = aot
+        self.route = route
+        self.installed_unix = time.time()
+        self.timings = timings
+
+
 class RoadRouter:
     """Routable road network: snap → batched shortest paths → polylines."""
 
@@ -268,32 +312,11 @@ class RoadRouter:
             # axon tunnel each dispatch costs a host round trip (~70 ms
             # measured), which dominated metro-scale warm latency; it
             # also collapses three per-bucket compiles into one.
-            hier = self._hier
-            # Polish must run at least ``interior_cap`` sweeps: it is
+            # (Polish runs at least ``interior_cap`` sweeps — that is
             # what re-derives chain-interior distances from the
-            # contracted overlay solution (every interior node is ≤ cap
-            # hops downstream of a solved node).
-            n_sweeps = max(_polish_sweeps(),
-                           hier.stats.get("contraction",
-                                          {}).get("interior_cap", 0))
-
-            @jax.jit
-            def _overlay_solve(p_cells, seed_pos, seed_val, padded_d):
-                dist = hier.query_fn(p_cells, seed_pos, seed_val)
-                # A chain-interior source's own row re-seeds at 0 so the
-                # polish sweeps fill its own chain (its overlay seeds
-                # carried the along-chain offsets, not the origin).
-                dist = dist.at[jnp.arange(dist.shape[0]),
-                               padded_d].min(0.0)
-                dist = polish(
-                    self._bf_senders, self._bf_receivers, self._bf_length,
-                    dist, n_nodes=self.n_nodes, n_sweeps=n_sweeps)
-                pred = tight_pred(
-                    self._bf_senders, self._bf_receivers, self._bf_length,
-                    dist, padded_d, n_nodes=self.n_nodes)
-                return dist, pred
-
-            self._overlay_solve = _overlay_solve
+            # contracted overlay solution; see _make_overlay_solve.)
+            self._overlay_solve = self._make_overlay_solve(
+                self._hier, self._bf_length)
             # AOT-compile the query entry per (graph, overlay) shape at
             # init (``jit(...).lower().compile()``): warm latency then
             # excludes dispatch/trace overhead and the FIRST request of
@@ -308,7 +331,8 @@ class RoadRouter:
                         jnp.zeros((L + 1, bucket, 2), jnp.int32),
                         jnp.zeros((L + 1, bucket, 2), jnp.float32),
                         jnp.zeros((bucket,), jnp.int32))
-                self._aot[bucket] = _overlay_solve.lower(*spec).compile()
+                self._aot[bucket] = self._overlay_solve.lower(
+                    *spec).compile()
             self._aot_compile_s = round(time.perf_counter() - t0, 3)
             self._publish_overlay_metrics()
         # Learned leg costs: load the trained road-GNN when its training
@@ -333,6 +357,11 @@ class RoadRouter:
         self._transformer_mtime_ns: Optional[int] = None
         self._gnn = None
         self._transformer = None
+        # Live-traffic metric (routest_tpu/live): installed by the
+        # customizer, snapshotted once per request batch. None = frozen
+        # world (free-flow / GNN pricing, distance-metric routing).
+        self._live: Optional[_LiveMetric] = None
+        self._live_lock = threading.Lock()  # serializes installs only
         # Serializes reloads only — model loading happens OUTSIDE the
         # cache lock so a retrain never stalls concurrent requests.
         self._reload_lock = threading.Lock()
@@ -404,8 +433,121 @@ class RoadRouter:
             info["aot_buckets"] = sorted(self._aot)
             if self._aot:
                 info["aot_compile_s"] = self._aot_compile_s
-            return info
-        return {"solver": "flat_bf", "max_iters_bound": self.max_iters}
+        else:
+            info = {"solver": "flat_bf", "max_iters_bound": self.max_iters}
+        if self._live is not None:
+            info["live"] = self.live_info
+        return info
+
+    # ── live traffic: metric install / flip ───────────────────────────
+
+    @property
+    def live_epoch(self) -> int:
+        """Metric generation currently serving (0 = no live metric)."""
+        live = self._live
+        return live.epoch if live is not None else 0
+
+    @property
+    def live_info(self) -> Optional[Dict]:
+        """Health/bench view of the installed live metric."""
+        live = self._live
+        if live is None:
+            return None
+        return {"epoch": live.epoch, "route_metric": live.route,
+                "installed_unix": round(live.installed_unix, 3),
+                **live.timings}
+
+    def live_metric_export(self) -> Optional[np.ndarray]:
+        """The (E,) blended edge seconds the live generation serves —
+        what the bench's scipy oracle re-solves against."""
+        live = self._live
+        return None if live is None else live.time_s
+
+    def install_live_metric(self, time_s: np.ndarray, epoch: int, *,
+                            route: bool = True) -> Dict:
+        """Build and atomically flip to a new live metric generation.
+
+        ``time_s`` is the blended per-edge travel seconds (original
+        edge order). Everything expensive — overlay customization
+        (``HierarchicalIndex.customize``: partition + contraction
+        reused, boundary tables re-priced), the fused solve's
+        trace/compile for the AOT buckets — happens BEFORE the flip, on
+        the caller's (customizer) thread, so requests keep solving the
+        previous generation with zero blip and the flip itself is one
+        reference assignment. ``route=False`` installs the metric for
+        leg PRICING only (ETAs shift, chosen routes stay on the
+        distance metric). Raises on a bad metric or a failed
+        customization — the previous generation keeps serving.
+        """
+        time_s = np.array(time_s, np.float32, copy=True)
+        if time_s.shape != self.length_m.shape:
+            raise ValueError(
+                f"live metric has {time_s.shape} entries, graph has "
+                f"{self.length_m.shape}")
+        # Same physical floor as every learned pricer: no edge beats
+        # free-flow at an arterial ceiling, and non-finite/absurd
+        # estimates degrade to physics instead of poisoning the metric.
+        bad = ~np.isfinite(time_s) | (time_s <= 0)
+        if bad.any():
+            time_s[bad] = self.freeflow_time_s[bad]
+        np.maximum(time_s, self.length_m / 16.7, out=time_s)
+        timings: Dict = {}
+        hier_live = solve = None
+        aot: Dict[int, object] = {}
+        d_time_bf = jnp.asarray(time_s[self._bf_perm])
+        if self._hier is not None and route:
+            t0 = time.perf_counter()
+            hier_live = self._hier.customize(time_s)
+            timings["customize_s"] = round(time.perf_counter() - t0, 3)
+            timings["full_build_s"] = self._hier.stats.get("build_s", 0.0)
+            solve = self._make_overlay_solve(hier_live, d_time_bf)
+            t0 = time.perf_counter()
+            L = hier_live.n_levels
+            for bucket in self._aot_buckets():
+                spec = (jnp.zeros((L, bucket), jnp.int32),
+                        jnp.zeros((L + 1, bucket, 2), jnp.int32),
+                        jnp.zeros((L + 1, bucket, 2), jnp.float32),
+                        jnp.zeros((bucket,), jnp.int32))
+                aot[bucket] = solve.lower(*spec).compile()
+            timings["aot_s"] = round(time.perf_counter() - t0, 3)
+        live = _LiveMetric(epoch, time_s, d_time_bf, hier_live, solve,
+                           aot, route, timings)
+        with self._live_lock:
+            self._live = live
+        from routest_tpu.live import set_metric_epoch
+
+        set_metric_epoch(live.epoch)
+        get_logger("routest.road").info(
+            "live_metric_installed", epoch=live.epoch, route=route,
+            **timings)
+        return dict(timings, epoch=live.epoch)
+
+    def _make_overlay_solve(self, hier: HierarchicalIndex, d_weights):
+        """Fused overlay query + polish + predecessor recovery over the
+        given index and (receiver-sorted) edge weights — one jitted
+        program, one dispatch per warm solve. Shared by the distance
+        overlay (init) and every live-metric generation (customizer)."""
+        n_sweeps = max(_polish_sweeps(),
+                       hier.stats.get("contraction",
+                                      {}).get("interior_cap", 0))
+
+        @jax.jit
+        def _solve(p_cells, seed_pos, seed_val, padded_d):
+            dist = hier.query_fn(p_cells, seed_pos, seed_val)
+            # A chain-interior source's own row re-seeds at 0 so the
+            # polish sweeps fill its own chain (its overlay seeds
+            # carried the along-chain offsets, not the origin).
+            dist = dist.at[jnp.arange(dist.shape[0]),
+                           padded_d].min(0.0)
+            dist = polish(
+                self._bf_senders, self._bf_receivers, d_weights,
+                dist, n_nodes=self.n_nodes, n_sweeps=n_sweeps)
+            pred = tight_pred(
+                self._bf_senders, self._bf_receivers, d_weights,
+                dist, padded_d, n_nodes=self.n_nodes)
+            return dist, pred
+
+        return _solve
 
     def graph_dict(self) -> Dict[str, np.ndarray]:
         """The (post-bridge) routable graph — the EXACT arrays serving
@@ -494,11 +636,39 @@ class RoadRouter:
             if self._gnn_path and m != self._gnn_mtime_ns:
                 new_gnn = (self._load_gnn(self._gnn_path)
                            if m is not None else None)
-                with self._gnn_lock:
-                    self._gnn = new_gnn
-                    self._gnn_mtime_ns = m
-                    self._model_gen += 1
-                    self._hour_times.clear()
+                # Verified hot-swap (the continuous-retrain landing
+                # zone, docs/ARCHITECTURE.md "Live traffic"): when a
+                # model is already serving, a REPLACEMENT artifact must
+                # score the graph finitely and stay within the
+                # divergence bound before the generation flips — a
+                # corrupt/degenerate retrain keeps the old pricer
+                # serving. A DELETED artifact still stops serving
+                # (matches a fresh process), and the first-ever install
+                # only needs finiteness.
+                accept, verdict = self._verify_gnn_swap(new_gnn, m)
+                swaps = _router_metrics()["swaps"]
+                if accept:
+                    with self._gnn_lock:
+                        self._gnn = new_gnn
+                        self._gnn_mtime_ns = m
+                        self._model_gen += 1
+                        self._hour_times.clear()
+                        gen = self._model_gen
+                    swaps.labels(result=verdict.pop("result",
+                                                    "accepted")).inc()
+                    _router_metrics()["model_gen"].set(gen)
+                    get_logger("routest.road").info(
+                        "road_model_swapped", generation=gen,
+                        path=self._gnn_path, **verdict)
+                else:
+                    with self._gnn_lock:
+                        # Remember the bad mtime so the artifact is not
+                        # re-verified on every request until it changes.
+                        self._gnn_mtime_ns = m
+                    swaps.labels(result="rejected").inc()
+                    get_logger("routest.road").warning(
+                        "road_model_swap_rejected", path=self._gnn_path,
+                        **verdict)
             m = self._mtime_ns(self._transformer_path)
             if self._transformer_path and m != self._transformer_mtime_ns:
                 new_tf = (self._load_transformer(self._transformer_path)
@@ -508,6 +678,58 @@ class RoadRouter:
                     self._transformer_mtime_ns = m
         finally:
             self._reload_lock.release()
+
+    def _verify_gnn_swap(self, new_gnn, mtime_ns) -> Tuple[bool, Dict]:
+        """Golden-graph gate for a road-GNN replacement → ``(accept,
+        verdict)``. ``new_gnn`` None accepts as a removal (file deleted
+        → pricing falls down the stack) unless a model is live and the
+        file still EXISTS (an unloadable overwrite must not take down a
+        working pricer). A loadable replacement scores the whole edge
+        set at the current hour: any non-finite output rejects, and —
+        when a model is already serving — a median absolute divergence
+        beyond ``RTPU_ROAD_SWAP_MAX_DIV`` edge-seconds rejects too."""
+        with self._gnn_lock:
+            cur = self._gnn
+        if new_gnn is None:
+            if cur is not None and mtime_ns is not None:
+                return False, {"reason": "replacement failed to load"}
+            return True, {"result": "removed" if mtime_ns is None
+                          else "accepted"}
+        import datetime as _dt
+
+        from routest_tpu.models.gnn import GraphBatch, edge_feature_array
+
+        hour = _dt.datetime.now().hour
+        model, params = new_gnn
+        e = len(self.length_m)
+        batch = GraphBatch(
+            senders=self._d_senders, receivers=self._d_receivers,
+            edge_feats=jnp.asarray(edge_feature_array(
+                self.length_m, self.speed_limit, self.road_class, hour)),
+            length_m=self._d_length, speed_limit=self._d_speed,
+            targets=jnp.zeros((e,), jnp.float32),
+            weights=jnp.ones((e,), jnp.float32))
+        try:
+            pred = np.asarray(
+                model.apply(params, jnp.asarray(self.coords), batch),
+                np.float32)
+        except Exception as exc:
+            return False, {"reason": "verification forward failed: "
+                                     f"{type(exc).__name__}: {exc}"}
+        if not np.isfinite(pred).all():
+            return False, {"reason": "non-finite edge predictions",
+                           "bad_edges": int((~np.isfinite(pred)).sum())}
+        bound = _road_swap_divergence()
+        if cur is not None and bound > 0:
+            pred_f = np.maximum(pred, self.length_m / 16.7)
+            cur_f = self.edge_time_s(hour)  # live pricer, same floor
+            div = float(np.median(np.abs(pred_f - cur_f)))
+            if div > bound:
+                return False, {"reason": "divergence beyond bound",
+                               "divergence_s": round(div, 2),
+                               "bound_s": bound}
+            return True, {"divergence_s": round(div, 3), "bound_s": bound}
+        return True, {}
 
     def _load_transformer(self, path: str):
         """(model, params, trained_seq_len) when a fingerprint-compatible
@@ -642,19 +864,53 @@ class RoadRouter:
                           self.coords[None, :, 0], self.coords[None, :, 1])
         return np.argmin(d, axis=1).astype(np.int32)
 
-    def shortest(self, source_nodes: np.ndarray):
+    def shortest(self, source_nodes: np.ndarray,
+                 live: Optional[_LiveMetric] = None):
         """(S,) nodes → ((S, N) distances m, (S, N) predecessor edge ids).
 
         The source axis is padded to power-of-two buckets (duplicating
         source 0) so varying waypoint counts reuse one compiled program
         instead of recompiling the while_loop on the request path — the
         same bucket trick as the serving batcher.
+
+        With ``live`` (a snapshot of ``self._live`` taken ONCE by the
+        caller, so one request batch never straddles a flip) and its
+        route metric armed, the solve runs over the live travel-TIME
+        metric instead of meters: distances come back in seconds, and
+        predecessor trees are time-shortest (``route_legs_batch``
+        recovers leg meters along those trees separately).
         """
         source_nodes = np.asarray(source_nodes, np.int32)
         n_src = len(source_nodes)
         bucket = 1 << max(0, (n_src - 1)).bit_length()
         padded = np.full(bucket, source_nodes[0] if n_src else 0, np.int32)
         padded[:n_src] = source_nodes
+        if live is not None and live.route:
+            t0 = time.perf_counter()
+            if live.hier is not None:
+                p_cells, seed_pos, seed_val = live.hier.prep_sources(padded)
+                solve = live.aot.get(bucket, live.solve)
+                dist, pred = jax.device_get(solve(
+                    p_cells, seed_pos, seed_val, jnp.asarray(padded)))
+            else:
+                # Flat graphs re-dispatch the SAME compiled program with
+                # the time weights as arguments — a metric flip costs
+                # zero recompiles here.
+                dist, pred, converged = jax.device_get(_bellman_ford(
+                    self._bf_senders, self._bf_receivers, live.d_time_bf,
+                    jnp.asarray(padded),
+                    n_nodes=self.n_nodes, max_iters=self.max_iters))
+                if not bool(converged):
+                    dist, pred, _ = jax.device_get(_bellman_ford(
+                        self._bf_senders, self._bf_receivers,
+                        live.d_time_bf, jnp.asarray(padded),
+                        n_nodes=self.n_nodes, max_iters=self.n_nodes))
+            _router_metrics()["phase"].labels(phase="solve").observe(
+                time.perf_counter() - t0)
+            pred = pred[:n_src]
+            pred = np.where(pred >= 0, self._bf_perm[np.maximum(pred, 0)],
+                            -1)
+            return dist[:n_src], pred
         if self._hier is not None:
             # Overlay path: exact distances in O(top-cells-across)
             # sweeps, then a couple of polish sweeps so the tight-edge
@@ -702,6 +958,27 @@ class RoadRouter:
         # original arrays, which also carry the GNN's per-edge times)
         pred = np.where(pred >= 0, self._bf_perm[np.maximum(pred, 0)], -1)
         return dist[:n_src], pred
+
+    def _meters_along(self, pred: np.ndarray,
+                      metric_rows: np.ndarray) -> np.ndarray:
+        """(S, N) meters accumulated along the given predecessor trees
+        (pointer doubling — the ``_time_table`` machinery with lengths
+        as the per-edge cost). Live-metric solves are time-shortest, so
+        leg DISTANCES must be recovered along those trees rather than
+        read from the solve's own (seconds) table."""
+        m = len(pred)
+        bucket = 1 << max(0, (m - 1)).bit_length()
+        pad = [(0, bucket - m), (0, 0)]
+        n_rounds = max(1, (max(self.n_nodes - 1, 1)).bit_length())
+        meters = np.asarray(_time_table(
+            self._d_senders, jnp.asarray(np.pad(pred, pad, mode="edge")),
+            self._d_length,
+            jnp.asarray(np.pad(metric_rows, pad, mode="edge")),
+            n_rounds=n_rounds))[:m]
+        # Same unreachable sentinel as the distance solve (3e38, finite)
+        # so downstream consumers see one convention either way.
+        return np.where(np.isfinite(meters), meters,
+                        np.float32(3e38)).astype(np.float32)
 
     def _walk(self, pred_row: np.ndarray, source: int, target: int) -> List[int]:
         """Predecessor edges → node sequence source..target (host-side)."""
@@ -791,23 +1068,42 @@ class RoadRouter:
         if cur:
             groups.append(cur)
 
+        # ONE live-metric snapshot for the whole batch: every problem in
+        # it prices (and, with the route metric armed, routes) against
+        # the same metric generation — a concurrent flip affects only
+        # later batches, never tears this one.
+        live = self._live
         out: List[Optional[RoadLegs]] = [None] * len(problems)
         for g in groups:
             sel = np.concatenate([np.arange(offsets[i], offsets[i + 1])
                                   for i in g])
-            dist, pred = self.shortest(all_nodes[sel])
+            dist, pred = self.shortest(all_nodes[sel], live=live)
+            meters = (self._meters_along(pred, dist)
+                      if live is not None and live.route else None)
             pos = 0
             for i in g:
                 m = counts[i]
                 _, time_scale, hour = problems[i]
                 eff_hour = 12 if hour is None else int(hour) % 24
+                if live is not None:
+                    # Live pricing: the legs' per-edge seconds ARE the
+                    # installed metric — route solves, leg durations and
+                    # the oracle-facing export stay coherent by
+                    # construction (hour blending happens at flip time).
+                    time_arr = live.time_s
+                    cost_model = f"live+{self.leg_cost_model}"
+                else:
+                    time_arr = self.edge_time_s(eff_hour)
+                    cost_model = self.leg_cost_model
                 out[i] = RoadLegs(
                     self, pts_list[i],
                     all_nodes[offsets[i]:offsets[i + 1]],
                     dist[pos:pos + m], pred[pos:pos + m],
                     all_snap[offsets[i]:offsets[i + 1]],
-                    time_scale, self.edge_time_s(eff_hour),
-                    self.leg_cost_model, hour=eff_hour)
+                    time_scale, time_arr,
+                    cost_model, hour=eff_hour,
+                    meters_rows=(meters[pos:pos + m]
+                                 if meters is not None else None))
                 pos += m
         return out
 
@@ -830,7 +1126,8 @@ class RoadLegs:
                  snap_m: np.ndarray, time_scale: float,
                  time_s: Optional[np.ndarray] = None,
                  cost_model: str = "freeflow",
-                 hour: int = 12) -> None:
+                 hour: int = 12,
+                 meters_rows: Optional[np.ndarray] = None) -> None:
         self._r = router
         self._hour = hour
         self._points = points
@@ -840,9 +1137,15 @@ class RoadLegs:
         self._time_scale = time_scale
         self._time_s = time_s if time_s is not None else router.freeflow_time_s
         self.cost_model = cost_model
+        # Live-metric solves are TIME-shortest: ``dist`` rows are
+        # seconds and ``meters_rows`` carries the meters recovered
+        # along those trees — the VRP/ABI distance fields must stay in
+        # meters whatever metric chose the paths.
+        self._live_metric = meters_rows is not None
         m = len(points)
         # Full matrix (the VRP input): graph distance + first/last mile.
-        self.dist_m = dist[np.arange(m)[:, None], nodes[None, :]] \
+        phys = meters_rows if meters_rows is not None else dist
+        self.dist_m = phys[np.arange(m)[:, None], nodes[None, :]] \
             + snap_m[:, None] + snap_m[None, :]
         np.fill_diagonal(self.dist_m, 0.0)
         self._dist_rows = dist            # (M, N): duration_matrix masks by it
@@ -925,6 +1228,12 @@ class RoadLegs:
         """
         t = self._r._transformer
         if t is None or not trips:
+            return None
+        if self._live_metric:
+            # The transformer was trained on the frozen world (free-flow
+            # features, no live context); letting it re-price legs would
+            # silently overwrite the live-blended durations the metric
+            # flip just installed. Base (live) pricing stands.
             return None
         from routest_tpu.models.gnn import edge_feature_array
 

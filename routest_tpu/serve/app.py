@@ -63,6 +63,7 @@ class ServerState:
             required=os.environ.get("ROUTEST_AUTH") == "require")
         self.mailer = mailer
         self.started = time.time()
+        self.live = None  # LiveTrafficService when RTPU_LIVE=1
         # tile-probe cache: (checked_at, result) — see health()
         self._tiles_cache = (0.0, None)
 
@@ -119,6 +120,20 @@ def create_app(config: Optional[Config] = None,
         recorder.register_slo_engine(app.slo)
         if config.slo.tick_s > 0:
             app.slo.start()
+
+    # Live traffic (RTPU_LIVE=1, docs/ARCHITECTURE.md "Live traffic"):
+    # probe-stream ingest → per-edge congestion state → periodic metric
+    # refresh on the road router. Armed asynchronously — the router
+    # build on a metro extract must not stall /up.
+    app.live = None
+    state.live = None
+    live_cfg = getattr(config, "live", None)
+    if live_cfg is not None and live_cfg.enabled:
+        from routest_tpu.live.service import LiveTrafficService
+
+        app.live = LiveTrafficService(state.bus, live_cfg)
+        state.live = app.live
+        app.live.start()
 
     # ── optimization ────────────────────────────────────────────────────
 
@@ -415,7 +430,15 @@ def create_app(config: Optional[Config] = None,
             return {"error": "driver_details must carry driver_name and vehicle_type"}, 400
         if "destinations" not in _obj(route.get("properties")):
             return {"error": "route_details.properties.destinations required"}, 400
-        sim.start_simulation(data, state.bus.publish, state.sim_tick_range)
+        # Optional deterministic replay: a caller-supplied sim_seed
+        # makes the tick jitter (and therefore the publish cadence)
+        # bit-identical across runs — scenario tooling and tests lean
+        # on it; unseeded requests keep the reference's random gait.
+        seed = data.get("sim_seed")
+        if seed is not None and not isinstance(seed, int):
+            return {"error": "sim_seed must be an integer"}, 400
+        sim.start_simulation(data, state.bus.publish, state.sim_tick_range,
+                             seed=seed)
         return {"status": "route simulation initialized."}, 200
 
     @app.route("/api/update_tracker", methods=("POST",))
@@ -432,6 +455,60 @@ def create_app(config: Optional[Config] = None,
             return {"error": f"malformed tracker payload: {e}"}, 400
         state.bus.publish(str(data.get("route_id")), event)
         return {"status": "published"}, 200
+
+    @app.route("/api/probe", methods=("POST",))
+    def probe(request):
+        """Probe-observation ingest over HTTP — the loadgen-facing twin
+        of the bus-native probe stream. The handler only PUBLISHES to
+        the probe channel; every replica (this one included) folds the
+        event through its own bus subscription, so HTTP- and bus-
+        sourced probes take one code path into the estimator and the
+        whole fleet sees every observation exactly once."""
+        data = get_json(request)
+        if not data:
+            return {"error": "no probe data provided."}, 400
+        obs = data.get("obs") if isinstance(data.get("obs"), list) \
+            else data.get("observations")
+        if not isinstance(obs, list) or not obs:
+            return {"error": "obs must be a non-empty list of "
+                             "[edge_id, speed_mps] pairs"}, 400
+        if len(obs) > 4096:
+            return {"error": "probe batch too large (max 4096)"}, 400
+        for o in obs:
+            if (not isinstance(o, (list, tuple)) or len(o) != 2
+                    or not isinstance(o[0], int)
+                    or not isinstance(o[1], (int, float))):
+                return {"error": "each observation must be "
+                                 "[edge_id, speed_mps]"}, 400
+        channel = (state.live.cfg.channel if state.live is not None
+                   else os.environ.get("RTPU_LIVE_CHANNEL",
+                                       "rtpu.probes"))
+        event = {"t": float(data.get("t") or time.time()),
+                 "driver": str(data.get("driver") or "http"),
+                 "obs": [[int(e), float(s)] for e, s in obs]}
+        if data.get("hour") is not None:
+            try:
+                event["hour"] = int(data["hour"]) % 24
+            except (TypeError, ValueError):
+                return {"error": "hour must be an integer"}, 400
+        state.bus.publish(channel, event)
+        return {"status": "published", "count": len(obs)}, 200
+
+    @app.route("/api/live", methods=("GET",))
+    def live_state(request):
+        """Live-traffic surface: ingest/customizer/retrain state, the
+        serving metric epoch, and — with ``?metric=1`` — the blended
+        per-edge seconds themselves (the array the bench's scipy
+        oracle re-solves against)."""
+        if state.live is None:
+            return {"enabled": False}, 200
+        out = state.live.snapshot()
+        if request.args.get("metric") and state.live.router is not None:
+            metric = state.live.router.live_metric_export()
+            if metric is not None:
+                out["edge_time_s"] = [round(float(v), 4) for v in metric]
+                out["n_edges"] = len(metric)
+        return out, 200
 
     @app.route("/api/realtime_feed", methods=("GET",))
     def realtime_feed(request):
@@ -743,6 +820,23 @@ def create_app(config: Optional[Config] = None,
                 "leg_cost_model": r.leg_cost_model,
                 "transformer": bool(r.has_transformer),
                 **r.solver_info,
+            }
+        # Live-traffic gauge: armed/ready state + estimator coverage +
+        # serving metric epoch (absent entirely when RTPU_LIVE is off —
+        # the frozen-world health shape is unchanged).
+        if state.live is not None:
+            live_snap = state.live.snapshot()
+            engine_res["live"] = {
+                "ready": live_snap.get("ready", False),
+                "epoch": live_snap.get("epoch", 0),
+                "edges_observed": live_snap.get(
+                    "ingest", {}).get("edges_observed", 0),
+                "confidence_mean": live_snap.get(
+                    "ingest", {}).get("confidence_mean", 0.0),
+                "flips": live_snap.get(
+                    "customize", {}).get("flips", 0),
+                **({"error": live_snap["error"]}
+                   if live_snap.get("error") else {}),
             }
         model_res = {"status": "ok" if state.eta.available else "degraded",
                      "generation": state.eta.generation,

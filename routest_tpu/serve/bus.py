@@ -41,17 +41,23 @@ class InMemoryBus:
         self._history: Dict[str, List] = {}  # channel -> [(id, data), …]
         self._last_pub: Dict[str, float] = {}
 
-    def _evict_stale_locked(self, now: float) -> None:
+    def _evict_stale_locked(self, now: float,
+                            incoming: Optional[str] = None) -> None:
         """Channel names come from clients (route_id), so replay state
-        must be bounded: past MAX_CHANNELS, drop the least-recently
+        must be bounded: at MAX_CHANNELS, drop the least-recently
         published channels WITHOUT live subscribers (their resume
-        window is long gone anyway)."""
-        if len(self._history) <= self.MAX_CHANNELS:
+        window is long gone anyway). ``incoming`` is the channel about
+        to be inserted — counting it keeps the bound exact instead of
+        settling one past the cap (eviction runs before insertion)."""
+        overflow = len(self._history) - self.MAX_CHANNELS
+        if incoming is not None and incoming not in self._history:
+            overflow += 1
+        if overflow <= 0:
             return
         idle = sorted(
             (ch for ch in self._history if not self._subscribers.get(ch)),
             key=lambda ch: self._last_pub.get(ch, 0.0))
-        for ch in idle[: max(0, len(self._history) - self.MAX_CHANNELS)]:
+        for ch in idle[:overflow]:
             self._history.pop(ch, None)
             self._next_id.pop(ch, None)
             self._last_pub.pop(ch, None)
@@ -61,7 +67,7 @@ class InMemoryBus:
 
         with self._lock:
             now = _time.monotonic()
-            self._evict_stale_locked(now)
+            self._evict_stale_locked(now, incoming=channel)
             event_id = self._next_id.get(channel, 0) + 1
             self._next_id[channel] = event_id
             self._last_pub[channel] = now

@@ -6,15 +6,18 @@ function of the encoded 12-feature row — identical rows through the
 same model artifact produce identical minutes — so a prediction can be
 cached and deduplicated with NO semantic drift:
 
-- **Cache** — an LRU with lazy TTL expiry, keyed by ``(model
-  generation, row bytes)``. The generation is a process-wide counter
-  bumped every time ``EtaService`` brings a serving state live
-  (startup and every successful ``reload_if_changed()``), so a
-  hot-reload makes every old entry unreachable the instant the serving
-  snapshot flips — there is no window where a new model serves an old
-  model's numbers. Keys are the raw row bytes (48 B for the ABI row),
-  not a digest: exact equality, zero collision risk, and the dict's own
-  hashing is the content address.
+- **Cache** — an LRU with lazy TTL expiry, keyed by ``(generation,
+  row bytes)``. The generation is OPAQUE to this module — any hashable
+  value whose change must retire every cached prediction. The serving
+  layer passes ``(model generation, live-metric epoch)``: the model
+  half is a process-wide counter bumped every time ``EtaService``
+  brings a serving state live (startup and every successful
+  ``reload_if_changed()``), the epoch half is the live-traffic metric
+  generation (``routest_tpu/live``, 0 while live traffic is off) — so
+  neither a hot-reload nor a metric flip leaves a window where new
+  serving state answers with old numbers. Keys are the raw row bytes
+  (48 B for the ABI row), not a digest: exact equality, zero collision
+  risk, and the dict's own hashing is the content address.
 - **Singleflight** — N concurrent requests for the same uncached row
   cost ONE batcher submit: the first becomes the leader and computes;
   the rest park on an event and read the leader's result
@@ -74,9 +77,11 @@ class FastLane:
         self.singleflight = singleflight
         self.max_rows = int(max_rows)
         self._lock = threading.Lock()
-        # key -> (stored_monotonic, (row-result ndarray, () or (Q,)))
-        self._cache: "OrderedDict[Tuple[int, bytes], Tuple[float, np.ndarray]]" = OrderedDict()
-        self._inflight: Dict[Tuple[int, bytes], _Inflight] = {}
+        # (generation, row bytes) -> (stored_monotonic, row result);
+        # generation is any hashable (the serving layer passes a
+        # (model generation, metric epoch) tuple)
+        self._cache: "OrderedDict[Tuple, Tuple[float, np.ndarray]]" = OrderedDict()
+        self._inflight: Dict[Tuple, _Inflight] = {}
         reg = get_registry()
         self._m_hits = reg.counter(
             "rtpu_cache_hits_total", "Prediction rows served from cache.")
@@ -140,7 +145,7 @@ class FastLane:
 
     # ── the hot path ──────────────────────────────────────────────────
 
-    def predict(self, rows: np.ndarray, generation: int,
+    def predict(self, rows: np.ndarray, generation,
                 compute: Callable[[np.ndarray], np.ndarray]) -> np.ndarray:
         rows = np.ascontiguousarray(rows, np.float32)
         n = len(rows)

@@ -34,12 +34,17 @@ def main() -> None:
 
     env = dict(os.environ)
     broker = None
-    if n > 1 and not env.get("REDIS_URL"):
+    # A broker is needed whenever events must cross process boundaries:
+    # SSE across >1 replica, and — live traffic — the probe stream,
+    # which external probe sources publish INTO the fleet even when a
+    # single replica serves it.
+    if (n > 1 or config.live.enabled) and not env.get("REDIS_URL"):
         from routest_tpu.serve.netbus import start_broker
 
         broker, _ = start_broker()
         env["REDIS_URL"] = f"tcp://127.0.0.1:{broker.port}"
-        _log.info("sse_broker_started", url=env["REDIS_URL"])
+        _log.info("sse_broker_started", url=env["REDIS_URL"],
+                  live_traffic=config.live.enabled)
 
     # Version label for the boot fleet (rollouts replace it per-replica;
     # RTPU_VERSION names what THIS deploy is serving).

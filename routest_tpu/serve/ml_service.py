@@ -1195,8 +1195,15 @@ class EtaService:
             rows = np.where(bad[:, None], np.float32(0.0), rows)
         fl = self._fastlane
         if fl is not None and fl.accepts(len(rows)):
+            from routest_tpu.live import metric_epoch
+
+            # Cache key = (model generation, live-metric epoch): a
+            # metric flip retires every cached prediction the same way
+            # a model swap does, so no served number outlives either
+            # kind of change. Epoch is 0 (one stable key) while live
+            # traffic is off.
             preds = fl.predict(
-                rows, serving.generation,
+                rows, (serving.generation, metric_epoch()),
                 lambda miss: self._submit_chunked(batcher, miss))
         else:
             preds = self._submit_chunked(batcher, rows)

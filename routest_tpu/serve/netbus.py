@@ -153,14 +153,20 @@ class Broker(socketserver.ThreadingTCPServer):
         self._history: Dict[str, list] = {}  # channel -> [(id, line), …]
         self._last_pub: Dict[str, float] = {}
 
-    def _evict_stale_locked(self, now: float) -> None:
-        """Bound replay state (same policy as InMemoryBus): past the cap,
-        drop the least-recently published subscriber-less channels."""
-        if len(self._history) <= self.MAX_CHANNELS:
+    def _evict_stale_locked(self, now: float,
+                            incoming: Optional[str] = None) -> None:
+        """Bound replay state (same policy as InMemoryBus): at the cap,
+        drop the least-recently published subscriber-less channels.
+        ``incoming`` counts the channel about to be inserted so the
+        bound holds exactly (eviction runs before insertion)."""
+        overflow = len(self._history) - self.MAX_CHANNELS
+        if incoming is not None and incoming not in self._history:
+            overflow += 1
+        if overflow <= 0:
             return
         idle = sorted((ch for ch in self._history if not self._subs.get(ch)),
                       key=lambda ch: self._last_pub.get(ch, 0.0))
-        for ch in idle[: max(0, len(self._history) - self.MAX_CHANNELS)]:
+        for ch in idle[:overflow]:
             self._history.pop(ch, None)
             self._next_id.pop(ch, None)
             self._last_pub.pop(ch, None)
@@ -188,7 +194,7 @@ class Broker(socketserver.ThreadingTCPServer):
     def fanout(self, channel: str, data) -> int:
         with self._subs_lock:
             now = time.monotonic()
-            self._evict_stale_locked(now)
+            self._evict_stale_locked(now, incoming=channel)
             event_id = self._next_id.get(channel, 0) + 1
             self._next_id[channel] = event_id
             self._last_pub[channel] = now

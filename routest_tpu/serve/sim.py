@@ -73,10 +73,23 @@ def simulate_route(
     return ticks
 
 
-def start_simulation(data: dict, publish, tick_range_s: tuple = (2.0, 5.0)) -> threading.Thread:
+def start_simulation(data: dict, publish,
+                     tick_range_s: tuple = (2.0, 5.0),
+                     rng: Optional[random.Random] = None,
+                     seed: Optional[int] = None) -> threading.Thread:
+    """Run :func:`simulate_route` on a daemon thread.
+
+    ``rng`` (or ``seed``, which builds one) threads a seeded generator
+    through to the tick-interval jitter, so probe scenarios and tests
+    replay bit-identically — the same determinism convention as the
+    chaos engine and loadgen. Unseeded callers keep the historical
+    fresh-``random.Random()`` behavior."""
+    if rng is None and seed is not None:
+        rng = random.Random(int(seed))
+
     def run():
         try:
-            simulate_route(data, publish, tick_range_s)
+            simulate_route(data, publish, tick_range_s, rng=rng)
         except Exception as e:  # daemon thread: never die silently
             from routest_tpu.utils.logging import get_logger
 

@@ -1,0 +1,162 @@
+"""Bus behavior under the probe-stream load shape (satellite of the
+live-traffic PR): hundreds–thousands of channels, bounded replay
+state, slow subscribers, and queue overflow — for both the in-process
+``InMemoryBus`` and the cross-process netbus broker."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from routest_tpu.serve.bus import InMemoryBus
+from routest_tpu.serve.netbus import Broker, NetBus, start_broker
+
+
+# ── InMemoryBus ──────────────────────────────────────────────────────
+
+
+def test_inmemory_history_eviction_under_channel_churn():
+    bus = InMemoryBus()
+    cap = InMemoryBus.MAX_CHANNELS
+    for i in range(cap + 500):
+        bus.publish(f"ch-{i}", {"i": i})
+    # replay state is bounded: at most MAX_CHANNELS channels retained
+    assert len(bus._history) <= cap
+    # the most recently published channels survive (LRU-by-publish)
+    assert f"ch-{cap + 499}" in bus._history
+    assert "ch-0" not in bus._history
+
+
+def test_inmemory_eviction_spares_live_subscribers():
+    bus = InMemoryBus()
+    cap = InMemoryBus.MAX_CHANNELS
+    sub = bus.subscribe("keep-me")
+    bus.publish("keep-me", {"v": 1})
+    for i in range(cap + 100):
+        bus.publish(f"churn-{i}", {"i": i})
+    # the subscribed channel's replay ring survives the churn
+    assert "keep-me" in bus._history
+    assert sub.get(timeout=0.5) == {"v": 1}
+    sub.close()
+
+
+def test_inmemory_max_queue_overflow_drops_oldest_keeps_stream_live():
+    bus = InMemoryBus(max_queue=4, history=64)
+    sub = bus.subscribe("c")
+    for i in range(20):
+        bus.publish("c", {"i": i})
+    got = []
+    while True:
+        v = sub.get(timeout=0.05)
+        if v is None:
+            break
+        got.append(v["i"])
+    # bounded: only max_queue events buffered; the NEWEST survive (the
+    # slow-consumer policy drops oldest so the stream stays current)
+    assert len(got) == 4
+    assert got[-1] == 19
+    # and the stream is still live afterwards
+    bus.publish("c", {"i": 99})
+    assert sub.get(timeout=0.5) == {"i": 99}
+    sub.close()
+
+
+def test_inmemory_many_channels_fanout_isolated():
+    bus = InMemoryBus()
+    subs = {i: bus.subscribe(f"d{i}") for i in range(0, 300, 7)}
+    for i in range(300):
+        bus.publish(f"d{i}", {"i": i})
+    for i, sub in subs.items():
+        assert sub.get(timeout=0.5) == {"i": i}
+        assert sub.get(timeout=0.01) is None  # no cross-channel leakage
+        sub.close()
+
+
+# ── netbus broker ────────────────────────────────────────────────────
+
+
+@pytest.fixture()
+def broker():
+    b, _t = start_broker()
+    yield b
+    b.shutdown()
+
+
+def test_broker_history_eviction_bounded(broker):
+    bus = NetBus(f"tcp://127.0.0.1:{broker.port}")
+    cap = Broker.MAX_CHANNELS
+    # publish past the cap on subscriber-less channels
+    for i in range(cap + 64):
+        bus.publish(f"p{i}", {"i": i})
+    assert len(broker._history) <= cap
+    assert f"p{cap + 63}" in broker._history
+
+
+def test_broker_hundreds_of_probe_channels(broker):
+    """The probe load shape: many drivers, each its own channel, one
+    subscriber reading a few of them — no leakage, ids per channel."""
+    bus = NetBus(f"tcp://127.0.0.1:{broker.port}")
+    subs = {i: bus.subscribe(f"drv-{i}") for i in (0, 57, 199)}
+    for round_i in range(3):
+        for i in range(200):
+            bus.publish(f"drv-{i}", {"i": i, "round": round_i})
+    for i, sub in subs.items():
+        for round_i in range(3):
+            msg = sub.get(timeout=2.0)
+            assert msg == {"i": i, "round": round_i}
+        assert sub.get(timeout=0.05) is None
+        assert sub.last_id == 3  # per-channel ids, not global
+        sub.close()
+
+
+def test_broker_slow_subscriber_dropped_not_blocking(broker):
+    """A subscriber that stops reading must not stall the channel for
+    a healthy peer: the broker's send timeout drops it and closes its
+    socket, while the healthy subscriber keeps receiving."""
+    url = f"tcp://127.0.0.1:{broker.port}"
+    bus = NetBus(url, ack_timeout=30.0)
+    healthy = bus.subscribe("firehose")
+    # raw slow consumer: subscribes, then never reads
+    slow = socket.create_connection(("127.0.0.1", broker.port))
+    slow.sendall(json.dumps({"op": "subscribe",
+                             "channel": "firehose"}).encode() + b"\n")
+    time.sleep(0.2)
+    # tiny receive buffer so the broker's send side fills fast
+    slow.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 2048)
+    payload = {"pad": "x" * 4096}
+    done = {"count": 0}
+
+    def publish_many():
+        for _ in range(200):
+            bus.publish("firehose", payload)
+            done["count"] += 1
+
+    t = threading.Thread(target=publish_many, daemon=True)
+    t.start()
+    t.join(timeout=60.0)
+    assert not t.is_alive(), "publishes wedged behind the slow consumer"
+    assert done["count"] == 200
+    # the healthy subscriber still drains events (some may replay)
+    got = 0
+    while healthy.get(timeout=0.2) is not None:
+        got += 1
+        if got >= 50:
+            break
+    assert got >= 50
+    healthy.close()
+    slow.close()
+
+
+def test_broker_replay_rings_bounded_per_channel(broker):
+    bus = NetBus(f"tcp://127.0.0.1:{broker.port}")
+    for i in range(Broker.HISTORY * 3):
+        bus.publish("ring", {"i": i})
+    ring = broker._history["ring"]
+    assert len(ring) == Broker.HISTORY
+    # resume from 0 replays only the retained window, newest-aligned
+    sub = bus.subscribe("ring", last_event_id=0)
+    first = sub.get(timeout=2.0)
+    assert first["i"] == Broker.HISTORY * 2
+    sub.close()

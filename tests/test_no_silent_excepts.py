@@ -21,6 +21,7 @@ import os
 import pytest
 
 import routest_tpu.chaos
+import routest_tpu.live
 import routest_tpu.obs
 import routest_tpu.serve
 import routest_tpu.serve.fleet
@@ -39,6 +40,10 @@ FLEET_ROOT = os.path.dirname(
 # The chaos engine is what every robustness claim leans on; it must
 # never eat its own errors either.
 CHAOS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.chaos.__file__))
+# Live traffic runs on daemon threads (ingest, customize, retrain): a
+# silently swallowed failure there means a silently frozen world —
+# stale metrics serving forever with nothing in the logs.
+LIVE_ROOT = os.path.dirname(os.path.abspath(routest_tpu.live.__file__))
 
 BROAD = {"Exception", "BaseException"}
 
@@ -75,8 +80,9 @@ def _offenders(path):
 
 
 @pytest.mark.parametrize("root",
-                         [SERVE_ROOT, OBS_ROOT, FLEET_ROOT, CHAOS_ROOT],
-                         ids=["serve", "obs", "fleet", "chaos"])
+                         [SERVE_ROOT, OBS_ROOT, FLEET_ROOT, CHAOS_ROOT,
+                          LIVE_ROOT],
+                         ids=["serve", "obs", "fleet", "chaos", "live"])
 def test_no_silent_broad_excepts(root):
     offenders = []
     for dirpath, dirnames, filenames in os.walk(root):
