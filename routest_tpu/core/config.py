@@ -70,8 +70,18 @@ class ServeConfig:
     # ``max_wait_ms`` elapse, whichever first (SURVEY.md §7.3 item 4).
     max_batch: int = 4096
     max_wait_ms: float = 2.0
-    # Bucketed pad sizes to avoid recompiles.
-    batch_buckets: Tuple[int, ...] = (8, 64, 512, 4096)
+    # Bucketed pad sizes to avoid recompiles (``RTPU_BATCH_BUCKETS``,
+    # comma-separated). Every bucket is AOT-compiled at startup (see
+    # ``serve_aot``), so adding one costs boot time, not first-request
+    # latency; the 1024/2048 steps bound pad waste for mid-size batches
+    # (a 1024-row request used to pad 4× to the 4096 bucket).
+    batch_buckets: Tuple[int, ...] = (8, 64, 512, 1024, 2048, 4096)
+    # AOT serving entry (docs/PERFORMANCE.md "Scoring artifact"): the
+    # full score program is ``jit().lower().compile()``d per bucket at
+    # startup with the input slab donated, so no bucket ever pays
+    # trace+compile (or jit dispatch overhead) on a customer request.
+    # ``RTPU_SERVE_AOT=0`` restores the plain jit path.
+    serve_aot: bool = True
     # Model hot-reload: poll the artifact every N seconds and swap a
     # changed file in without a restart. 0 (default) disables.
     reload_sec: float = 0.0
@@ -425,11 +435,31 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
             warnings.warn(f"{name}={raw!r} is not a number; using {default}")
             return default
 
+    def _buckets(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
+        # Ops knob: malformed entries keep the default (boot must not
+        # abort on a typo); values are sorted/deduped downstream by the
+        # batcher's align rounding.
+        raw = env.get(name)
+        if not raw:
+            return default
+        try:
+            vals = tuple(sorted({int(v) for v in raw.split(",") if v.strip()}))
+            return vals if vals and all(v > 0 for v in vals) else default
+        except ValueError:
+            import warnings
+
+            warnings.warn(f"{name}={raw!r} is not a bucket list; "
+                          f"using {default}")
+            return default
+
     serve = ServeConfig(
         host=env.get("RTPU_HOST", "127.0.0.1"),
         port=_int("PORT", _int("RTPU_PORT", 5000)),
         max_batch=_int("RTPU_MAX_BATCH", 4096),
         max_wait_ms=_float("RTPU_MAX_WAIT_MS", 2.0),
+        batch_buckets=_buckets("RTPU_BATCH_BUCKETS",
+                               ServeConfig.batch_buckets),
+        serve_aot=env.get("RTPU_SERVE_AOT", "1") != "0",
         reload_sec=_float_tolerant("ROUTEST_RELOAD_SEC", 0.0),
         fastlane_cache=env.get("RTPU_FASTLANE_CACHE", "1") != "0",
         fastlane_cache_size=_int("RTPU_FASTLANE_CACHE_SIZE", 8192),

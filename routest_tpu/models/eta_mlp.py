@@ -46,6 +46,49 @@ _N_WEEKDAYS = 7
 _INTERNAL_FEATURES = 4 + 4 + _N_WEEKDAYS + _N_HOURS + 3
 
 
+def _cumsum_matrix(n_q: int) -> np.ndarray:
+    """(2Q, 2Q) block-diagonal upper-triangular ones: ``sp @ M`` computes
+    BOTH head cumsums (pace cols 0..Q-1, overhead cols Q..2Q-1) in one
+    matmul. ``cumsum`` along a tiny axis lowers to a reduce-window /
+    scan that XLA cannot fuse with the surrounding elementwise graph;
+    a constant-matrix dot fuses, runs on the MXU, and is exactly the
+    same sum (ones-matrix matmul adds the identical terms)."""
+    tri = np.triu(np.ones((n_q, n_q), np.float32))
+    m = np.zeros((2 * n_q, 2 * n_q), np.float32)
+    m[:n_q, :n_q] = tri
+    m[n_q:, n_q:] = tri
+    return m
+
+
+def quantile_heads(out: jax.Array, dist_km: jax.Array,
+                   n_q: int) -> jax.Array:
+    """Fused non-crossing quantile epilogue: raw head outputs
+    (…, 2Q) + distance (…,) → per-quantile ETA minutes (…, Q).
+
+    pace/overhead for quantile 0 are softplus-positive; each later
+    quantile adds a softplus-positive increment (cumulative sum), so
+    ``eta[:, i] <= eta[:, i+1]`` for every input and parameter setting —
+    crossing quantiles are unrepresentable. The cumulative sums run as
+    ONE constant-matrix matmul (``_cumsum_matrix``) so the whole
+    epilogue is softplus → dot → multiply-add: three fusable ops instead
+    of two scans. ``quantile_heads_unfused`` is the scan-form oracle the
+    parity tests compare against."""
+    sp = jax.nn.softplus(out[..., : 2 * n_q])
+    cum = sp @ jnp.asarray(_cumsum_matrix(n_q), sp.dtype)
+    return cum[..., :n_q] * dist_km[..., None] + cum[..., n_q:]
+
+
+def quantile_heads_unfused(out: jax.Array, dist_km: jax.Array,
+                           n_q: int) -> jax.Array:
+    """Reference (pre-fusion) epilogue: explicit ``jnp.cumsum`` per head
+    family. Semantics oracle for :func:`quantile_heads` — kept for the
+    parity tests and the serving-kernel bench's fused-vs-unfused rows;
+    serving always runs the fused form."""
+    pace = jnp.cumsum(jax.nn.softplus(out[..., :n_q]), axis=-1)
+    overhead = jnp.cumsum(jax.nn.softplus(out[..., n_q:2 * n_q]), axis=-1)
+    return pace * dist_km[..., None] + overhead
+
+
 @dataclasses.dataclass(frozen=True)
 class EtaMLP:
     """Configured model; ``init``/``apply`` are pure functions of params.
@@ -171,16 +214,16 @@ class EtaMLP:
         pace/overhead for quantile 0 are softplus-positive; each later
         quantile adds a softplus-positive increment (cumulative sum), so
         ``eta[:, i] <= eta[:, i+1]`` holds for every input and parameter
-        setting — crossing quantiles are unrepresentable.
+        setting — crossing quantiles are unrepresentable. The epilogue
+        runs in the fused matmul form (:func:`quantile_heads`) — same
+        sums, one fusable dot instead of two scans.
         """
         if not self.quantiles:
             raise ValueError("apply_quantiles on a point model; "
                              "construct EtaMLP(quantiles=...)")
         n_q = len(self.quantiles)
         out, dist_km = self._trunk(params, x)
-        pace = jnp.cumsum(jax.nn.softplus(out[..., :n_q]), axis=-1)
-        overhead = jnp.cumsum(jax.nn.softplus(out[..., n_q:]), axis=-1)
-        return pace * dist_km[..., None] + overhead
+        return quantile_heads(out, dist_km, n_q)
 
 
 def fit_normalizer(features: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

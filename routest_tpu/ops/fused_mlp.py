@@ -47,6 +47,22 @@ Design notes:
 - matmuls run on the MXU in the model policy's compute dtype (bfloat16)
   with float32 accumulation.
 
+Compute-dtype variants (``RTPU_KERNEL_DTYPE``, or the ``dtype=`` arg of
+``pack_eta_params``): ``bf16`` (default — MXU-native matmuls),
+``f32`` (full-precision matmuls, parity/debug), and ``int8`` —
+weights quantized per output column to int8 at pack time (4× less
+weight HBM traffic; min int8 tile is (32, 128) and every padded weight
+dim is a multiple of 128, so the layout is tile-legal) and dequantized
+in VMEM to bf16 before the dot. EVERY variant accumulates in float32
+(``preferred_element_type``); activations and the epilogue stay f32.
+
+The quantile epilogue is fused in-kernel: the 2·Q raw heads go through
+softplus once, then ONE constant-matrix dot computes both cumulative
+sums (the same block-triangular trick as ``eta_mlp.quantile_heads``) —
+non-crossing by construction regardless of dtype, since the cumsum of
+softplus-positive increments is monotone whatever error quantization
+put into the increments themselves.
+
 Semantics are identical to ``EtaMLP.apply`` on the 12-feature ABI
 (SURVEY.md Appendix B, ``Flaskr/ml.py:35-48``): unknown categories hit
 zero weight rows, distance is clamped non-negative, two softplus heads
@@ -62,6 +78,7 @@ no custom VJP is defined here.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Tuple
 
 import jax
@@ -99,12 +116,39 @@ _ROW_DIST, _ROW_LOGD, _ROW_AGE = 39, 40, 41
 
 Packed = Dict[str, List[jax.Array]]
 
+# Compute-dtype variants (RTPU_KERNEL_DTYPE / pack_eta_params(dtype=)).
+_DTYPE_ALIASES = {
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "f32": "float32", "fp32": "float32", "float32": "float32",
+    "int8": "int8",
+}
+
+
+def resolve_kernel_dtype(model=None, dtype=None) -> str:
+    """Canonical kernel compute-dtype name: explicit ``dtype`` arg, then
+    ``RTPU_KERNEL_DTYPE``, then the model policy's compute dtype. An
+    unknown name raises — kernel selection must stay LOUD (the serving
+    layer logs ``fused_kernel_unavailable`` and falls back to XLA), not
+    silently serve a different precision than the operator asked for."""
+    raw = dtype or os.environ.get("RTPU_KERNEL_DTYPE")
+    if not raw:
+        if model is not None:
+            raw = np.dtype(model.policy.compute_dtype).name
+        else:
+            raw = "bfloat16"
+    name = _DTYPE_ALIASES.get(str(raw).strip().lower())
+    if name is None:
+        raise ValueError(
+            f"RTPU_KERNEL_DTYPE={raw!r} is not a kernel variant "
+            f"(choose from bf16 / f32 / int8)")
+    return name
+
 
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
-def pack_eta_params(model, params) -> Packed:
+def pack_eta_params(model, params, dtype: str = None) -> Packed:
     """EtaMLP params → kernel-layout weights (a jit-friendly pytree).
 
     Layer 0 is re-rowed to the kernel's lane layout with the normalizer
@@ -112,15 +156,24 @@ def pack_eta_params(model, params) -> Packed:
     scaling the weight row by ``1/std`` and shifting the bias by
     ``-mean/std · row``. All dims pad up to multiples of 128 (MXU tiles);
     padding rows/cols are zero so they are exact no-ops through gelu.
+
+    ``dtype`` selects the compute variant (``resolve_kernel_dtype``):
+    bf16/f32 store the weights in that dtype; int8 stores them quantized
+    per OUTPUT column (symmetric, scale = max|col|/127 — per-column
+    because a whole-layer scale lets one outlier column crush the
+    resolution of every other) with f32 scales under ``"scale"``.
+    Biases are always f32 — they add into the f32 accumulator.
     """
     layers = params["layers"]
     norm = params["norm"]
     mean = np.asarray(norm["mean"], np.float32)
     std = np.asarray(norm["std"], np.float32)
-    compute = model.policy.compute_dtype
+    variant = resolve_kernel_dtype(model, dtype)
+    compute = jnp.bfloat16 if variant == "bfloat16" else jnp.float32
 
     ws: List[jax.Array] = []
     bs: List[jax.Array] = []
+    scales: List[jax.Array] = []
     for i, layer in enumerate(layers):
         w = np.asarray(layer["w"], np.float32)
         b = np.asarray(layer["b"], np.float32)
@@ -144,20 +197,33 @@ def pack_eta_params(model, params) -> Packed:
             wp[:d_in, :d_out] = w
             bp = np.zeros((1, wp.shape[1]), np.float32)
             bp[0, :d_out] = b
-        ws.append(jnp.asarray(wp, compute))
+        if variant == "int8":
+            s = np.abs(wp).max(axis=0) / 127.0
+            s[s < 1e-12] = 1.0  # all-zero (padding) columns: exact zeros
+            ws.append(jnp.asarray(np.rint(wp / s), jnp.int8))
+            scales.append(jnp.asarray(s[None, :], jnp.float32))
+        else:
+            ws.append(jnp.asarray(wp, compute))
         bs.append(jnp.asarray(bp, jnp.float32))
-    return {"w": ws, "b": bs}
+    packed: Packed = {"w": ws, "b": bs}
+    if variant == "int8":
+        packed["scale"] = scales
+    return packed
 
 
-def _kernel(n_layers: int, compute, n_q: int, x_ref, *refs) -> None:
+def _kernel(n_layers: int, compute, n_q: int, quant: bool,
+            x_ref, *refs) -> None:
     """One batch tile: expand → matmul chain → eta, all in VMEM.
 
-    refs = w_0, b_0, …, w_{n-1}, b_{n-1}, out_ref. ``n_q == 0`` is the
-    2-head point model; ``n_q > 0`` fuses the quantile epilogue too
-    (``EtaMLP.apply_quantiles``: cumulative softplus pace/overhead
-    increments ⇒ non-crossing quantiles), unrolled over the few heads —
-    pure VPU lane arithmetic, so the uncertainty band costs no extra
-    HBM pass.
+    refs = w_0, b_0[, s_0], …, w_{n-1}, b_{n-1}[, s_{n-1}], out_ref
+    (``quant`` adds the per-column int8 scales; weights dequantize in
+    VMEM to the compute dtype, so HBM only ever moves int8 weights).
+    ``n_q == 0`` is the 2-head point model; ``n_q > 0`` fuses the
+    quantile epilogue too (``EtaMLP.apply_quantiles``): one softplus
+    over the padded head lanes, then ONE constant-matrix dot per head
+    family computes the cumulative sums (MXU-shaped — K is the padded
+    128-lane head dim) ⇒ non-crossing quantiles with no per-head
+    unrolled lane slicing and no extra HBM pass for the band.
 
     The tile arrives in its natural (tile, 12) ABI width and leaves as
     (tile, 1) / (tile, n_q); minor-dim lane padding means HBM still
@@ -193,9 +259,19 @@ def _kernel(n_layers: int, compute, n_q: int, x_ref, *refs) -> None:
     )
 
     h = xfull.astype(compute)
+    stride = 3 if quant else 2
     for i in range(n_layers):
-        w_ref, b_ref = refs[2 * i], refs[2 * i + 1]
-        out = jnp.dot(h, w_ref[:], preferred_element_type=jnp.float32)
+        w_ref, b_ref = refs[stride * i], refs[stride * i + 1]
+        if quant:
+            # Dequantize in VMEM: int8 weights stream from HBM at a
+            # quarter of the f32 bill; per-column f32 scales broadcast
+            # over the rows. The dot still runs in the compute dtype
+            # with f32 accumulation.
+            s_ref = refs[stride * i + 2]
+            w = (w_ref[:].astype(jnp.float32) * s_ref[:]).astype(compute)
+        else:
+            w = w_ref[:]
+        out = jnp.dot(h, w, preferred_element_type=jnp.float32)
         out = out + b_ref[:]
         if i < n_layers - 1:
             h = jax.nn.gelu(out).astype(compute)
@@ -204,14 +280,23 @@ def _kernel(n_layers: int, compute, n_q: int, x_ref, *refs) -> None:
         overhead = jax.nn.softplus(out[:, 1:2])
         out_ref[:] = pace * dist + overhead
     else:
-        pace = jnp.zeros((tile, 1), jnp.float32)
-        overhead = jnp.zeros((tile, 1), jnp.float32)
-        etas = []
-        for qi in range(n_q):  # unrolled cumsum: heads are few
-            pace = pace + jax.nn.softplus(out[:, qi:qi + 1])
-            overhead = overhead + jax.nn.softplus(out[:, n_q + qi:n_q + qi + 1])
-            etas.append(pace * dist + overhead)
-        out_ref[:] = jnp.concatenate(etas, axis=1)
+        # Fused epilogue, MXU form: softplus over the whole padded head
+        # block (the VPU processes 128 lanes per cycle either way), then
+        # one triangular-matrix dot per head family computes the
+        # cumulative sums. The triangular selectors are built in-kernel
+        # from iota (Pallas kernels may not capture array constants);
+        # rows ≥ 2·n_q are zero, so the softplus(0) on padding lanes
+        # never contributes.
+        d_head = out.shape[1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (d_head, n_q), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (d_head, n_q), 1)
+        pace_m = ((row <= col) & (row < n_q)).astype(jnp.float32)
+        over_m = ((row - n_q <= col) & (row >= n_q)
+                  & (row < 2 * n_q)).astype(jnp.float32)
+        sp = jax.nn.softplus(out)
+        pace = jnp.dot(sp, pace_m, preferred_element_type=jnp.float32)
+        overhead = jnp.dot(sp, over_m, preferred_element_type=jnp.float32)
+        out_ref[:] = pace * dist + overhead
 
 
 @functools.partial(jax.jit, static_argnames=("n_q", "tile", "interpret"))
@@ -221,9 +306,16 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *, n_q: int = 0,
     minutes for a quantile model — via the fused kernel.
 
     ``interpret=True`` runs the Pallas interpreter (any backend) — used by
-    the CPU test suite; compiled mode requires a TPU.
+    the CPU test suite; compiled mode requires a TPU. The compute
+    variant (bf16 / f32 / int8-weight, see ``pack_eta_params``) is
+    carried by the packed pytree itself.
     """
     ws, bs = packed["w"], packed["b"]
+    scales = packed.get("scale")
+    quant = scales is not None
+    # int8 variant: dequantized matmuls run in bf16 (MXU-native);
+    # otherwise the packed weight dtype IS the compute dtype.
+    compute = jnp.bfloat16 if quant else ws[0].dtype
     n_layers = len(ws)
     b_rows = x.shape[0]
     if b_rows == 0:
@@ -244,20 +336,35 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *, n_q: int = 0,
         xp = xp.at[:b_rows].set(x.astype(jnp.float32))
 
     wb_specs = []
-    for w, b in zip(ws, bs):
+    operands = []
+    for i, (w, b) in enumerate(zip(ws, bs)):
         wb_specs.append(pl.BlockSpec(w.shape, lambda i: (0, 0),
                                      memory_space=pltpu.VMEM))
         wb_specs.append(pl.BlockSpec(b.shape, lambda i: (0, 0),
                                      memory_space=pltpu.VMEM))
+        operands.extend((w, b))
+        if quant:
+            s = scales[i]
+            wb_specs.append(pl.BlockSpec(s.shape, lambda i: (0, 0),
+                                         memory_space=pltpu.VMEM))
+            operands.append(s)
 
     n_out = n_q if n_q else 1
     flops = 2 * b_pad * sum(w.shape[0] * w.shape[1] for w in ws)
+    if n_q:
+        # Fused epilogue: two (d_head, n_q) constant dots + the
+        # multiply-add per quantile.
+        flops += 2 * b_pad * (2 * ws[-1].shape[1] * n_q + n_q)
     # Physical traffic: minor dims pad to 128 lanes in HBM's (8, 128)
-    # f32 tiling, so input and output each move b_pad*128*4 bytes.
+    # f32 tiling, so input and output each move b_pad*128*4 bytes; the
+    # weight bill is the STORED dtype (1 byte/elem for int8 + its f32
+    # scales), which is the whole point of the quantized variant.
     bytes_accessed = 2 * b_pad * LANES * 4 + sum(
         w.size * w.dtype.itemsize for w in ws)
+    if quant:
+        bytes_accessed += sum(s.size * 4 for s in scales)
     out = pl.pallas_call(
-        functools.partial(_kernel, n_layers, ws[0].dtype, n_q),
+        functools.partial(_kernel, n_layers, compute, n_q, quant),
         grid=(b_pad // tile,),
         in_specs=[pl.BlockSpec((tile, N_FEATURES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)] + wb_specs,
@@ -268,10 +375,13 @@ def fused_eta_forward(packed: Packed, x: jax.Array, *, n_q: int = 0,
             dimension_semantics=("parallel",)),
         cost_estimate=pl.CostEstimate(
             flops=flops, bytes_accessed=bytes_accessed,
-            transcendentals=b_pad * (sum(w.shape[1] for w in ws[:-1]) + 2),
+            # gelu per hidden lane + softplus over the (padded) head
+            # lanes of the fused epilogue (2 for the point model).
+            transcendentals=b_pad * (sum(w.shape[1] for w in ws[:-1])
+                                     + (ws[-1].shape[1] if n_q else 2)),
         ),
         interpret=interpret,
-    )(xp, *[a for pair in zip(ws, bs) for a in pair])
+    )(xp, *operands)
     if n_q:
         return out[:b_rows, :n_q]
     return out[:b_rows, 0]

@@ -152,7 +152,15 @@ class FastLane:
         if not self.accepts(n):
             self._m_bypass.inc()
             return compute(rows)
-        keys = [(generation, rows[i].tobytes()) for i in range(n)]
+        # ONE tobytes for the whole batch, then per-row slices: a
+        # per-row rows[i].tobytes() loop was measurable fixed overhead
+        # at the 1024-row request size (docs/PERFORMANCE.md "Scoring
+        # artifact" — the fast lane sits on the decomposition's fixed-
+        # cost side, so per-row python here is paid by every request).
+        buf = rows.tobytes()
+        width = rows.shape[1] * rows.itemsize
+        keys = [(generation, buf[i * width:(i + 1) * width])
+                for i in range(n)]
         out: List[Optional[np.ndarray]] = [None] * n
         # Classification under ONE lock pass: cache hit, join an
         # in-flight computation, or become the leader for a novel key.
@@ -195,9 +203,14 @@ class FastLane:
         if coalesced:
             self._m_coalesced.inc(coalesced)
 
+        all_leads = len(lead_rows) == n
         if lead_keys:
             try:
-                preds = np.asarray(compute(rows[lead_rows]))
+                # all_leads ⇒ lead_rows is 0..n-1 in order: pass the
+                # caller's batch straight through (no fancy-index copy
+                # on the all-unique workload).
+                preds = np.asarray(compute(
+                    rows if all_leads else rows[lead_rows]))
             except BaseException as e:
                 # Chaos-safe: nothing cached, every waiter gets the
                 # error, the inflight slots disappear so the NEXT
@@ -211,17 +224,29 @@ class FastLane:
                                 flight.event.set()
                 raise
             now = time.monotonic()
+            # ONE owning host copy for the whole compute result; this
+            # request's answers (out rows, singleflight waiters) are
+            # row VIEWS of it — request-lifetime only. Cache entries
+            # still copy their row: a cached view would pin the whole
+            # (rows × width) base for as long as ONE hot row stays
+            # resident, turning an 8k-entry cache into hundreds of MB
+            # under skewed traffic.
+            owned = np.array(preds)
             with self._lock:
                 for slot, key in enumerate(lead_keys):
-                    value = np.array(preds[slot])  # own the row's memory
+                    value = owned[slot]
                     if self.cache:
-                        self._cache_put(key, value, now)
+                        self._cache_put(key, np.array(value), now)
                     out[lead_rows[slot]] = value
                     if self.singleflight:
                         flight = self._inflight.pop(key, None)
                         if flight is not None:
                             flight.value = value
                             flight.event.set()
+            if all_leads and not joins:
+                # Nothing came from cache or a peer: the compute result
+                # IS the answer — skip the per-row restack.
+                return owned
         for i, slot in follower_of:
             out[i] = out[lead_rows[slot]]
 

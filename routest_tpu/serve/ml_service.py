@@ -79,6 +79,18 @@ _m_swaps = get_registry().counter(
 _m_generation = get_registry().gauge(
     "rtpu_model_generation",
     "Generation id of the live serving model (monotonic per process).")
+# Scoring-artifact observability (docs/PERFORMANCE.md "Scoring
+# artifact"): one observation per AOT bucket compile at bring-up. The
+# per-bucket COUNT doubles as the "no compile after startup" assertion —
+# if it ever grows while serving, a customer request paid a compile.
+_m_aot_compile = get_registry().histogram(
+    "rtpu_replica_aot_compile_seconds",
+    "AOT compile of the score program per batch bucket "
+    "(jit().lower().compile() at serving bring-up).", ("bucket",))
+_m_cold_start = get_registry().gauge(
+    "rtpu_replica_cold_start_seconds",
+    "Service-construction-to-ready wall time of the live serving state "
+    "(model load + AOT bucket compiles + self-check + warmup).")
 
 
 def _artifact_fingerprint(path: str) -> Optional[str]:
@@ -629,6 +641,12 @@ class DynamicBatcher:
             finally:
                 with self._lock:
                     self._flushing = False
+                    # Slab-rotation safety (the AOT entry DONATES its
+                    # input): the detached slab re-enters circulation
+                    # only HERE, after the flush's device call fully
+                    # consumed its copy — an in-flight donated buffer
+                    # is never rewritten (docs/PERFORMANCE.md §6;
+                    # fuzzed in test_scoring_artifact.py).
                     if batch_slab is not None and self._spare is None:
                         self._spare = batch_slab
                     more = self._queued_rows >= self._drain_cap
@@ -643,11 +661,18 @@ class EtaService:
                  model_path: Optional[str] = None,
                  runtime: Optional[MeshRuntime] = None) -> None:
         cfg = cfg or ServeConfig()
+        self._t_construct = time.perf_counter()
         self._cfg = cfg
         self._runtime = runtime
         self._model: Optional[EtaMLP] = None
         self._params: Optional[Params] = None
         self._error: Optional[str] = None
+        # Scoring-artifact introspection (scoring_info() / health):
+        # which compute path serves, at what dtype, with which buckets
+        # AOT-compiled, selected by which measured record.
+        self.kernel_dtype: Optional[str] = None
+        self._aot_buckets: Tuple[int, ...] = ()
+        self._win_provenance: dict = {}
         self._path = model_path or default_model_path()
         self._loaded_mtime_ns = self._artifact_mtime_ns()
         self._reload_lock = threading.Lock()
@@ -686,36 +711,66 @@ class EtaService:
             from routest_tpu.train.checkpoint import ExportedServingModel
 
             if isinstance(self._model, ExportedServingModel):
-                # AOT export: the traced program IS the artifact (weights
-                # baked in as constants) — call it directly; no params to
-                # place, nothing to jit. Single-logical-device by
-                # construction, so a mesh runtime cannot shard it.
+                # AOT export: the traced program IS the artifact
+                # (weights baked in as constants). A mesh runtime no
+                # longer gets refused: the serialized program compiles
+                # UNDER a jit with the mesh's batch sharding — the same
+                # artifact a multi-chip mesh fans out, compiled with
+                # shardings (ROADMAP item 2's contract). Per-bucket AOT
+                # compiles happen here exactly like the msgpack path.
                 exported = self._model
                 from routest_tpu.utils.logging import get_logger
 
-                if runtime is not None:
-                    get_logger("routest_tpu.serve").warning(
-                        "aot_serving_unsharded",
-                        reason="StableHLO exports are single-logical-"
-                               "device programs; mesh runtime ignored")
                 if os.environ.get("ROUTEST_FUSED") == "1":
                     get_logger("routest_tpu.serve").warning(
                         "fused_kernel_ignored",
                         reason="AOT exports run their serialized program "
                                "as-is; ROUTEST_FUSED has no effect")
+                self.kernel_dtype = "export"
 
-                def aot_score(x: np.ndarray) -> np.ndarray:
+                def direct_score(x: np.ndarray) -> np.ndarray:
+                    # Shape-polymorphic single-device call: the fallback
+                    # for non-bucket shapes on every export path.
                     return exported(np.asarray(x, np.float32))
 
+                if runtime is not None and cfg.serve_aot:
+                    sharding = runtime.batch_sharding()
+                    jitted = jax.jit(exported.call,
+                                     in_shardings=(sharding,),
+                                     donate_argnums=(0,))
+                    score = self._aot_score(jitted, (), sharding,
+                                            direct_score,
+                                            align=runtime.n_data)
+                    if score is not None:
+                        self.kernel = "stablehlo_aot_sharded"
+                        self._finish_init(score, align=runtime.n_data)
+                        return
+                    # Loud degrade (e.g. an export whose recorded device
+                    # count cannot execute on this mesh): the artifact
+                    # still serves single-device rather than not at all.
+                    get_logger("routest_tpu.serve").warning(
+                        "aot_mesh_incompatible",
+                        reason="exported program would not compile under "
+                               "the mesh's shardings; serving single-"
+                               "device (re-export on this topology)")
+                score = None
+                if cfg.serve_aot:
+                    jitted = jax.jit(exported.call, donate_argnums=(0,))
+                    score = self._aot_score(jitted, (), None, direct_score)
                 self.kernel = "stablehlo_aot"
-                self._finish_init(aot_score, align=1)
+                self._finish_init(score or direct_score, align=1)
                 return
             # Quantile models score ALL heads per row — (B, Q) through the
             # batcher — so one device call serves both the median (the
-            # reference ABI's single eta) and the uncertainty band.
+            # reference ABI's single eta) and the uncertainty band (its
+            # non-crossing construction is fused into the score program,
+            # models/eta_mlp.quantile_heads).
             forward = (self._model.apply_quantiles if self.quantiles
                        else self._model.apply)
             apply_jit = jax.jit(forward)
+            if self.kernel_dtype is None and hasattr(self._model, "policy"):
+                self.kernel_dtype = np.dtype(
+                    self._model.policy.compute_dtype).name
             # load_model returns host numpy arrays; pin them on device once
             # or every scoring call re-uploads the whole param tree.
             if runtime is not None:
@@ -733,15 +788,94 @@ class EtaService:
                     def score(x: np.ndarray) -> np.ndarray:
                         return apply_jit(
                             params, runtime.shard_batch(jax.numpy.asarray(x)))
+
+                    if cfg.serve_aot:
+                        # Shard-ready AOT: compile each bucket WITH the
+                        # mesh's batch sharding (params replicated) —
+                        # the same compiled artifact multi-chip serving
+                        # fans out, per ROADMAP item 2.
+                        aot = self._aot_score(
+                            jax.jit(forward, donate_argnums=(1,)),
+                            (params,), runtime.batch_sharding(), score,
+                            align=runtime.n_data)
+                        score = aot or score
             else:
                 params = jax.device_put(self._params)
 
-                def score(x: np.ndarray) -> np.ndarray:
+                def jit_score(x: np.ndarray) -> np.ndarray:
                     return apply_jit(params, x)
 
+                score = jit_score
+                if cfg.serve_aot:
+                    aot = self._aot_score(
+                        jax.jit(forward, donate_argnums=(1,)),
+                        (params,), None, jit_score)
+                    score = aot or score
                 score = self._maybe_fused_score(score)
             self._finish_init(
                 score, align=runtime.n_data if runtime is not None else 1)
+
+    def _aot_score(self, jitted, leading: tuple, x_sharding, fallback,
+                   align: int = 1):
+        """Per-bucket AOT serving entry: ``jit().lower().compile()`` the
+        full score program for every (align-rounded) batch bucket NOW,
+        so no bucket ever pays trace+compile — or the jit call's python
+        dispatch — on a customer request. The input argument is DONATED
+        (``jitted`` is built with ``donate_argnums`` on the slab arg):
+        the device copy of the batcher's staging slab is consumed by the
+        computation, so XLA reuses its buffer for outputs/temporaries
+        instead of allocating fresh — no defensive copy exists anywhere
+        on the path (the numpy slab itself is never aliased by the
+        device: the host→device transfer is the one copy, and the slab
+        is detached from the queue for the whole flush, so donation can
+        never rewrite rows a waiter still owns). Backends that cannot
+        donate (CPU XLA) silently decline; the compile-time warning is
+        filtered because it is the EXPECTED outcome there.
+
+        Returns a score fn dispatching exact bucket shapes to their
+        compiled executables (anything else → ``fallback``), or None if
+        any bucket refuses to compile (the caller keeps the jit path).
+        """
+        import warnings
+
+        buckets = sorted({((b + align - 1) // align) * align
+                          for b in self._cfg.batch_buckets})
+        n_feat = self._model.n_features
+        table = {}
+        try:
+            for b in buckets:
+                if x_sharding is not None:
+                    spec = jax.ShapeDtypeStruct((b, n_feat), np.float32,
+                                                sharding=x_sharding)
+                else:
+                    spec = jax.ShapeDtypeStruct((b, n_feat), np.float32)
+                t0 = time.perf_counter()
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    table[b] = jitted.lower(*leading, spec).compile()
+                _m_aot_compile.labels(bucket=b).observe(
+                    time.perf_counter() - t0)
+        except Exception as e:
+            from routest_tpu.utils.logging import get_logger
+
+            get_logger("routest_tpu.serve").warning(
+                "aot_compile_unavailable", bucket=locals().get("b"),
+                error=f"{type(e).__name__}: {e}")
+            return None
+
+        def score(x: np.ndarray) -> np.ndarray:
+            exe = table.get(len(x))
+            if exe is None:
+                return fallback(x)
+            x = np.ascontiguousarray(x, np.float32)
+            if x_sharding is not None:
+                x = jax.device_put(x, x_sharding)
+            return exe(*leading, x)
+
+        self._aot_buckets = tuple(buckets)
+        return score
 
     def _finish_init(self, score, align: int) -> None:
         """Shared serving bring-up: batcher, one-row self-check, bucket
@@ -767,6 +901,8 @@ class EtaService:
             self._params = None
             self._batcher = None
             self.kernel = "xla"  # nothing is serving; don't claim fused
+            self.kernel_dtype = None
+            self._aot_buckets = ()
             # drop the score closure too — it captures the device-pinned
             # param tree and would hold device memory forever
             self._score = None
@@ -781,6 +917,8 @@ class EtaService:
             if not _in_reload.flag:
                 _m_generation.set(self._serving.generation)
             self._warm_buckets()
+            if not _in_reload.flag:
+                _m_cold_start.set(time.perf_counter() - self._t_construct)
 
     def _warm_buckets(self) -> None:
         """Compile EVERY batch bucket at startup.
@@ -844,15 +982,17 @@ class EtaService:
         return score
 
     @staticmethod
-    def _fused_win_bucket() -> Tuple[int, Dict[int, int]]:
-        """(win_bucket, tile_by_batch) from the measured kernel bench
-        (``artifacts/kernel_bench.json``, written by
+    def _fused_selection() -> Tuple[int, Dict[int, int], dict]:
+        """(win_bucket, tile_by_batch, provenance) from the measured
+        kernel bench (``artifacts/kernel_bench.json``, written by
         ``scripts/bench_serving_kernel.py`` — per-bucket slope-timed
         head-to-head on the real chip). ``win_bucket`` is the largest
         batch size where the Pallas path wins (0 = no recorded win);
         ``tile_by_batch`` maps each measured batch size to the kernel
         tile that won its sweep, so serving replays the measured
-        configuration instead of a hardcoded tile.
+        configuration instead of a hardcoded tile; ``provenance`` names
+        the record (path / backend / recorded_unix) so health can answer
+        "which measurement chose this kernel".
         ``ROUTEST_KERNEL_BENCH`` relocates the record (deployments that
         move artifacts out of the repo tree)."""
         path = os.environ.get("ROUTEST_KERNEL_BENCH") or os.path.join(
@@ -863,14 +1003,28 @@ class EtaService:
 
             with open(path) as f:
                 rec = json.load(f)
+            provenance = {"path": path,
+                          "backend": rec.get("backend")
+                          if isinstance(rec, dict) else None,
+                          "recorded_unix": rec.get("recorded_unix")
+                          if isinstance(rec, dict) else None}
             if not isinstance(rec, dict) or rec.get("backend") != "tpu":
-                return 0, {}
+                return 0, {}, provenance
             tiles = {int(r["batch"]): int(r["pallas_tile"])
                      for r in rec.get("rows", ())
                      if isinstance(r, dict) and r.get("pallas_tile")}
-            return int(rec.get("pallas_wins_max_bucket") or 0), tiles
+            return int(rec.get("pallas_wins_max_bucket") or 0), tiles, \
+                provenance
         except Exception:  # any malformed record means "no recorded win"
-            return 0, {}
+            return 0, {}, {"path": path, "backend": None,
+                           "recorded_unix": None}
+
+    @staticmethod
+    def _fused_win_bucket() -> Tuple[int, Dict[int, int]]:
+        """(win_bucket, tile_by_batch) — the selection half of
+        ``_fused_selection`` (kept as the stable introspection point)."""
+        win, tiles, _prov = EtaService._fused_selection()
+        return win, tiles
 
     def _maybe_fused_score(self, fallback):
         """Measured-selection swap to the fused Pallas kernel
@@ -890,7 +1044,9 @@ class EtaService:
         mode = os.environ.get("ROUTEST_FUSED", "auto")
         if mode == "0":
             return fallback
-        recorded_bucket, tile_by_batch = self._fused_win_bucket()
+        recorded_bucket, tile_by_batch, provenance = self._fused_selection()
+        self._win_provenance = dict(provenance,
+                                    pallas_wins_max_bucket=recorded_bucket)
         win_bucket = None if mode == "1" else recorded_bucket
         if win_bucket == 0:
             return fallback
@@ -906,9 +1062,12 @@ class EtaService:
                            f"have {jax.default_backend()}; serving XLA")
             return fallback
         try:
-            from routest_tpu.ops import fused_eta_forward, pack_eta_params
+            from routest_tpu.ops import (fused_eta_forward, pack_eta_params,
+                                         resolve_kernel_dtype)
 
-            packed = jax.device_put(pack_eta_params(self._model, self._params))
+            variant = resolve_kernel_dtype(self._model)
+            packed = jax.device_put(
+                pack_eta_params(self._model, self._params, dtype=variant))
             n_q = len(self.quantiles)
             # Replay the measured tile: smallest benched batch that
             # covers this request's rows (bench batches are the serving
@@ -936,6 +1095,7 @@ class EtaService:
             probe = np.zeros((1, self._model.n_features), np.float32)
             if not np.isfinite(np.asarray(fused(probe))).all():
                 raise ValueError("fused kernel probe produced non-finite output")
+            self.kernel_dtype = variant
             return score
         except Exception as e:  # pragma: no cover - depends on backend
             from routest_tpu.utils.logging import get_logger
@@ -1060,6 +1220,9 @@ class EtaService:
             self._batcher = fresh._batcher
             self._score = fresh._score
             self.kernel = fresh.kernel
+            self.kernel_dtype = fresh.kernel_dtype
+            self._aot_buckets = fresh._aot_buckets
+            self._win_provenance = fresh._win_provenance
             self._error = None
             self._loaded_mtime_ns = fresh._loaded_mtime_ns
             self.fingerprint = fresh.fingerprint
@@ -1169,6 +1332,22 @@ class EtaService:
     @property
     def load_error(self) -> Optional[str]:
         return self._error
+
+    def scoring_info(self) -> dict:
+        """The scoring artifact's identity card (health's model block,
+        mirroring the road_router block): which compute path serves
+        (kernel), at what dtype, which buckets are AOT-compiled, and —
+        when measured selection is in play — which recorded bench chose
+        the win bucket (provenance: record path/backend/timestamp)."""
+        info = {
+            "kernel": self.kernel,
+            "dtype": self.kernel_dtype,
+            "aot": bool(self._aot_buckets),
+            "aot_buckets": list(self._aot_buckets),
+        }
+        if self._win_provenance:
+            info["win_bucket"] = self._win_provenance
+        return info
 
     def predict_batch(self, rows: np.ndarray) -> Optional[np.ndarray]:
         return self._predict_rows(self._serving, rows)

@@ -191,6 +191,13 @@ class ExportedServingModel:
         self.quantiles = tuple(header.get("quantiles", ()))
         self.hidden = tuple(header.get("hidden", ()))
 
+    @property
+    def call(self):
+        """The raw traceable program — what the serving layer hands to
+        ``jax.jit`` for per-bucket AOT compiles (with mesh shardings
+        when a runtime is present)."""
+        return self._call
+
     def __call__(self, x):
         return self._call(x)
 

@@ -117,6 +117,12 @@ def main() -> None:
     report = {
         "recorded_unix": int(time.time()),
         "cpu_count": os.cpu_count(),
+        # Structural host caveat (PR-4 convention): this ladder runs the
+        # hermetic CPU backend; absolute preds/s bind only to this box,
+        # the point-vs-quantile RATIO is the portable claim.
+        "host_caveat": "cpu-backend ladder: compare the ratio, not the "
+                       "absolute throughput",
+        "quick": bool(args.quick),
         "modes_valid": bool(q_served) and not p_served,
         "modes": modes,
         "point_over_quantile": round(p_tp / q_tp, 4) if q_tp else None,

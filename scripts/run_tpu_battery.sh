@@ -11,6 +11,7 @@
 # regenerate the TPU records the moment the environment recovers:
 #   artifacts/router_scale.json   (250k-row overlay solve, oracle-verified)
 #   artifacts/kernel_bench.json   (per-batch XLA vs Pallas -> serving auto-select)
+#   artifacts/serving_kernel.json (per-path xla/pallas/aot Mpreds/s curves)
 #   artifacts/load_test_tpu.json  (5 endpoint-class budgets + decomposition)
 #   artifacts/bench_tpu.json      (throughput + roofline record)
 #
@@ -53,7 +54,11 @@ run_step() {
 # record the driver compares, then the serving-selection table) before
 # the hour-scale router runs start.
 run_step bench timeout 600 python bench.py
-run_step kernel_bench timeout 2400 python scripts/bench_serving_kernel.py
+# Per-path (xla / pallas / aot) Mpreds/s rows per serving bucket, the
+# refreshed selection table, and the regression gate: --gate fails the
+# battery if the fused kernel now LOSES at a bucket the previous record
+# said it wins (serving would keep auto-selecting a slower path).
+run_step kernel_bench timeout 2400 python scripts/bench_serving_kernel.py --gate
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
 run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
   --osm-nodes 250000 --verify --flat-compare

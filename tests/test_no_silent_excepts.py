@@ -23,6 +23,7 @@ import pytest
 import routest_tpu.chaos
 import routest_tpu.live
 import routest_tpu.obs
+import routest_tpu.ops
 import routest_tpu.serve
 import routest_tpu.serve.fleet
 
@@ -44,6 +45,11 @@ CHAOS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.chaos.__file__))
 # silently swallowed failure there means a silently frozen world —
 # stale metrics serving forever with nothing in the logs.
 LIVE_ROOT = os.path.dirname(os.path.abspath(routest_tpu.live.__file__))
+# The kernel layer's selection fallbacks (fused_kernel_ignored /
+# fused_kernel_unavailable, pack failures) must stay LOUD: a silently
+# swallowed Mosaic failure would quietly serve the slow path while the
+# bench record claims the kernel wins.
+OPS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.ops.__file__))
 
 BROAD = {"Exception", "BaseException"}
 
@@ -81,8 +87,9 @@ def _offenders(path):
 
 @pytest.mark.parametrize("root",
                          [SERVE_ROOT, OBS_ROOT, FLEET_ROOT, CHAOS_ROOT,
-                          LIVE_ROOT],
-                         ids=["serve", "obs", "fleet", "chaos", "live"])
+                          LIVE_ROOT, OPS_ROOT],
+                         ids=["serve", "obs", "fleet", "chaos", "live",
+                              "ops"])
 def test_no_silent_broad_excepts(root):
     offenders = []
     for dirpath, dirnames, filenames in os.walk(root):

@@ -142,6 +142,67 @@ def test_fused_quantile_epilogue_matches_apply_quantiles():
     assert (np.diff(got, axis=1) >= -1e-5).all()  # non-crossing quantiles
 
 
+@pytest.mark.parametrize("dtype,rtol,atol", [
+    ("f32", 1e-4, 1e-3),
+    ("bf16", 2e-2, 0.5),      # bf16 matmuls: bf16-scale tolerance
+    ("int8", 5e-2, 1.5),      # per-column 8-bit weights: quantization err
+])
+def test_kernel_dtype_variants_parity(dtype, rtol, atol):
+    """RTPU_KERNEL_DTYPE variants (bf16 / f32 / int8-weight) all track
+    the XLA oracle within their precision class, point AND quantile."""
+    model, params, feats = _model_and_params()
+    packed = pack_eta_params(model, params, dtype=dtype)
+    want = np.asarray(model.apply(params, feats[:512]))
+    got = np.asarray(fused_eta_forward(packed, feats[:512], tile=256,
+                                       interpret=True))
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+    qmodel = EtaMLP(hidden=(64, 32), policy=F32_POLICY,
+                    quantiles=(0.1, 0.5, 0.9))
+    qparams = qmodel.init(jax.random.PRNGKey(7),
+                          norm_mean=fit_normalizer(feats)[0],
+                          norm_std=fit_normalizer(feats)[1])
+    qpacked = pack_eta_params(qmodel, qparams, dtype=dtype)
+    want_q = np.asarray(qmodel.apply_quantiles(qparams, feats[:256]))
+    got_q = np.asarray(fused_eta_forward(qpacked, feats[:256], n_q=3,
+                                         tile=128, interpret=True))
+    np.testing.assert_allclose(got_q, want_q, rtol=rtol, atol=atol)
+    # Non-crossing is structural — it must survive EVERY dtype variant
+    # (the cumsum of softplus-positive increments is monotone no matter
+    # what error quantization put into the increments).
+    assert (np.diff(got_q, axis=1) >= -1e-5).all(), dtype
+
+
+def test_int8_pack_layout():
+    """int8 packing: weights stored as int8 with per-column f32 scales,
+    padding columns exactly zero (scale floor keeps them no-ops)."""
+    model, params, _ = _model_and_params(hidden=(96, 40))
+    packed = pack_eta_params(model, params, dtype="int8")
+    assert "scale" in packed and len(packed["scale"]) == len(packed["w"])
+    for w, s in zip(packed["w"], packed["scale"]):
+        assert np.asarray(w).dtype == np.int8
+        assert np.asarray(s).dtype == np.float32
+        assert s.shape == (1, w.shape[1])
+        assert np.abs(np.asarray(w)).max() <= 127
+    # hidden=40 pads to 128: columns 40+ of layer-1 must dequantize to 0
+    w1 = np.asarray(packed["w"][1]) * np.asarray(packed["scale"][1])
+    assert (w1[:, 40:] == 0).all()
+
+
+def test_resolve_kernel_dtype_env(monkeypatch):
+    from routest_tpu.ops import resolve_kernel_dtype
+
+    model, _, _ = _model_and_params()
+    monkeypatch.delenv("RTPU_KERNEL_DTYPE", raising=False)
+    assert resolve_kernel_dtype(model) == "float32"  # F32_POLICY model
+    assert resolve_kernel_dtype(model, "bf16") == "bfloat16"
+    monkeypatch.setenv("RTPU_KERNEL_DTYPE", "int8")
+    assert resolve_kernel_dtype(model) == "int8"
+    monkeypatch.setenv("RTPU_KERNEL_DTYPE", "fp7")
+    with pytest.raises(ValueError):  # unknown variants stay LOUD
+        resolve_kernel_dtype(model)
+
+
 def test_fused_win_bucket_parses_measured_record(tmp_path, monkeypatch):
     """Serving's measured-selection reads (win bucket, tile table) from
     the kernel bench record; non-TPU or malformed records mean "no

@@ -1,0 +1,68 @@
+"""Guardband re-run of the serving-kernel bench (slow).
+
+CI direction invariants for the compiled scoring artifact, measured on
+whatever host runs the suite (1-core guardbands, not TPU-grade
+assertions — the TPU battery owns the real gate):
+
+- fused quantile heads are not SLOWER than the scan-form oracle
+  (``fused-heads ≥ unfused`` within a noise band);
+- the AOT per-bucket entry's total dispatch cost does not regress past
+  the jit path's (``AOT fixed overhead ≤ jit fixed overhead`` within a
+  noise band — summed across buckets so single-bucket timer noise on a
+  1-core host cannot flake the suite);
+- the artifact stays structurally honest (CPU runs must record the
+  non-binding caveat and a zero win bucket).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def record(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("kernel") / "serving_kernel.json")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "bench_serving_kernel.py"),
+         "--quick", "--cpu", "--no-pallas", "--out", out],
+        cwd=REPO, capture_output=True, text=True, timeout=1500)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_record_structure(record):
+    assert record["backend"] == "cpu"
+    assert record["pallas_wins_max_bucket"] == 0  # CPU can never enable
+    assert "caveat" in record                     # structurally honest
+    assert record["quick"] is True
+    for row in record["rows"]:
+        for key in ("xla_us", "jit_call_us", "aot_call_us",
+                    "xla_mpreds_s", "aot_mpreds_s"):
+            assert row.get(key), (row, key)
+
+
+def test_aot_dispatch_not_worse_than_jit(record):
+    """Direction invariant: summed across buckets, the AOT entry's
+    wall-per-call must stay within the guardband of the jit path's —
+    a regression here means customer flushes re-grew dispatch cost."""
+    jit_total = sum(r["jit_call_us"] for r in record["rows"])
+    aot_total = sum(r["aot_call_us"] for r in record["rows"])
+    assert aot_total <= jit_total * 1.25, (aot_total, jit_total)
+
+
+def test_fused_heads_not_worse_than_unfused(record):
+    """Direction invariant: the matmul-form quantile epilogue must not
+    lose to the scan-form oracle beyond the noise band."""
+    heads = record["quantile_heads"]
+    if heads is None:
+        pytest.skip("point-model artifact: no quantile heads to compare")
+    assert heads["fused_over_unfused"] >= 0.9, heads
