@@ -321,12 +321,25 @@ def travel_matrix(input_data: dict) -> dict:
         from routest_tpu.optimize.road_router import default_router
 
         car_speed = geo.PROFILE_SPEED_MPS[geo.profile_for_vehicle("car")]
+        # Solve only the waypoints the response can reference: with
+        # ``sources``/``destinations`` subsets, the solve's row count is
+        # |sources ∪ dests|, not the full point list — each row is an
+        # independent one-source-vs-all-destinations device solve, so
+        # the subset's values are bitwise the full matrix's. The solve
+        # itself rides the router's batched path (shared dispatches
+        # with concurrent request_route traffic) and the route
+        # fastlane.
+        need = sorted(set(sources) | set(dests))
+        pos = {p: k for k, p in enumerate(need)}
         legs = default_router().route_legs(
-            latlon, car_speed / speed,
+            latlon[need], car_speed / speed,
             hour=_pickup_hour(input_data.get("pickup_time")))
-        dist = legs.dist_m
+        dist_sub = legs.dist_m
         durm = legs.duration_matrix()   # one device dispatch, no walks
-        durations = [[float(durm[i, j]) for j in dests] for i in sources]
+        dist = np.full((len(points), len(points)), np.inf)
+        dist[np.ix_(need, need)] = dist_sub
+        durations = [[float(durm[pos[i], pos[j]]) for j in dests]
+                     for i in sources]
         meta = {"road_graph": True, "leg_cost_model": legs.cost_model}
     else:
         dist = np.asarray(geo.distance_matrix_m(

@@ -79,11 +79,13 @@ _INF_NP = np.float32(3e38)
 # (measured in road_router._bellman_ford — same constant, same reason).
 _K_SWEEPS = 4
 
-# v3: v2 (multi-level payload, content-hash filenames, per-level build
-# stats) + the topology-only customization structure (partition-tree
-# cuts, chain-contraction edge composition) that lets a loaded overlay
-# re-price itself against a live metric without re-partitioning.
-_CACHE_VERSION = 3
+# v4: v3 (customization structure) + hub labels (the precomputed
+# all-pairs top-overlay distance table), the chain FILL structure
+# (direction-start offsets + last-hop edges that let the solve
+# synthesize full-graph distances/predecessors from a contracted
+# solve), and the contracted level-0 edge arrays the polish/predecessor
+# sweeps now run over.
+_CACHE_VERSION = 4
 
 
 def _log():
@@ -153,14 +155,14 @@ def polish(senders: jax.Array, receivers: jax.Array, w: jax.Array,
     return dist
 
 
-@functools.partial(jax.jit, static_argnames=("n_nodes",))
-def tight_pred(senders: jax.Array, receivers: jax.Array, w: jax.Array,
-               dist: jax.Array, sources: jax.Array, *,
-               n_nodes: int) -> jax.Array:
+def tight_edges(senders: jax.Array, receivers: jax.Array, w: jax.Array,
+                dist: jax.Array, *, n_nodes: int) -> jax.Array:
     """Predecessor recovery from a converged distance table: the edge
     entering each node with *minimal slack* (``dist[s] + w - dist[r]``)
     lies on a shortest path; segment-max of the edge id among
-    minimal-slack edges picks one deterministically.
+    minimal-slack edges picks one deterministically. Traceable core
+    with NO source zeroing — the contracted full solve picks its own
+    roots (an interior source has no contracted node to zero).
 
     Min-slack (not "any edge within a tolerance") matters on real
     street data: short edges exist (sub-meter OSM segments), so a fixed
@@ -179,6 +181,17 @@ def tight_pred(senders: jax.Array, receivers: jax.Array, w: jax.Array,
 
     min_slack = jax.vmap(seg_min)(slack)           # (S, N)
     tight = slack <= min_slack[:, receivers] + 1e-2
+    # Among tight edges, prefer the one whose SENDER is strictly
+    # closest (then max edge id deterministically): zero-weight edges
+    # make equal-distance neighbor pairs where both directions are
+    # tight, and two nodes independently picking each other is a
+    # predecessor 2-cycle (observed on a 1M street extract through a
+    # zero-length contracted chain). The minimal-sender-distance edge
+    # always exists for a finitely-reached node and points strictly
+    # "upstream" whenever any positive-weight tight in-edge does.
+    sd = jnp.where(tight, dist[:, senders], _INF)
+    best_sd = jax.vmap(seg_min)(sd)                # (S, N)
+    pick = tight & (sd <= best_sd[:, receivers])
     e_ids = jnp.arange(senders.shape[0], dtype=jnp.int32)
 
     def seg_max(t):
@@ -186,9 +199,58 @@ def tight_pred(senders: jax.Array, receivers: jax.Array, w: jax.Array,
                                    num_segments=n_nodes,
                                    indices_are_sorted=True)
 
-    pred = jnp.maximum(jax.vmap(seg_max)(tight), -1)
+    return jnp.maximum(jax.vmap(seg_max)(pick), -1)
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes",))
+def tight_pred(senders: jax.Array, receivers: jax.Array, w: jax.Array,
+               dist: jax.Array, sources: jax.Array, *,
+               n_nodes: int) -> jax.Array:
+    """:func:`tight_edges` with each row's source zeroed to -1 (the
+    flat-solver entry point)."""
+    pred = tight_edges(senders, receivers, w, dist, n_nodes=n_nodes)
     n_src = dist.shape[0]
     return pred.at[jnp.arange(n_src), sources].set(-1)
+
+
+def _build_labels(top_s: np.ndarray, top_r: np.ndarray, top_w: np.ndarray,
+                  n_top: int) -> Tuple[np.ndarray, Dict]:
+    """Hub labels: the exact all-pairs distance table over the top
+    overlay graph, built as a device-batched identity-seeded BF —
+    exactly the machinery the per-query top BF runs, with the source
+    axis widened from a request bucket to every top boundary node.
+    Rows chunk to bound the (rows, E) proposal tensor; the chunk shape
+    is fixed so every chunk reuses one compiled program. Returns the
+    (n_top, n_top) f32 table + build stats.
+
+    Because the overlay metric is the true metric on boundary nodes
+    (the level-stack induction), this table is EXACT — the query-time
+    fold ``min_b(seed[s, b] + labels[b, v])`` over a source's top-cell
+    boundary seeds reproduces the top BF's fixed point by definition,
+    so the label path needs no approximation fallback: parity with the
+    iterative top BF holds by construction, and routers that skip the
+    build (top too big, knob off) simply keep the BF stage."""
+    t0 = time.perf_counter()
+    e_top = max(1, len(top_s))
+    chunk = int(np.clip((256 << 20) // (4 * e_top), 64, n_top))
+    d_s = jnp.asarray(top_s)
+    d_r = jnp.asarray(top_r)
+    d_w = jnp.asarray(top_w)
+    labels = np.empty((n_top, n_top), np.float32)
+    for lo in range(0, n_top, chunk):
+        hi = min(lo + chunk, n_top)
+        d0 = np.full((chunk, n_top), _INF_NP, np.float32)
+        d0[np.arange(hi - lo), lo + np.arange(hi - lo)] = 0.0
+        d0[hi - lo:, 0] = 0.0          # pad rows: harmless re-solves
+        out, _ = relax_from(d_s, d_r, d_w, jnp.asarray(d0),
+                            n_nodes=n_top, max_iters=n_top + _K_SWEEPS)
+        labels[lo:hi] = np.asarray(out)[: hi - lo]
+    stats = {
+        "nodes": int(n_top),
+        "bytes": int(labels.nbytes),
+        "build_s": round(time.perf_counter() - t0, 3),
+    }
+    return labels, stats
 
 
 # ---------------------------------------------------------------------------
@@ -247,13 +309,25 @@ def _level_targets(n: int, cell_target: Optional[int] = None,
                 os.environ.get("ROUTEST_HIER_CELL_TARGET", "0") or 0)
         except ValueError:
             cell_target = 0
+    # Hub labels change the balance at the top: the top phase is a
+    # precomputed table fold instead of an iterative BF, so the ladder
+    # no longer needs to stop while the top is still large enough to
+    # matter — it should instead use SMALLER level-1 cells (every
+    # query phase is cheaper in small cells; the top grows, but the
+    # fold doesn't care) and stack GENTLER (ratio-4) levels until the
+    # top fits the label budget. Measured at 250k: 1.45√n cells cut
+    # the non-top query phases 225→154 ms vs the 2.2√n BF balance.
+    labels_on = _labels_max() > 0
     if not cell_target:
         # Balance the phases: cell work ~ c, overlay hops ~ sqrt(N/c).
-        cell_target = max(192, int(2.2 * np.sqrt(n)))
+        cell_target = max(160, int((1.45 if labels_on else 2.2)
+                                   * np.sqrt(n)))
     try:
-        ratio = int(os.environ.get("ROUTEST_HIER_RATIO", "16") or 16)
+        ratio = int(os.environ.get("ROUTEST_HIER_RATIO", "0") or 0)
     except ValueError:
-        ratio = 16
+        ratio = 0
+    if not ratio:
+        ratio = 4 if labels_on else 16
     ratio = max(2, ratio)
     if max_levels is None:
         try:
@@ -262,10 +336,39 @@ def _level_targets(n: int, cell_target: Optional[int] = None,
         except ValueError:
             max_levels = 0
     max_levels = max_levels or 8
+    # With labels the ladder runs all the way down to a 2-cell cut —
+    # every extra level shrinks the top boundary, and the label build
+    # cost is quadratic-ish in it; without labels a <4-cell level's
+    # stitch cost outweighs the top-BF hops it saves.
+    min_cells = 1 if labels_on else 4
     targets = [int(cell_target)]
-    while len(targets) < max_levels and n // (targets[-1] * ratio) >= 4:
+    while (len(targets) < max_levels
+           and n // (targets[-1] * ratio) >= min_cells):
         targets.append(targets[-1] * ratio)
     return targets
+
+
+# Stop stacking levels once the top boundary fits this budget: by
+# here the label fold is already cheap, and the next level's cells
+# would be few and DENSE (clique-dominated), making its ascend cost
+# more than the label-build seconds it saves (measured at 250k: the
+# final 2-cell level cost 211 ms of ascend to save 44 s of one-time
+# label build).
+_LABEL_STOP = 2560
+
+
+def _labels_max() -> int:
+    """Hub labels build when the top overlay has at most this many
+    boundary nodes (``ROUTEST_HIER_LABELS``; 0/off disables). The label
+    table is (top, top) f32 — 4096 nodes = 64 MB resident and an
+    all-pairs device BF at build time — so the cap bounds both."""
+    raw = os.environ.get("ROUTEST_HIER_LABELS", "4096").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 4096
 
 
 def _prune_slack() -> float:
@@ -447,14 +550,27 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
     c_w = [w[keep_edge]]
     chain_edge_comp: List[List[int]] = []      # per chain-emitted edge
     seed_comp: Dict[int, List[int]] = {}       # (node*2 + slot) → edges
+    fill_comp: Dict[int, List[int]] = {}       # (node*2 + slot) → edges
     seed_node = np.full((n, 2), -1, np.int64)
     seed_w = np.full((n, 2), np.inf, np.float64)
+    seed_last = np.full((n, 2), -1, np.int64)
     seed_node[kept, 0] = cid_of[kept]
     seed_w[kept, 0] = 0.0
+    # Fill structure (the inverse of seeds): which contracted node
+    # REACHES each interior along its chain, at what along-chain cost,
+    # entering through which original edge. The solve uses it to
+    # synthesize exact full-graph distances and predecessors from a
+    # contracted solve — interiors are never relaxed on device.
+    fill_node = np.full((n, 2), -1, np.int64)
+    fill_w = np.full((n, 2), np.inf, np.float64)
+    fill_last = np.full((n, 2), -1, np.int64)
+    fill_dir = np.full((n, 2), -1, np.int64)   # emitted-direction id
+    n_dirs = 0
 
     def emit(seg: List[int]) -> None:
-        """One kept→kept segment: summed edges per direction + seeds
-        for its interiors."""
+        """One kept→kept segment: summed edges per direction + seed and
+        fill entries for its interiors."""
+        nonlocal n_dirs
         for s_dir in (0, 1):
             nodes = seg if s_dir == 0 else seg[::-1]
             total = 0.0
@@ -475,13 +591,23 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
             c_r.append(np.asarray([cid_of[nodes[-1]]]))
             c_w.append(np.asarray([total], np.float32))
             chain_edge_comp.append(hop_ids)
+            dir_id = n_dirs
+            n_dirs += 1
             # Seeds: every interior can reach the segment's END in this
-            # direction at cost (total - partial).
+            # direction at cost (total - partial). Fill: the segment's
+            # START reaches every interior at cost partial, entering
+            # through hop i-1.
             for i, node in enumerate(nodes[1:-1], start=1):
                 slot = 0 if seed_node[node, 0] < 0 else 1
                 seed_node[node, slot] = cid_of[nodes[-1]]
                 seed_w[node, slot] = total - partial[i]
+                seed_last[node, slot] = hop_ids[-1]
                 seed_comp[node * 2 + slot] = hop_ids[i:]
+                fill_node[node, slot] = cid_of[nodes[0]]
+                fill_w[node, slot] = partial[i]
+                fill_last[node, slot] = hop_ids[i - 1]
+                fill_dir[node, slot] = dir_id
+                fill_comp[node * 2 + slot] = hop_ids[:i]
 
     for chain in chains:
         seg: List[int] = [chain[0]]
@@ -510,15 +636,20 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
         [kept_edge_ids]
         + [np.asarray(ids, np.int64) for ids in chain_edge_comp]
         if chain_edge_comp else [kept_edge_ids]).astype(np.int64)
-    seed_lens = np.zeros(2 * n, np.int64)
-    for slot_key, ids in seed_comp.items():
-        seed_lens[slot_key] = len(ids)
-    seed_comp_ptr = np.zeros(2 * n + 1, np.int64)
-    np.cumsum(seed_lens, out=seed_comp_ptr[1:])
-    seed_comp_flat = np.zeros(int(seed_comp_ptr[-1]), np.int64)
-    for slot_key, ids in seed_comp.items():
-        lo = seed_comp_ptr[slot_key]
-        seed_comp_flat[lo:lo + len(ids)] = ids
+    def _ragged(comp: Dict[int, List[int]]):
+        lens = np.zeros(2 * n, np.int64)
+        for slot_key, ids in comp.items():
+            lens[slot_key] = len(ids)
+        ptr = np.zeros(2 * n + 1, np.int64)
+        np.cumsum(lens, out=ptr[1:])
+        flat = np.zeros(int(ptr[-1]), np.int64)
+        for slot_key, ids in comp.items():
+            lo = ptr[slot_key]
+            flat[lo:lo + len(ids)] = ids
+        return ptr, flat
+
+    seed_comp_ptr, seed_comp_flat = _ragged(seed_comp)
+    fill_comp_ptr, fill_comp_flat = _ragged(fill_comp)
     return {
         "cid_of": cid_of, "kept": kept,
         "c_senders": c_senders, "c_receivers": c_receivers,
@@ -526,11 +657,63 @@ def _contract_chains(coords: np.ndarray, senders: np.ndarray,
         "seed_node": seed_node.astype(np.int64),
         "seed_w": np.where(np.isfinite(seed_w), seed_w,
                            _INF_NP).astype(np.float32),
+        "seed_last": seed_last,
+        "fill_node": fill_node, "fill_last": fill_last,
+        "fill_dir": fill_dir,
+        "fill_w": np.where(np.isfinite(fill_w), fill_w,
+                           _INF_NP).astype(np.float32),
         "edge_comp_ptr": edge_comp_ptr,
         "edge_comp": edge_comp,
         "seed_comp_ptr": seed_comp_ptr,
         "seed_comp": seed_comp_flat,
+        "fill_comp_ptr": fill_comp_ptr,
+        "fill_comp": fill_comp_flat,
     }
+
+
+def _pack_ell_flat(senders: np.ndarray, receivers: np.ndarray,
+                   w: np.ndarray, tags: np.ndarray, n_nodes: int):
+    """Receiver-sorted flat edge list → width-8 ELL minirows
+    ``(m, W) senders/weights/tags + (m,) receivers`` (the
+    :func:`_ell_pack` layout for ONE graph instead of per-cell).
+    ``tags`` rides along per lane (pad -1) — the fused solve stores
+    the ORIGINAL entering edge there so predecessor recovery needs no
+    later remap. Pad lanes carry (0, INF, -1); pad minirows receive
+    into ``n_nodes - 1`` (sorted order kept, INF never wins)."""
+    E = len(senders)
+    if E == 0:
+        return (np.zeros((1, _ELL_W), np.int32),
+                np.full((1, _ELL_W), _INF_NP, np.float32),
+                np.full((1, _ELL_W), -1, np.int32),
+                np.full((1,), max(n_nodes - 1, 0), np.int32))
+    new_run = np.empty(E, bool)
+    new_run[0] = True
+    new_run[1:] = receivers[1:] != receivers[:-1]
+    run_start = np.maximum.accumulate(np.where(new_run, np.arange(E), 0))
+    rank = np.arange(E) - run_start
+    new_mini = new_run | (rank % _ELL_W == 0)
+    mini_id = np.cumsum(new_mini) - 1
+    lane = rank % _ELL_W
+    m = int(mini_id[-1]) + 1
+    ell_s = np.zeros((m, _ELL_W), np.int32)
+    ell_w = np.full((m, _ELL_W), _INF_NP, np.float32)
+    ell_t = np.full((m, _ELL_W), -1, np.int32)
+    ell_r = np.full((m,), max(n_nodes - 1, 0), np.int32)
+    ell_s[mini_id, lane] = senders
+    ell_w[mini_id, lane] = w
+    ell_t[mini_id, lane] = tags
+    ell_r[mini_id] = receivers
+    return ell_s, ell_w, ell_t, ell_r
+
+
+def _identity_fill(n: int) -> Dict[str, np.ndarray]:
+    """Fill structure of an uncontracted graph: no interiors, every
+    slot a pad — the synthesis stage degenerates to the kept-node
+    gather."""
+    ids = np.full((n, 2), -1, np.int64)
+    return {"node": ids, "w": np.full((n, 2), _INF_NP, np.float32),
+            "last": ids.copy(), "dir": ids.copy(),
+            "seed_last": ids.copy()}
 
 
 # ---------------------------------------------------------------------------
@@ -648,6 +831,38 @@ def _relax_ell(es: jax.Array, ew_: jax.Array, er: jax.Array,
     return dist.reshape(S, c_max)
 
 
+def _cell_all_pairs(ces: np.ndarray, cer: np.ndarray, cew: np.ndarray,
+                    sizes: np.ndarray, c_max: int) -> np.ndarray:
+    """(P, c_max, c_max) EXACT in-cell all-pairs tables — the
+    dense-level ascend's precompute. High overlay levels are
+    clique-dominated (hundreds of edges per node), so the per-query
+    in-cell relaxation that is cheap at street density costs hundreds
+    of ms there (measured 435/1013 ms for levels 4/5 of the 1M
+    stack); with the full table the ascend is a fold over the entry
+    seeds instead. Identity-seeded restricted BF per cell,
+    source-chunked to bound the (rows, E) proposal tensor; rows at or
+    beyond the cell's size are masked INF."""
+    P, e_max = ces.shape
+    pt = np.empty((P, c_max, c_max), np.float32)
+    chunk = int(np.clip((192 << 20) // (4 * max(e_max, c_max, 1)),
+                        32, c_max))
+    for p in range(P):
+        d_s = jnp.asarray(ces[p])
+        d_r = jnp.asarray(cer[p])
+        d_w = jnp.asarray(cew[p])
+        for lo in range(0, c_max, chunk):
+            hi = min(lo + chunk, c_max)
+            d0 = np.full((chunk, c_max), _INF_NP, np.float32)
+            d0[np.arange(hi - lo), lo + np.arange(hi - lo)] = 0.0
+            d0[hi - lo:, 0] = 0.0      # pad rows: harmless re-solves
+            out, _ = relax_from(d_s, d_r, d_w, jnp.asarray(d0),
+                                n_nodes=c_max,
+                                max_iters=c_max + _K_SWEEPS)
+            pt[p, lo:hi] = np.asarray(out)[: hi - lo]
+        pt[p, sizes[p]:] = _INF_NP
+    return pt
+
+
 @functools.partial(jax.jit, static_argnames=("slack",))
 def _prune_cliques(T: jax.Array, *, slack: float = 2e-7) -> jax.Array:
     """(P, b, b) restricted boundary metric → keep mask for clique
@@ -748,6 +963,13 @@ class _Level:
         self.d_cbo = jnp.asarray(p["cbo"])
         self.d_table = jnp.asarray(p["table"])
         self.d_perm = jnp.asarray(p["perm_of_node"])
+        # Dense-level all-pairs table (+ one INF pad row per cell so
+        # pad entry positions fold to INF); None at street density.
+        pt = p.get("pt")
+        self.d_pt = (jnp.asarray(np.concatenate(
+            [pt, np.full((pt.shape[0], 1, self.c_max), _INF_NP,
+                         np.float32)], axis=1))
+            if pt is not None else None)
         # G_{k-1}-node → local slot, padded with a dump slot (= c_max)
         # so the next level's seed scatter can route pad entries there.
         self.d_local_pad = jnp.asarray(np.concatenate(
@@ -758,7 +980,7 @@ class _Level:
         self.stats = stats
 
     def payload(self) -> Dict[str, np.ndarray]:
-        return {
+        out = {
             "cell": self.cell, "local_of_node": self.local_of_node,
             "src_cell": self.src_cell, "b_global": self.b_global,
             "ell_s": np.asarray(self.d_ell_s),
@@ -768,6 +990,9 @@ class _Level:
             "table": np.asarray(self.d_table),
             "perm_of_node": np.asarray(self.d_perm),
         }
+        if self.d_pt is not None:
+            out["pt"] = np.asarray(self.d_pt)[:, :-1, :]  # drop pad row
+        return out
 
 
 def _build_level(senders: np.ndarray, receivers: np.ndarray, w: np.ndarray,
@@ -852,37 +1077,58 @@ def _build_level(senders: np.ndarray, receivers: np.ndarray, w: np.ndarray,
     cbo = np.full((P, b_max), B, np.int32)   # overlay id, pad B (= INF slot)
     cbo[b_cell, b_pos] = np.arange(B)
 
-    # Batched in-cell tables, chunked so the (chunk, b_max, e_max)
-    # proposal tensor stays bounded whatever the graph size — and so
-    # small levels run in ONE dispatch rather than many.
-    if chunk_cells is None:
-        chunk_cells = _table_chunk(P, b_max, e_max, c_max)
-    chunk_cells = min(chunk_cells, P)
-    table = np.empty((P, b_max, c_max), np.float32)
-    max_iters = c_max + _K_SWEEPS
-    for lo in range(0, P, chunk_cells):
-        hi = min(lo + chunk_cells, P)
-        pad = chunk_cells - (hi - lo)
-        g_ces = np.concatenate([ces[lo:hi], np.zeros((pad, e_max), np.int32)])
-        g_cer = np.concatenate([cer[lo:hi],
-                                np.full((pad, e_max), c_max - 1, np.int32)])
-        g_cew = np.concatenate([cew[lo:hi],
-                                np.full((pad, e_max), _INF_NP, np.float32)])
-        g_bl = np.concatenate([bl[lo:hi], np.zeros((pad, b_max), np.int32)])
-        # Row b of the block-flat table seeds boundary b of EVERY cell
-        # in the chunk at once: (b_max, chunk*c_max).
-        d0 = jnp.full((b_max, chunk_cells * c_max), _INF)
-        pos = (np.arange(chunk_cells, dtype=np.int64)[:, None] * c_max
-               + g_bl).T                                  # (b_max, chunk)
-        d0 = d0.at[jnp.arange(b_max)[:, None], jnp.asarray(pos)].set(0.0)
-        out = _relax_blockdiag(jnp.asarray(g_ces), jnp.asarray(g_cer),
-                               jnp.asarray(g_cew), d0,
-                               c_max=c_max, max_iters=max_iters)
-        out = np.asarray(out).reshape(b_max, chunk_cells, c_max)
-        table[lo:hi] = out.transpose(1, 0, 2)[: hi - lo]
-    # Pad boundary rows carry garbage (seeded at local 0): mask.
-    row = np.arange(b_max)[None, :]
-    table[row >= bcounts[:, None]] = _INF_NP
+    # Batched in-cell tables. Clique-DENSE levels (≥ 64 edges/node —
+    # upper overlay levels, never street-density level 1) build the
+    # FULL in-cell all-pairs table instead: the boundary table is a
+    # row subset of it, and the query's ascend into such a cell
+    # becomes a fold over the table rather than a relaxation over
+    # hundreds of thousands of clique edges per request.
+    t_pt = time.perf_counter()
+    pt: Optional[np.ndarray] = None
+    if (e_max >= 64 * c_max
+            and P * c_max * c_max * 4 <= (512 << 20)):
+        pt = _cell_all_pairs(ces, cer, cew, sizes, c_max)
+        table = np.ascontiguousarray(
+            pt[np.arange(P)[:, None], bl, :])
+        row = np.arange(b_max)[None, :]
+        table[row >= bcounts[:, None]] = _INF_NP
+    else:
+        # Chunked so the (chunk, b_max, e_max) proposal tensor stays
+        # bounded whatever the graph size — and so small levels run in
+        # ONE dispatch rather than many.
+        if chunk_cells is None:
+            chunk_cells = _table_chunk(P, b_max, e_max, c_max)
+        chunk_cells = min(chunk_cells, P)
+        table = np.empty((P, b_max, c_max), np.float32)
+        max_iters = c_max + _K_SWEEPS
+        for lo in range(0, P, chunk_cells):
+            hi = min(lo + chunk_cells, P)
+            pad = chunk_cells - (hi - lo)
+            g_ces = np.concatenate([ces[lo:hi],
+                                    np.zeros((pad, e_max), np.int32)])
+            g_cer = np.concatenate([cer[lo:hi],
+                                    np.full((pad, e_max), c_max - 1,
+                                            np.int32)])
+            g_cew = np.concatenate([cew[lo:hi],
+                                    np.full((pad, e_max), _INF_NP,
+                                            np.float32)])
+            g_bl = np.concatenate([bl[lo:hi],
+                                   np.zeros((pad, b_max), np.int32)])
+            # Row b of the block-flat table seeds boundary b of EVERY
+            # cell in the chunk at once: (b_max, chunk*c_max).
+            d0 = jnp.full((b_max, chunk_cells * c_max), _INF)
+            pos = (np.arange(chunk_cells, dtype=np.int64)[:, None] * c_max
+                   + g_bl).T                              # (b_max, chunk)
+            d0 = d0.at[jnp.arange(b_max)[:, None],
+                       jnp.asarray(pos)].set(0.0)
+            out = _relax_blockdiag(jnp.asarray(g_ces), jnp.asarray(g_cer),
+                                   jnp.asarray(g_cew), d0,
+                                   c_max=c_max, max_iters=max_iters)
+            out = np.asarray(out).reshape(b_max, chunk_cells, c_max)
+            table[lo:hi] = out.transpose(1, 0, 2)[: hi - lo]
+        # Pad boundary rows carry garbage (seeded at local 0): mask.
+        row = np.arange(b_max)[None, :]
+        table[row >= bcounts[:, None]] = _INF_NP
 
     # Cliques: the boundary↔boundary submatrix of each table.
     T = table[np.arange(P)[:, None, None],
@@ -923,6 +1169,10 @@ def _build_level(senders: np.ndarray, receivers: np.ndarray, w: np.ndarray,
         "b_global": b_global.astype(np.int64),
         "cell_remap": remap,
     }
+    if pt is not None:
+        payload["pt"] = pt
+        stats["pt"] = {"bytes": int(pt.nbytes),
+                       "build_s": round(time.perf_counter() - t_pt, 3)}
     return payload, stats, (ovl_s, ovl_r, ovl_w)
 
 
@@ -938,7 +1188,9 @@ class HierarchicalIndex:
     def __init__(self, levels: List[_Level], top_s: np.ndarray,
                  top_r: np.ndarray, top_w: np.ndarray, stats: Dict, *,
                  expand_idx: np.ndarray, seed_node: np.ndarray,
-                 seed_w: np.ndarray) -> None:
+                 seed_w: np.ndarray, l0: Optional[Dict] = None,
+                 fill: Optional[Dict] = None,
+                 labels: Optional[np.ndarray] = None) -> None:
         self.levels = levels
         self.n_levels = len(levels)
         l1 = levels[0]
@@ -979,6 +1231,103 @@ class HierarchicalIndex:
         self._d_top_s = jnp.asarray(self._top_s)
         self._d_top_r = jnp.asarray(self._top_r)
         self._d_top_w = jnp.asarray(self._top_w)
+        # Hub labels: the exact all-pairs top-overlay table. When
+        # present the query's top stage is one gather-fold over the
+        # source's top-cell boundary seeds; when absent the iterative
+        # top BF runs as before (same answers — the table IS its fixed
+        # point).
+        self._labels = (np.asarray(labels, np.float32)
+                        if labels is not None else None)
+        self._d_labels = (jnp.asarray(self._labels)
+                          if self._labels is not None else None)
+        # Level-0 (contracted) edge arrays: what the full solve's
+        # polish + predecessor sweeps run over — the bend-chain ratio
+        # cheaper than the full graph. ``edge_last`` maps a contracted
+        # edge to the ORIGINAL edge entering its receiver, which is
+        # what predecessor synthesis hands back to walkers.
+        self._l0 = l0
+        self._fill = fill
+        if l0 is not None:
+            l0_r = np.asarray(l0["receivers"], np.int64)
+            perm = np.argsort(l0_r, kind="stable")
+            s_sorted = np.asarray(l0["senders"],
+                                  np.int64)[perm].astype(np.int32)
+            r_sorted = l0_r[perm].astype(np.int32)
+            w_sorted = np.asarray(l0["w"], np.float32)[perm]
+            last_sorted = np.asarray(l0["edge_last"],
+                                     np.int64)[perm].astype(np.int32)
+            self._d_l0_s = jnp.asarray(s_sorted)
+            self._d_l0_r = jnp.asarray(r_sorted)
+            self._d_l0_w = jnp.asarray(w_sorted)
+            self._d_l0_last = jnp.asarray(last_sorted)
+            # ELL minirows for the fused solve's polish + predecessor
+            # sweeps: ~8× less segment traffic than edge-wise
+            # reductions (the _relax_ell rationale, applied to the
+            # whole contracted graph). Lane tags carry the ORIGINAL
+            # entering edge so recovered predecessors need no remap.
+            nc = self.n_contracted
+            es, ew_, et, er = _pack_ell_flat(s_sorted, r_sorted,
+                                             w_sorted, last_sorted, nc)
+            self._d_l0_ell = (jnp.asarray(es), jnp.asarray(ew_),
+                              jnp.asarray(et), jnp.asarray(er))
+        if fill is not None:
+            nc = self.n_contracted
+
+            def _pad_ids(a):
+                a = np.asarray(a, np.int64)
+                return jnp.asarray(np.where(a >= 0, a, nc).astype(np.int32))
+
+            self._d_fill_node = _pad_ids(fill["node"])
+            self._d_fill_w = jnp.asarray(
+                np.asarray(fill["w"], np.float32))
+            self._d_fill_last = jnp.asarray(
+                np.asarray(fill["last"], np.int64).astype(np.int32))
+            self._d_fill_dir = jnp.asarray(
+                np.asarray(fill["dir"], np.int64).astype(np.int32))
+            self._d_seed_node_full = _pad_ids(self._seed_node)
+            self._d_seed_w_full = jnp.asarray(self._seed_w)
+            self._d_seed_last = jnp.asarray(
+                np.asarray(fill["seed_last"], np.int64).astype(np.int32))
+            # Direction tables for the interior-source same-segment
+            # correction: each emitted chain direction carries at most
+            # ``interior_cap`` interiors, so the correction is a
+            # handful of per-source scatters over (n_dirs, k_max)
+            # tables instead of dense (S, N) compare passes (measured
+            # 36 ms/solve at 250k). Pad row = n_dirs, pad node id =
+            # n_nodes — scatters there are dropped by JAX's
+            # out-of-bounds update semantics.
+            fd = np.asarray(fill["dir"], np.int64)
+            fw_np = np.asarray(fill["w"], np.float32)
+            fl_np = np.asarray(fill["last"], np.int64)
+            mask = fd >= 0
+            self._n_dirs = int(fd.max()) + 1 if mask.any() else 0
+            kmax = 1
+            dir_nodes = np.full((self._n_dirs + 1, 1), self.n_nodes,
+                                np.int64)
+            dir_w = np.full((self._n_dirs + 1, 1), _INF_NP, np.float32)
+            dir_last = np.full((self._n_dirs + 1, 1), -1, np.int64)
+            if self._n_dirs:
+                vv, ss = np.nonzero(mask)
+                dd = fd[vv, ss]
+                order = np.argsort(dd, kind="stable")
+                dd, vv, ss = dd[order], vv[order], ss[order]
+                counts = np.bincount(dd, minlength=self._n_dirs)
+                kmax = max(1, int(counts.max()))
+                starts = np.zeros(self._n_dirs + 1, np.int64)
+                np.cumsum(counts, out=starts[1:])
+                ranks = np.arange(len(dd)) - starts[dd]
+                dir_nodes = np.full((self._n_dirs + 1, kmax),
+                                    self.n_nodes, np.int64)
+                dir_w = np.full((self._n_dirs + 1, kmax), _INF_NP,
+                                np.float32)
+                dir_last = np.full((self._n_dirs + 1, kmax), -1, np.int64)
+                dir_nodes[dd, ranks] = vv
+                dir_w[dd, ranks] = fw_np[vv, ss]
+                dir_last[dd, ranks] = fl_np[vv, ss]
+            self._dir_kmax = kmax
+            self._d_dir_nodes = jnp.asarray(dir_nodes.astype(np.int32))
+            self._d_dir_w = jnp.asarray(dir_w)
+            self._d_dir_last = jnp.asarray(dir_last.astype(np.int32))
         self.stats = stats
         # Topology-only customization structure (partition-tree cuts +
         # contraction composition), attached by ``build``/``load``/
@@ -1037,6 +1386,13 @@ class HierarchicalIndex:
             expand_idx = contraction["cid_of"]
             seed_node = contraction["seed_node"]
             seed_w = contraction["seed_w"]
+            edge_last = contraction["edge_comp"][
+                contraction["edge_comp_ptr"][1:] - 1]
+            fill = {"node": contraction["fill_node"],
+                    "w": contraction["fill_w"],
+                    "last": contraction["fill_last"],
+                    "dir": contraction["fill_dir"],
+                    "seed_last": contraction["seed_last"]}
         else:
             c_coords = coords
             g_s, g_r, g_w = senders, receivers, w
@@ -1045,8 +1401,15 @@ class HierarchicalIndex:
                                   np.full(n_full, -1, np.int64)], axis=1)
             seed_w = np.stack([np.zeros(n_full, np.float32),
                                np.full(n_full, _INF_NP, np.float32)], axis=1)
+            edge_last = np.arange(len(g_s), dtype=np.int64)
+            fill = _identity_fill(n_full)
+        l0 = {"senders": np.asarray(g_s, np.int64),
+              "receivers": np.asarray(g_r, np.int64),
+              "w": np.asarray(g_w, np.float32),
+              "edge_last": edge_last}
         n = len(c_coords)
         contract_s = round(time.perf_counter() - t0, 3)
+        auto_ladder = cell_targets is None
         if cell_targets is None:
             cell_targets = _level_targets(n, cell_target,
                                           max_levels=max_levels)
@@ -1067,9 +1430,17 @@ class HierarchicalIndex:
         }
         if contraction is not None:
             for key in ("edge_comp_ptr", "edge_comp",
-                        "seed_comp_ptr", "seed_comp"):
+                        "seed_comp_ptr", "seed_comp",
+                        "fill_comp_ptr", "fill_comp"):
                 structure[key] = contraction[key]
         prune_slack = _prune_slack()
+        lmax = _labels_max()
+        # Early label-stop applies only to the auto ladder: explicit
+        # ``cell_targets`` (tests forcing deep stacks) build every
+        # requested level. ``B * 8 <= n`` keeps small auto builds
+        # multi-level too — the stop exists to skip DENSE top levels
+        # at scale, not to flatten every small graph to one level.
+        label_stop = min(lmax, _LABEL_STOP) if lmax and auto_ladder else 0
         node_origin = np.arange(n)        # current-graph node → G0 node
         levels: List[_Level] = []
         for li, (cell0, P) in enumerate(parts):
@@ -1084,9 +1455,15 @@ class HierarchicalIndex:
                 break
             payload, lstats, ovl = built
             B = len(payload["b_global"])
-            if li > 0 and 2 * B > len(node_origin):
+            stalled = (B >= len(node_origin) if lmax
+                       else 2 * B > len(node_origin))
+            if li > 0 and stalled:
                 # The overlay stopped shrinking — another level would
-                # cost more stitch work than its BF saves.
+                # cost more stitch work than its BF saves. With labels
+                # on, ANY shrink is worth stacking: the top phase is a
+                # table fold (not a BF whose hop count the level must
+                # pay back), and every node shaved off the top cuts
+                # the all-pairs label build quadratically.
                 break
             # Source lookup: G0 node → this level's (renumbered) cell.
             payload["src_cell"] = payload["cell_remap"][
@@ -1096,8 +1473,21 @@ class HierarchicalIndex:
             levels.append(_Level(payload, lstats))
             g_s, g_r, g_w = ovl
             node_origin = node_origin[payload["b_global"]]
+            if label_stop and B <= label_stop and B * 8 <= n:
+                break
         if not levels:
             return None
+
+        # Hub labels over the top overlay: built with the same batched
+        # relaxation the per-query top BF runs, so the table is exact
+        # and the query's top phase becomes a fold over it. Skipped
+        # (with the BF kept as the serving path) when the top is bigger
+        # than the label budget or the knob is off.
+        labels = None
+        n_top = levels[-1].n_overlay
+        label_stats: Optional[Dict] = None
+        if lmax and 2 <= n_top <= lmax and len(g_s):
+            labels, label_stats = _build_labels(g_s, g_r, g_w, n_top)
 
         l1 = levels[0].stats
         stats = {
@@ -1121,9 +1511,11 @@ class HierarchicalIndex:
             "levels": [dict(lvl.stats) for lvl in levels],
             "build_s": 0.0,
         }
+        if label_stats is not None:
+            stats["labels"] = label_stats
         index = cls(levels, g_s, g_r, g_w, stats,
                     expand_idx=expand_idx, seed_node=seed_node,
-                    seed_w=seed_w)
+                    seed_w=seed_w, l0=l0, fill=fill, labels=labels)
         index._structure = structure
         stats["build_s"] = round(time.perf_counter() - t0, 3)
         if cache_path:
@@ -1175,13 +1567,26 @@ class HierarchicalIndex:
             seed_sums = (scs[scp[1:]] - scs[scp[:-1]]).reshape(-1, 2)
             seed_w = np.where(self._seed_node >= 0, seed_sums,
                               _INF_NP).astype(np.float32)
+            fcp = s["fill_comp_ptr"]
+            fcs = np.concatenate([
+                [0.0], np.cumsum(w_full[s["fill_comp"]],
+                                 dtype=np.float64)])
+            fill_sums = (fcs[fcp[1:]] - fcs[fcp[:-1]]).reshape(-1, 2)
+            fill = dict(self._fill or _identity_fill(len(w_full)))
+            fill["w"] = np.where(
+                np.asarray(fill["node"]) >= 0, fill_sums,
+                _INF_NP).astype(np.float32)
         else:
             g_w = w_full
             seed_w = self._seed_w  # identity contraction: col0 = 0,
             #                        col1 = INF — weight-independent
+            fill = self._fill      # all pads — weight-independent
         g_s = s["c_senders"]
         g_r = s["c_receivers"]
+        g_w0 = g_w                 # level-0 weights, before the loop
+        #                            rebinds g_w to overlay weights
         prune_slack = float(self.stats.get("prune_slack", _prune_slack()))
+        lmax = _labels_max()
         node_origin = np.arange(len(self.levels[0].cell))
         levels: List[_Level] = []
         for li, (cell0, P) in enumerate(s["parts"]):
@@ -1196,7 +1601,9 @@ class HierarchicalIndex:
                 break
             payload, lstats, ovl = built
             B = len(payload["b_global"])
-            if li > 0 and 2 * B > len(node_origin):
+            stalled = (B >= len(node_origin) if lmax
+                       else 2 * B > len(node_origin))
+            if li > 0 and stalled:
                 break
             payload["src_cell"] = payload["cell_remap"][
                 cell0].astype(np.int32)
@@ -1205,6 +1612,18 @@ class HierarchicalIndex:
             levels.append(_Level(payload, lstats))
             g_s, g_r, g_w = ovl
             node_origin = node_origin[payload["b_global"]]
+            if (lmax and B <= min(lmax, _LABEL_STOP)
+                    and B * 8 <= len(self.levels[0].cell)):
+                break
+        # Re-price the labels too (same build, new top weights): a
+        # live-metric flip then keeps the fold path instead of falling
+        # back to the iterative top BF.
+        labels = None
+        lmax = _labels_max()
+        n_top = levels[-1].n_overlay
+        label_stats: Optional[Dict] = None
+        if lmax and 2 <= n_top <= lmax and len(g_s):
+            labels, label_stats = _build_labels(g_s, g_r, g_w, n_top)
         l1 = levels[0].stats
         stats = {
             "n_cells": l1["n_cells"], "c_max": l1["c_max"],
@@ -1223,9 +1642,15 @@ class HierarchicalIndex:
             "customized": True,
             "full_build_s": self.stats.get("build_s", 0.0),
         }
+        if label_stats is not None:
+            stats["labels"] = label_stats
+        l0 = dict(self._l0) if self._l0 is not None else None
+        if l0 is not None:
+            l0["w"] = np.asarray(g_w0, np.float32)
         out = type(self)(levels, g_s, g_r, g_w, stats,
                          expand_idx=self._expand_idx,
-                         seed_node=self._seed_node, seed_w=seed_w)
+                         seed_node=self._seed_node, seed_w=seed_w,
+                         l0=l0, fill=fill, labels=labels)
         out._structure = s
         stats["build_s"] = round(time.perf_counter() - t0, 3)
         return out
@@ -1236,10 +1661,20 @@ class HierarchicalIndex:
             "expand_idx": self._expand_idx,
             "seed_node": self._seed_node, "seed_w": self._seed_w,
         }
+        if self._labels is not None:
+            flat["labels"] = self._labels
+        if self._l0 is not None:
+            for name in ("senders", "receivers", "w", "edge_last"):
+                flat[f"g0_{name}"] = np.asarray(self._l0[name])
+        if self._fill is not None:
+            for name in ("node", "w", "last", "dir", "seed_last"):
+                flat[f"fill_{name}"] = np.asarray(self._fill[name])
         for k, lvl in enumerate(self.levels):
             p = lvl.payload()
             for name in _LEVEL_KEYS:
                 flat[f"l{k}_{name}"] = p[name]
+            if "pt" in p:
+                flat[f"l{k}_pt"] = p["pt"]
         # v3: the customization structure rides along, so a worker that
         # REHYDRATES the overlay can still re-price it against a live
         # metric (the whole point of shipping structure, not just
@@ -1254,7 +1689,8 @@ class HierarchicalIndex:
                 [P for _, P in s["parts"]], np.int64)
             if "edge_comp_ptr" in s:
                 for name in ("edge_comp_ptr", "edge_comp",
-                             "seed_comp_ptr", "seed_comp"):
+                             "seed_comp_ptr", "seed_comp",
+                             "fill_comp_ptr", "fill_comp"):
                     flat[f"s_{name}"] = s[name]
         tmp = f"{cache_path}.tmp{os.getpid()}.npz"
         try:
@@ -1309,10 +1745,22 @@ class HierarchicalIndex:
                 levels = []
                 for k in range(n_levels):
                     p = {name: z[f"l{k}_{name}"] for name in _LEVEL_KEYS}
+                    if f"l{k}_pt" in z.files:
+                        p["pt"] = z[f"l{k}_pt"]
                     levels.append(_Level(p, stats["levels"][k]))
                 top_s, top_r, top_w = z["top_s"], z["top_r"], z["top_w"]
                 expand_idx = z["expand_idx"]
                 seed_node, seed_w = z["seed_node"], z["seed_w"]
+                labels = z["labels"] if "labels" in z.files else None
+                l0 = fill = None
+                if "g0_senders" in z.files:
+                    l0 = {name: z[f"g0_{name}"]
+                          for name in ("senders", "receivers", "w",
+                                       "edge_last")}
+                if "fill_node" in z.files:
+                    fill = {name: z[f"fill_{name}"]
+                            for name in ("node", "w", "last", "dir",
+                                         "seed_last")}
                 structure: Optional[Dict] = None
                 if "s_parts" in z.files:
                     parts_arr = z["s_parts"]
@@ -1325,7 +1773,8 @@ class HierarchicalIndex:
                     }
                     if "s_edge_comp_ptr" in z.files:
                         for name in ("edge_comp_ptr", "edge_comp",
-                                     "seed_comp_ptr", "seed_comp"):
+                                     "seed_comp_ptr", "seed_comp",
+                                     "fill_comp_ptr", "fill_comp"):
                             structure[name] = z[f"s_{name}"]
         except Exception as e:
             _log().warning("overlay_cache_rejected", path=cache_path,
@@ -1334,7 +1783,7 @@ class HierarchicalIndex:
         stats["loaded_from_cache"] = True
         index = cls(levels, top_s, top_r, top_w, stats,
                     expand_idx=expand_idx, seed_node=seed_node,
-                    seed_w=seed_w)
+                    seed_w=seed_w, l0=l0, fill=fill, labels=labels)
         index._structure = structure
         return index
 
@@ -1376,6 +1825,27 @@ class HierarchicalIndex:
                 seed = jnp.take_along_axis(local_prev, lp.d_bl[p_prev],
                                            axis=1)
                 pos = l.d_local_pad[lp.d_cbo[p_prev]]
+                if l.d_pt is not None:
+                    # Dense level: fold the entry seeds through the
+                    # precomputed in-cell all-pairs table — same fixed
+                    # point as the relaxation below, minus the
+                    # per-query sweeps over clique-dense edges. Pad
+                    # seeds land on the per-cell INF row.
+                    bp = seed.shape[1]
+
+                    def body(j, acc):
+                        row = l.d_pt[p, pos[:, j]]       # (S, c_max)
+                        return jnp.minimum(
+                            acc, jnp.expand_dims(seed[:, j], 1) + row)
+
+                    local = jax.lax.fori_loop(
+                        0, bp, body, jnp.full((S, l.c_max), _INF))
+                    for j2 in (0, 1):
+                        row = l.d_pt[p, c["seed_pos"][k][:, j2]]
+                        local = jnp.minimum(
+                            local,
+                            c["seed_val"][k][:, j2, None] + row)
+                    return {**c, f"local{k}": jnp.minimum(local, _INF)}
                 d0 = jnp.full((S, l.c_max + 1), _INF)
                 d0 = d0.at[rows[:, None], pos].min(seed)
                 # Chain-interior sources whose second endpoint lands in
@@ -1404,6 +1874,40 @@ class HierarchicalIndex:
             ovl, _ = relax_from(top_s, top_r, top_w, ovl0[:, :Bt],
                                 n_nodes=Bt, max_iters=Bt + _K_SWEEPS)
             return {**c, "ovl": ovl}
+
+        d_labels = self._d_labels
+
+        def top_labels(c: Dict) -> Dict:
+            """Hub-label fold: the top BF's fixed point read off the
+            precomputed all-pairs table. A source's only finite top
+            seeds are its top-cell boundary distances (+ ≤2 chain
+            seeds), so ``min_b(seed_b + labels[b, v])`` IS the top BF
+            answer — one gather-min over the seed axis instead of a
+            diameter-bound while_loop."""
+            l = lvls[L - 1]
+            p = c["p_cells"][L - 1]
+            local = c[f"local{L - 1}"]
+            S = local.shape[0]
+            seed = jnp.take_along_axis(local, l.d_bl[p], axis=1)
+            ids = l.d_cbo[p]                     # (S, b), pad = Bt
+            lab_pad = jnp.concatenate(
+                [d_labels, jnp.full((1, Bt), _INF)], axis=0)
+            b = seed.shape[1]
+            if S * b * Bt * 4 <= (192 << 20):
+                acc = jnp.min(seed[:, :, None] + lab_pad[ids], axis=1)
+            else:  # bound the (S, b, Bt) proposal on huge tops
+
+                def body(i, acc):
+                    return jnp.minimum(
+                        acc, seed[:, i, None] + lab_pad[ids[:, i]])
+
+                acc = jax.lax.fori_loop(0, b, body,
+                                        jnp.full((S, Bt), _INF))
+            for j in (0, 1):
+                sid = c["seed_pos"][L][:, j]     # pad = Bt (INF row)
+                acc = jnp.minimum(
+                    acc, c["seed_val"][L][:, j, None] + lab_pad[sid])
+            return {**c, "ovl": jnp.minimum(acc, _INF)}
 
         def make_descend(k: int):
             l = lvls[k]
@@ -1446,15 +1950,30 @@ class HierarchicalIndex:
             return descend
 
         def expand(c: Dict) -> Dict:
+            """Contracted → full-graph distances: kept nodes gather
+            their row; chain interiors take ``min`` over their ≤2 fill
+            entries (direction-start distance + along-chain offset).
+            Exact for every path that touches a kept node — which is
+            every path except an interior source's own-segment tail;
+            :meth:`full_solve_fn` refines that case (and recovers
+            predecessors), so callers needing interior-source-to-
+            same-chain exactness go through the full solve."""
             ovl = c["ovl"]                        # (S, n_contracted)
             S = ovl.shape[0]
             pad = jnp.concatenate([ovl, jnp.full((S, 1), _INF)], axis=1)
-            return {**c, "ovl": pad[:, self._d_expand]}
+            out = pad[:, self._d_expand]
+            if self._fill is not None:
+                for j in (0, 1):
+                    fn = self._d_fill_node[:, j]
+                    fw = self._d_fill_w[:, j]
+                    out = jnp.minimum(out, pad[:, fn] + fw[None, :])
+            return {**c, "ovl": jnp.minimum(out, _INF)}
 
         stages: List[Tuple[str, object]] = [("phase1", phase1)]
         for k in range(1, L):
             stages.append((f"ascend_l{k + 1}", make_ascend(k)))
-        stages.append(("top_bf", top_bf))
+        stages.append(("top_labels", top_labels) if d_labels is not None
+                      else ("top_bf", top_bf))
         for k in range(L - 1, -1, -1):
             stages.append((f"descend_l{k + 1}", make_descend(k)))
         if self._contracted:
@@ -1473,6 +1992,177 @@ class HierarchicalIndex:
             return carry["ovl"]
 
         return query
+
+    def full_solve_fn(self, n_sweeps: int = 2):
+        """The router's fused warm-solve program: overlay query +
+        polish + predecessor recovery ON THE CONTRACTED GRAPH, then an
+        exact synthesis of full-graph distances and ORIGINAL-edge
+        predecessors from the chain fill structure.
+
+        Before this, polish and predecessor sweeps ran over the FULL
+        edge list — 2-3 passes over (S, E_full) that dominated warm
+        latency once the overlay phases shrank (the bend ratio makes
+        the contracted graph ~6× smaller on real street extracts).
+        Synthesis rules (all exact):
+
+        - kept node: distance = its contracted row; predecessor = the
+          last ORIGINAL edge of its contracted predecessor edge.
+        - chain interior v: min over its ≤2 fill slots of
+          ``dist[direction start] + along-chain offset``, plus — when
+          the SOURCE sits on the same emitted direction upstream — the
+          direct along-chain offset difference (the one path family
+          that never touches a kept node). Predecessor = that
+          direction's entering hop.
+        - seed endpoints of an interior source whose distance still
+          equals the seed offset take the chain's last hop as
+          predecessor (no contracted edge carried that assignment).
+
+        Returns a traceable ``(p_cells, seed_pos, seed_val,
+        src_full) -> (dist (S, N), pred (S, N) original edge ids)``;
+        callers jit/AOT-compile it per bucket."""
+        if self._l0 is None or self._fill is None:
+            raise ValueError("index lacks level-0/fill arrays (pre-v4 "
+                             "cache or direct construction) — rebuild "
+                             "the overlay")
+        stages = [st for st in self._stages() if st[0] != "expand"]
+        nc = self.n_contracted
+        ell_s, ell_w, ell_t, ell_r = self._d_l0_ell
+        d_expand = self._d_expand
+        d_fill_node = self._d_fill_node
+        d_fill_w = self._d_fill_w
+        d_fill_last = self._d_fill_last
+        d_fill_dir = self._d_fill_dir
+        d_seed_node = self._d_seed_node_full
+        d_seed_w = self._d_seed_w_full
+        d_seed_last = self._d_seed_last
+
+        def solve(p_cells: jax.Array, seed_pos: jax.Array,
+                  seed_val: jax.Array, src_full: jax.Array):
+            carry = {"p_cells": p_cells, "seed_pos": seed_pos,
+                     "seed_val": seed_val}
+            for _name, fn in stages:
+                carry = fn(carry)
+            dist_c = carry["ovl"]                    # (S, n_contracted)
+            S = dist_c.shape[0]
+            rows = jnp.arange(S)
+
+            # Polish + tight-edge recovery over the ELL minirows: the
+            # same math as :func:`polish`/:func:`tight_edges`, with
+            # segment reductions over E/8 minirows instead of E edges
+            # — on CPU the segment op, not the gather, is the cost.
+            # Lane tags ARE the original entering edges, so recovered
+            # predecessors need no later remap.
+            def seg_min_rows(x):
+                return jax.vmap(lambda v: jax.ops.segment_min(
+                    v, ell_r, num_segments=nc,
+                    indices_are_sorted=True))(x)
+
+            for _ in range(n_sweeps):
+                prop = (dist_c[:, ell_s] + ell_w[None]).min(axis=2)
+                dist_c = jnp.minimum(dist_c, seg_min_rows(prop))
+            prop3 = dist_c[:, ell_s] + ell_w[None]       # (S, m, 8)
+            slack3 = prop3 - dist_c[:, ell_r][:, :, None]
+            min_slack = seg_min_rows(slack3.min(axis=2))
+            tight3 = slack3 <= min_slack[:, ell_r][:, :, None] + 1e-2
+            # Min-sender-dist disambiguation (see tight_edges).
+            sd3 = jnp.where(tight3, dist_c[:, ell_s], _INF)
+            best_sd = seg_min_rows(sd3.min(axis=2))
+            pick3 = tight3 & (sd3 <= best_sd[:, ell_r][:, :, None])
+            ids3 = jnp.where(pick3, ell_t[None], -1)
+            pred_c = jnp.maximum(jax.vmap(
+                lambda v: jax.ops.segment_max(
+                    v, ell_r, num_segments=nc,
+                    indices_are_sorted=True))(ids3.max(axis=2)), -1)
+            dist_pad = jnp.concatenate(
+                [dist_c, jnp.full((S, 1), _INF)], axis=1)
+            pred_pad = jnp.concatenate(
+                [pred_c, jnp.full((S, 1), -1, jnp.int32)], axis=1)
+            # Interior-source seed endpoints still carrying their seed
+            # assignment: encode the chain's last hop as -2 - edge so
+            # synthesis can tell it from a contracted edge id.
+            sn = d_seed_node[src_full]               # (S, 2), pad = nc
+            sw = d_seed_w[src_full]
+            sl = d_seed_last[src_full]
+            for j in (0, 1):
+                cur = pred_pad[rows, sn[:, j]]
+                cond = ((sl[:, j] >= 0)
+                        & (dist_pad[rows, sn[:, j]] >= sw[:, j]))
+                pred_pad = pred_pad.at[rows, sn[:, j]].set(
+                    jnp.where(cond, -2 - sl[:, j], cur))
+            # Synthesis: kept gather + fill fold. Direction choice is
+            # ulp-TOLERANT with a smaller-START-distance tie-break:
+            # zero-length chain hops make equal-distance neighbor pairs
+            # (interior ↔ kept endpoint) whose independent pred choices
+            # could otherwise point at each other — a walk 2-cycle the
+            # 250k extract actually produced. Preferring the direction
+            # whose start is strictly closer makes every within-chain
+            # walk step monotone toward a kept node, so the synthesized
+            # forest is acyclic wherever the contracted tree is.
+            base = dist_pad[:, d_expand]
+            pc = pred_pad[:, d_expand]
+            # pred_c lanes already carry ORIGINAL edge ids; -2 - e
+            # encodes an interior source's chain hop (above).
+            bpred_k = jnp.where(pc <= -2, -2 - pc, pc)
+            # Fill fold over the two slots in ONE vectorized pick:
+            # kept nodes have pad (INF) fills so their contracted row
+            # always wins; interiors choose between their two
+            # directions with an ulp-tolerant, nearer-start tie-break.
+            start0 = dist_pad[:, d_fill_node[:, 0]]
+            val0 = start0 + d_fill_w[None, :, 0]
+            start1 = dist_pad[:, d_fill_node[:, 1]]
+            val1 = start1 + d_fill_w[None, :, 1]
+            close = jnp.abs(val0 - val1) <= 4e-7 * val0 + 1e-6
+            pick1 = jnp.where(close, start1 < start0, val1 < val0)
+            fval = jnp.where(pick1, val1, val0)
+            fstart = jnp.where(pick1, start1, start0)
+            fpred = jnp.where(pick1, d_fill_last[None, :, 1],
+                              d_fill_last[None, :, 0])
+            take = (fval < 1e37) & (fval < base)
+            best = jnp.where(take, fval, base)
+            best_start = jnp.where(take, fstart, -jnp.inf)
+            bpred = jnp.where(take, fpred, bpred_k)
+
+            def closer(val, start, cur, cur_start):
+                finite = val < 1e37
+                close_ = jnp.abs(val - cur) <= 4e-7 * val + 1e-6
+                return finite & jnp.where(close_, start < cur_start,
+                                          val < cur)
+            # Same-direction along-chain candidates for interior
+            # sources — the one path family that never touches a kept
+            # node; their "start" is the source itself (distance 0, the
+            # minimal possible, so they win every tie). Each emitted
+            # direction holds ≤ interior_cap interiors, so this is a
+            # few (S,)-sized scatters through the direction tables
+            # (pads scatter out of bounds and are dropped), not dense
+            # (S, N) compare passes.
+            sdir = d_fill_dir[src_full]              # (S, 2)
+            sfw = d_fill_w[src_full]
+            for i in (0, 1):
+                d = jnp.where(sdir[:, i] >= 0, sdir[:, i], self._n_dirs)
+                ok_dir = sdir[:, i] >= 0
+                for k in range(self._dir_kmax):
+                    v = self._d_dir_nodes[d, k]          # (S,), pad = N
+                    off = self._d_dir_w[d, k] - sfw[:, i]
+                    ok = ok_dir & (off >= 0)
+                    val = jnp.where(ok, off, _INF)
+                    v_safe = jnp.minimum(v, best.shape[1] - 1)
+                    cur = best[rows, v_safe]
+                    curp = bpred[rows, v_safe]
+                    cur_start = best_start[rows, v_safe]
+                    take = ok & closer(val, jnp.zeros_like(val), cur,
+                                       cur_start)
+                    bpred = bpred.at[rows, v].set(
+                        jnp.where(take, self._d_dir_last[d, k], curp))
+                    best = best.at[rows, v].set(
+                        jnp.where(take, val, cur))
+                    best_start = best_start.at[rows, v].set(
+                        jnp.where(take, 0.0, cur_start))
+            best = jnp.minimum(best, _INF)
+            best = best.at[rows, src_full].set(0.0)
+            bpred = bpred.at[rows, src_full].set(-1)
+            return best, bpred
+
+        return solve
 
     def timed_query(self, sources: np.ndarray
                     ) -> Tuple[np.ndarray, Dict[str, float]]:
@@ -1546,9 +2236,10 @@ def build_params() -> Dict:
     the same graph — part of the cache key, so flipping a knob can
     never serve a payload built under the old one."""
     try:
-        ratio = int(os.environ.get("ROUTEST_HIER_RATIO", "16") or 16)
+        # 0 = auto (4 with labels, 16 without) — see _level_targets.
+        ratio = int(os.environ.get("ROUTEST_HIER_RATIO", "0") or 0)
     except ValueError:
-        ratio = 16
+        ratio = 0
     try:
         max_levels = int(os.environ.get("ROUTEST_HIER_MAX_LEVELS", "0") or 0)
     except ValueError:
@@ -1560,7 +2251,7 @@ def build_params() -> Dict:
         cell_target = 0
     return {"prune_slack": _prune_slack(), "ratio": ratio,
             "max_levels": max_levels, "cell_target": cell_target,
-            "contract": _contract_interior()}
+            "contract": _contract_interior(), "labels": _labels_max()}
 
 
 def _fingerprint_digest(fingerprint: Dict) -> str:

@@ -46,7 +46,6 @@ from routest_tpu.optimize.hierarchy import (
     HierarchicalIndex,
     hier_cache_path,
     hier_min_nodes,
-    polish,
     relax_from,
     tight_pred,
 )
@@ -89,6 +88,16 @@ def _router_metrics():
                 "rtpu_road_model_generation",
                 "Generation id of the live road-GNN leg pricer "
                 "(monotonic per process; bumps on every swap)."),
+            "batch_dispatches": reg.counter(
+                "rtpu_router_batch_dispatches_total",
+                "Merged solve dispatches through the router batcher."),
+            "batch_rows": reg.counter(
+                "rtpu_router_batch_rows_total",
+                "Source rows solved through merged dispatches."),
+            "batch_merged": reg.counter(
+                "rtpu_router_batch_merged_requests_total",
+                "Requests that shared a dispatch with at least one "
+                "other request."),
         }
     return _metrics
 
@@ -144,16 +153,20 @@ def _time_table(bf_senders: jax.Array, pred: jax.Array, time_bf: jax.Array,
 
 # Flat-relaxation sweeps run over hierarchy distances before
 # predecessor recovery: the overlay's re-associated sums round a few
-# ulps away from the sweep's own ``dist[s] + w`` assignments; a couple
-# of UNROLLED sweeps re-anchor ties near-bitwise (values are already
-# exact, so these are O(1), not O(diameter)) — each sweep is a full
-# (S, N)×E pass, so the count is a first-order term in metro warm
-# latency (8 sweeps cost ~700 ms of the 250k solve on one core).
+# ulps away from the sweep's own ``dist[s] + w`` assignments; an
+# UNROLLED sweep re-anchors ties near-bitwise (values are already
+# exact, so this is O(1), not O(diameter)). The sweeps now run on the
+# CONTRACTED graph (chain interiors are synthesized from the fill
+# structure, not relaxed in), and since the input values are exact the
+# single default sweep re-anchors every node whose assignment matters
+# — tight_edges' min-slack + 1 cm merge slack absorbs the one-op
+# rounding that remains. Each sweep is a full (S, Nc)×Ec pass (~40 ms
+# at 250k on one core), a first-order term in metro warm latency.
 def _polish_sweeps() -> int:
     try:
-        return max(1, int(os.environ.get("ROUTEST_POLISH_SWEEPS", "2")))
+        return max(1, int(os.environ.get("ROUTEST_POLISH_SWEEPS", "1")))
     except ValueError:
-        return 2
+        return 1
 
 
 @functools.partial(jax.jit, static_argnames=("n_nodes", "max_iters"))
@@ -204,13 +217,18 @@ class _LiveMetric:
     installed with a single reference flip — requests snapshot
     ``router._live`` once, so a flip can never tear a solve."""
 
-    __slots__ = ("epoch", "time_s", "d_time_bf", "hier", "solve", "aot",
-                 "route", "installed_unix", "timings")
+    __slots__ = ("epoch", "gen", "time_s", "d_time_bf", "hier", "solve",
+                 "aot", "route", "installed_unix", "timings")
 
     def __init__(self, epoch: int, time_s: np.ndarray, d_time_bf,
                  hier, solve, aot: Dict[int, object], route: bool,
-                 timings: Dict) -> None:
+                 timings: Dict, gen: int = 0) -> None:
         self.epoch = int(epoch)
+        # Router-internal monotonic install counter: the route
+        # fastlane keys on (epoch, gen) so even a caller that reuses
+        # an epoch number (two customizer instances both starting at
+        # 1) can never alias two different metrics onto one cache key.
+        self.gen = int(gen)
         self.time_s = time_s
         self.d_time_bf = d_time_bf
         self.hier = hier
@@ -219,6 +237,175 @@ class _LiveMetric:
         self.route = route
         self.installed_unix = time.time()
         self.timings = timings
+
+
+def _batcher_config() -> Tuple[bool, int, float]:
+    """(enabled, max merged rows, window seconds) for the solve
+    batcher (``ROUTEST_ROUTER_BATCH`` on/off,
+    ``ROUTEST_ROUTER_BATCH_MAX``, ``ROUTEST_ROUTER_BATCH_WINDOW_MS``)."""
+    raw = os.environ.get("ROUTEST_ROUTER_BATCH", "1").strip().lower()
+    enabled = raw not in ("0", "off", "false", "no")
+    try:
+        max_rows = max(1, int(os.environ.get(
+            "ROUTEST_ROUTER_BATCH_MAX", "32")))
+    except ValueError:
+        max_rows = 32
+    try:
+        window_ms = float(os.environ.get(
+            "ROUTEST_ROUTER_BATCH_WINDOW_MS", "0"))
+    except ValueError:
+        window_ms = 0.0
+    return enabled, max_rows, max(0.0, window_ms) / 1000.0
+
+
+class _BatchEntry:
+    __slots__ = ("sources", "live", "key", "event", "dist", "pred", "error")
+
+    def __init__(self, sources: np.ndarray, live, key) -> None:
+        self.sources = sources
+        self.live = live
+        self.key = key
+        self.event = threading.Event()
+        self.dist = self.pred = None
+        self.error: Optional[BaseException] = None
+
+
+class _SolveBatcher:
+    """Cross-request solve coalescing: concurrent :meth:`shortest`
+    callers whose metric generation matches merge into ONE padded
+    device dispatch. The solver's source axis is batched by design, so
+    merged results are bitwise what lone solves return — the merge only
+    amortizes dispatch + fetch, the way the ETA ``DynamicBatcher``
+    amortizes scoring (docs/ARCHITECTURE.md "Serving").
+
+    Zero added latency by construction with the default 0 ms window: a
+    lone request dispatches immediately; arrivals during an in-flight
+    solve queue and drain as the NEXT merged batch (the natural-
+    batching regime — occupancy grows exactly when the device is the
+    bottleneck). ``window_s > 0`` adds a fixed pre-drain wait for
+    benchmarking forced batch shapes.
+
+    Requests under different live-metric epochs never share a dispatch
+    (their edge weights differ); the leader drains one epoch group per
+    round and keeps going until the queue is empty, so mixed-epoch
+    bursts around a metric flip drain in arrival order."""
+
+    def __init__(self, router: "RoadRouter", max_rows: int,
+                 window_s: float) -> None:
+        self._router = router
+        self.max_rows = int(max_rows)
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._queue: List[_BatchEntry] = []
+        self._busy = False
+        self._dispatches = 0
+        self._rows = 0
+        self._requests = 0
+        self._merged_requests = 0
+        self._max_occupancy = 0
+
+    def stats(self) -> Dict:
+        with self._lock:
+            d = max(1, self._dispatches)
+            return {"max_rows": self.max_rows,
+                    "window_ms": round(self.window_s * 1000, 3),
+                    "dispatches": self._dispatches,
+                    "rows": self._rows,
+                    "requests": self._requests,
+                    "merged_requests": self._merged_requests,
+                    "max_occupancy": self._max_occupancy,
+                    "mean_rows_per_dispatch": round(self._rows / d, 3)}
+
+    def solve(self, sources: np.ndarray, live):
+        key = live.epoch if (live is not None and live.route) else 0
+        entry = _BatchEntry(sources, live if key else None, key)
+        with self._lock:
+            self._queue.append(entry)
+            self._requests += 1
+            leader = not self._busy
+            if leader:
+                self._busy = True
+        if not leader:
+            if not entry.event.wait(120.0):
+                raise TimeoutError("router solve batcher wedged")
+            if entry.error is not None:
+                raise entry.error
+            return entry.dist, entry.pred
+        drain_error: Optional[BaseException] = None
+        try:
+            if self.window_s > 0:
+                time.sleep(self.window_s)
+            while True:
+                with self._lock:
+                    if not self._queue:
+                        # Clearing the flag and observing the empty
+                        # queue must be ONE atomic step: an arrival in
+                        # between would otherwise wait on a leader that
+                        # already left.
+                        self._busy = False
+                        break
+                    k0 = self._queue[0].key
+                    batch: List[_BatchEntry] = []
+                    rest: List[_BatchEntry] = []
+                    rows = 0
+                    for it in self._queue:
+                        if (it.key == k0
+                                and rows + len(it.sources) <= self.max_rows):
+                            batch.append(it)
+                            rows += len(it.sources)
+                        else:
+                            rest.append(it)
+                    self._queue = rest
+                    self._dispatches += 1
+                    self._rows += rows
+                    self._max_occupancy = max(self._max_occupancy, rows)
+                    if len(batch) > 1:
+                        self._merged_requests += len(batch)
+                m = _router_metrics()
+                m["batch_dispatches"].inc()
+                m["batch_rows"].inc(rows)
+                if len(batch) > 1:
+                    m["batch_merged"].inc(len(batch))
+                self._dispatch(batch)
+        except BaseException as e:  # drain-loop bug: fail loudly, not hung
+            drain_error = e
+            raise
+        finally:
+            if drain_error:
+                with self._lock:
+                    # Never leave the flag stuck: if the drain loop
+                    # itself died, surviving queue entries error out
+                    # rather than hang their threads.
+                    leftovers = list(self._queue)
+                    self._queue = []
+                    self._busy = False
+            else:
+                leftovers = []
+            for it in leftovers:
+                if not it.event.is_set():
+                    it.error = drain_error
+                    it.event.set()
+        if entry.error is not None:
+            raise entry.error
+        return entry.dist, entry.pred
+
+    def _dispatch(self, batch: List[_BatchEntry]) -> None:
+        merged = (batch[0].sources if len(batch) == 1
+                  else np.concatenate([it.sources for it in batch]))
+        try:
+            dist, pred = self._router._solve_rows(merged, batch[0].live)
+        except BaseException as e:  # propagate to every merged caller
+            for it in batch:
+                it.error = e
+                it.event.set()
+            return
+        pos = 0
+        for it in batch:
+            m = len(it.sources)
+            it.dist = dist[pos:pos + m]
+            it.pred = pred[pos:pos + m]
+            pos += m
+            it.event.set()
 
 
 class RoadRouter:
@@ -312,11 +499,7 @@ class RoadRouter:
             # axon tunnel each dispatch costs a host round trip (~70 ms
             # measured), which dominated metro-scale warm latency; it
             # also collapses three per-bucket compiles into one.
-            # (Polish runs at least ``interior_cap`` sweeps — that is
-            # what re-derives chain-interior distances from the
-            # contracted overlay solution; see _make_overlay_solve.)
-            self._overlay_solve = self._make_overlay_solve(
-                self._hier, self._bf_length)
+            self._overlay_solve = self._make_overlay_solve(self._hier)
             # AOT-compile the query entry per (graph, overlay) shape at
             # init (``jit(...).lower().compile()``): warm latency then
             # excludes dispatch/trace overhead and the FIRST request of
@@ -335,6 +518,18 @@ class RoadRouter:
                     *spec).compile()
             self._aot_compile_s = round(time.perf_counter() - t0, 3)
             self._publish_overlay_metrics()
+        # Cross-request solve batching (concurrent request_route /
+        # matrix traffic shares compiled dispatches) + the route-level
+        # fastlane (Zipf-skewed OD traffic mostly skips the solver).
+        enabled, max_rows, window_s = _batcher_config()
+        self._solve_batcher: Optional[_SolveBatcher] = (
+            _SolveBatcher(self, max_rows, window_s) if enabled else None)
+        from routest_tpu.optimize.route_cache import (RouteCache,
+                                                      route_cache_config)
+
+        rc_on, rc_bytes, rc_ttl = route_cache_config()
+        self._route_cache: Optional[RouteCache] = (
+            RouteCache(rc_bytes, rc_ttl) if rc_on else None)
         # Learned leg costs: load the trained road-GNN when its training
         # graph fingerprint matches this router's node set.
         self._hour_times: Dict[int, np.ndarray] = {}
@@ -361,6 +556,7 @@ class RoadRouter:
         # customizer, snapshotted once per request batch. None = frozen
         # world (free-flow / GNN pricing, distance-metric routing).
         self._live: Optional[_LiveMetric] = None
+        self._live_installs = 0  # monotonic; part of the route-cache key
         self._live_lock = threading.Lock()  # serializes installs only
         # Serializes reloads only — model loading happens OUTSIDE the
         # cache lock so a retrain never stalls concurrent requests.
@@ -430,11 +626,20 @@ class RoadRouter:
                     "overlay": dict(self._hier.stats)}
             info["overlay"].setdefault("loaded_from_cache", False)
             info["overlay"]["cache_version"] = _CACHE_VERSION
+            info["hub_labels"] = self._hier._labels is not None
             info["aot_buckets"] = sorted(self._aot)
             if self._aot:
                 info["aot_compile_s"] = self._aot_compile_s
         else:
             info = {"solver": "flat_bf", "max_iters_bound": self.max_iters}
+        # Routing fast-path provenance (docs/PERFORMANCE.md §7): the
+        # solve batcher's merged-dispatch stats and the route
+        # fastlane's hit/byte counters, for health and the serving
+        # bench artifact.
+        if self._solve_batcher is not None:
+            info["batch"] = self._solve_batcher.stats()
+        if self._route_cache is not None:
+            info["route_cache"] = self._route_cache.stats()
         if self._live is not None:
             info["live"] = self.live_info
         return info
@@ -500,7 +705,7 @@ class RoadRouter:
             hier_live = self._hier.customize(time_s)
             timings["customize_s"] = round(time.perf_counter() - t0, 3)
             timings["full_build_s"] = self._hier.stats.get("build_s", 0.0)
-            solve = self._make_overlay_solve(hier_live, d_time_bf)
+            solve = self._make_overlay_solve(hier_live)
             t0 = time.perf_counter()
             L = hier_live.n_levels
             for bucket in self._aot_buckets():
@@ -510,9 +715,11 @@ class RoadRouter:
                         jnp.zeros((bucket,), jnp.int32))
                 aot[bucket] = solve.lower(*spec).compile()
             timings["aot_s"] = round(time.perf_counter() - t0, 3)
-        live = _LiveMetric(epoch, time_s, d_time_bf, hier_live, solve,
-                           aot, route, timings)
         with self._live_lock:
+            self._live_installs += 1
+            live = _LiveMetric(epoch, time_s, d_time_bf, hier_live,
+                               solve, aot, route, timings,
+                               gen=self._live_installs)
             self._live = live
         from routest_tpu.live import set_metric_epoch
 
@@ -522,32 +729,16 @@ class RoadRouter:
             **timings)
         return dict(timings, epoch=live.epoch)
 
-    def _make_overlay_solve(self, hier: HierarchicalIndex, d_weights):
-        """Fused overlay query + polish + predecessor recovery over the
-        given index and (receiver-sorted) edge weights — one jitted
-        program, one dispatch per warm solve. Shared by the distance
-        overlay (init) and every live-metric generation (customizer)."""
-        n_sweeps = max(_polish_sweeps(),
-                       hier.stats.get("contraction",
-                                      {}).get("interior_cap", 0))
-
-        @jax.jit
-        def _solve(p_cells, seed_pos, seed_val, padded_d):
-            dist = hier.query_fn(p_cells, seed_pos, seed_val)
-            # A chain-interior source's own row re-seeds at 0 so the
-            # polish sweeps fill its own chain (its overlay seeds
-            # carried the along-chain offsets, not the origin).
-            dist = dist.at[jnp.arange(dist.shape[0]),
-                           padded_d].min(0.0)
-            dist = polish(
-                self._bf_senders, self._bf_receivers, d_weights,
-                dist, n_nodes=self.n_nodes, n_sweeps=n_sweeps)
-            pred = tight_pred(
-                self._bf_senders, self._bf_receivers, d_weights,
-                dist, padded_d, n_nodes=self.n_nodes)
-            return dist, pred
-
-        return _solve
+    def _make_overlay_solve(self, hier: HierarchicalIndex):
+        """Fused overlay query + CONTRACTED-graph polish/predecessor
+        recovery + exact chain synthesis — one jitted program, one
+        dispatch per warm solve (``HierarchicalIndex.full_solve_fn``).
+        Shared by the distance overlay (init) and every live-metric
+        generation (customizer — the customized index carries its own
+        re-priced contracted weights and fill offsets). Polish sweeps
+        no longer couple to the contraction cap: chain interiors are
+        synthesized from the fill structure, not relaxed in."""
+        return jax.jit(hier.full_solve_fn(_polish_sweeps()))
 
     def graph_dict(self) -> Dict[str, np.ndarray]:
         """The (post-bridge) routable graph — the EXACT arrays serving
@@ -871,7 +1062,11 @@ class RoadRouter:
         The source axis is padded to power-of-two buckets (duplicating
         source 0) so varying waypoint counts reuse one compiled program
         instead of recompiling the while_loop on the request path — the
-        same bucket trick as the serving batcher.
+        same bucket trick as the serving batcher. Concurrent callers
+        whose metric generation matches merge into ONE device dispatch
+        through the solve batcher (``_SolveBatcher``) — the row axis is
+        batched by construction, so merged results are bitwise what a
+        lone solve returns.
 
         With ``live`` (a snapshot of ``self._live`` taken ONCE by the
         caller, so one request batch never straddles a flip) and its
@@ -880,6 +1075,16 @@ class RoadRouter:
         predecessor trees are time-shortest (``route_legs_batch``
         recovers leg meters along those trees separately).
         """
+        source_nodes = np.asarray(source_nodes, np.int32)
+        batcher = self._solve_batcher
+        if batcher is not None and 0 < len(source_nodes) <= batcher.max_rows:
+            return batcher.solve(source_nodes, live)
+        return self._solve_rows(source_nodes, live)
+
+    def _solve_rows(self, source_nodes: np.ndarray,
+                    live: Optional[_LiveMetric] = None):
+        """The real dispatch body behind :meth:`shortest` (the batcher
+        calls this with merged rows)."""
         source_nodes = np.asarray(source_nodes, np.int32)
         n_src = len(source_nodes)
         bucket = 1 << max(0, (n_src - 1)).bit_length()
@@ -892,19 +1097,22 @@ class RoadRouter:
                 solve = live.aot.get(bucket, live.solve)
                 dist, pred = jax.device_get(solve(
                     p_cells, seed_pos, seed_val, jnp.asarray(padded)))
-            else:
-                # Flat graphs re-dispatch the SAME compiled program with
-                # the time weights as arguments — a metric flip costs
-                # zero recompiles here.
-                dist, pred, converged = jax.device_get(_bellman_ford(
-                    self._bf_senders, self._bf_receivers, live.d_time_bf,
-                    jnp.asarray(padded),
-                    n_nodes=self.n_nodes, max_iters=self.max_iters))
-                if not bool(converged):
-                    dist, pred, _ = jax.device_get(_bellman_ford(
-                        self._bf_senders, self._bf_receivers,
-                        live.d_time_bf, jnp.asarray(padded),
-                        n_nodes=self.n_nodes, max_iters=self.n_nodes))
+                _router_metrics()["phase"].labels(phase="solve").observe(
+                    time.perf_counter() - t0)
+                # full_solve_fn already returns ORIGINAL edge ids.
+                return dist[:n_src], pred[:n_src]
+            # Flat graphs re-dispatch the SAME compiled program with
+            # the time weights as arguments — a metric flip costs
+            # zero recompiles here.
+            dist, pred, converged = jax.device_get(_bellman_ford(
+                self._bf_senders, self._bf_receivers, live.d_time_bf,
+                jnp.asarray(padded),
+                n_nodes=self.n_nodes, max_iters=self.max_iters))
+            if not bool(converged):
+                dist, pred, _ = jax.device_get(_bellman_ford(
+                    self._bf_senders, self._bf_receivers,
+                    live.d_time_bf, jnp.asarray(padded),
+                    n_nodes=self.n_nodes, max_iters=self.n_nodes))
             _router_metrics()["phase"].labels(phase="solve").observe(
                 time.perf_counter() - t0)
             pred = pred[:n_src]
@@ -913,8 +1121,10 @@ class RoadRouter:
             return dist[:n_src], pred
         if self._hier is not None:
             # Overlay path: exact distances in O(top-cells-across)
-            # sweeps, then a couple of polish sweeps so the tight-edge
-            # recovery sees the flat relaxation's own tie structure.
+            # sweeps (or one hub-label fold), polish + predecessor
+            # recovery on the CONTRACTED graph, and exact chain
+            # synthesis back to full-graph rows — all one fused
+            # program returning ORIGINAL edge predecessors.
             # Convergence is guaranteed by construction (the overlay
             # loop's bound is its exact node count), so no exhaustion
             # re-run exists. Buckets AOT-compiled at init dispatch the
@@ -926,9 +1136,7 @@ class RoadRouter:
                 p_cells, seed_pos, seed_val, jnp.asarray(padded)))
             _router_metrics()["phase"].labels(phase="solve").observe(
                 time.perf_counter() - t0)
-            pred = pred[:n_src]
-            pred = np.where(pred >= 0, self._bf_perm[np.maximum(pred, 0)], -1)
-            return dist[:n_src], pred
+            return dist[:n_src], pred[:n_src]
         # ONE batched device_get for (dist, pred, converged): separate
         # np.asarray fetches each pay a full tunnel round trip (~70 ms),
         # which dominated small-graph request latency (252 → 102 ms
@@ -1030,12 +1238,95 @@ class RoadRouter:
         pred i32 rows over every node) stays under ~64 MB:
         serving-default graphs take a single call, metro graphs chunk
         instead of materializing a (ΣM, N) table.
+
+        Problems first consult the route fastlane
+        (``optimize/route_cache.py``): a cached identical problem —
+        same waypoint bytes, time scale, hour, live-metric epoch and
+        road-model generation — skips snap AND solve entirely, and
+        concurrent identical problems collapse onto one solve
+        (singleflight). Only the uncached remainder reaches the
+        grouped solves below.
         """
         self._maybe_reload_models()  # once for the whole batch
         pts_list = [np.asarray(p, np.float32) for p, _, _ in problems]
         counts = [len(p) for p in pts_list]
-        offsets = np.concatenate([[0], np.cumsum(counts)])
-        all_pts = np.concatenate(pts_list, axis=0)
+        # ONE live-metric snapshot for the whole batch: every problem in
+        # it prices (and, with the route metric armed, routes) against
+        # the same metric generation — a concurrent flip affects only
+        # later batches, never tears this one.
+        live = self._live
+        out: List[Optional[RoadLegs]] = [None] * len(problems)
+        cache = self._route_cache
+        keys: List = [None] * len(problems)
+        aliases: List[Tuple[int, int]] = []        # (idx, lead idx)
+        waits: List[Tuple[int, object]] = []       # (idx, flight)
+        solve_idx: List[int] = list(range(len(problems)))
+        if cache is not None:
+            epoch = ((live.epoch, live.gen) if live is not None
+                     else (0, 0))
+            gen = self._model_gen
+            my_leads: Dict = {}
+            solve_idx = []
+            for i, pts in enumerate(pts_list):
+                _, time_scale, hour = problems[i]
+                eff_hour = 12 if hour is None else int(hour) % 24
+                key = (pts.tobytes(), len(pts), float(time_scale),
+                       eff_hour, epoch, gen)
+                keys[i] = key
+                lead = my_leads.get(key)
+                if lead is not None:
+                    # duplicate inside this batch: share the lead's
+                    # legs (waiting on our own flight would deadlock)
+                    aliases.append((i, lead))
+                    continue
+                state, val = cache.lookup(key)
+                if state == "hit":
+                    out[i] = val
+                elif state == "wait":
+                    waits.append((i, val))
+                else:
+                    my_leads[key] = i
+                    solve_idx.append(i)
+
+        try:
+            if solve_idx:
+                self._solve_problems(problems, pts_list, counts,
+                                     solve_idx, live, out,
+                                     copy_rows=cache is not None)
+        except BaseException as e:
+            if cache is not None:
+                for i in solve_idx:
+                    cache.abort(keys[i], e)
+            raise
+        if cache is not None:
+            for i in solve_idx:
+                legs = out[i]
+                cache.commit(keys[i], legs, legs.nbytes())
+        for i, lead in aliases:
+            out[i] = out[lead]
+        if waits:
+            # Respect the request budget like the ETA fast lane: a
+            # parked waiter must not outlive its deadline waiting on a
+            # slow leader.
+            from routest_tpu.serve.deadline import current_deadline
+
+            dl = current_deadline()
+            budget = (None if dl is None
+                      else max(0.0, dl - time.monotonic()))
+            for i, flight in waits:
+                out[i] = cache.wait(flight, budget)
+        return out
+
+    def _solve_problems(self, problems, pts_list, counts, solve_idx,
+                        live, out, *, copy_rows: bool) -> None:
+        """Snap + grouped solves + :class:`RoadLegs` construction for
+        the selected problem indices (the cache-miss remainder).
+        ``copy_rows`` detaches each problem's rows from the group
+        solve's big arrays so a cached entry can never pin a whole
+        (Σrows, N) result."""
+        sel_counts = [counts[i] for i in solve_idx]
+        offsets = np.concatenate([[0], np.cumsum(sel_counts)])
+        all_pts = np.concatenate([pts_list[i] for i in solve_idx], axis=0)
         # snap() materializes an (M, N) haversine table — chunk its row
         # axis too, or a full road batch on a country-scale graph would
         # build the multi-GB host tensor the solve grouping avoids.
@@ -1059,30 +1350,28 @@ class RoadRouter:
         groups: List[List[int]] = []
         cur: List[int] = []
         rows = 0
-        for idx, m in enumerate(counts):
+        for j, m in enumerate(sel_counts):
             if cur and rows + m > budget:
                 groups.append(cur)
                 cur, rows = [], 0
-            cur.append(idx)
+            cur.append(j)
             rows += m
         if cur:
             groups.append(cur)
 
-        # ONE live-metric snapshot for the whole batch: every problem in
-        # it prices (and, with the route metric armed, routes) against
-        # the same metric generation — a concurrent flip affects only
-        # later batches, never tears this one.
-        live = self._live
-        out: List[Optional[RoadLegs]] = [None] * len(problems)
+        def _rows(a, lo, hi):
+            return a[lo:hi].copy() if copy_rows else a[lo:hi]
+
         for g in groups:
-            sel = np.concatenate([np.arange(offsets[i], offsets[i + 1])
-                                  for i in g])
+            sel = np.concatenate([np.arange(offsets[j], offsets[j + 1])
+                                  for j in g])
             dist, pred = self.shortest(all_nodes[sel], live=live)
             meters = (self._meters_along(pred, dist)
                       if live is not None and live.route else None)
             pos = 0
-            for i in g:
-                m = counts[i]
+            for j in g:
+                i = solve_idx[j]
+                m = sel_counts[j]
                 _, time_scale, hour = problems[i]
                 eff_hour = 12 if hour is None else int(hour) % 24
                 if live is not None:
@@ -1097,15 +1386,14 @@ class RoadRouter:
                     cost_model = self.leg_cost_model
                 out[i] = RoadLegs(
                     self, pts_list[i],
-                    all_nodes[offsets[i]:offsets[i + 1]],
-                    dist[pos:pos + m], pred[pos:pos + m],
-                    all_snap[offsets[i]:offsets[i + 1]],
+                    all_nodes[offsets[j]:offsets[j + 1]],
+                    _rows(dist, pos, pos + m), _rows(pred, pos, pos + m),
+                    all_snap[offsets[j]:offsets[j + 1]],
                     time_scale, time_arr,
                     cost_model, hour=eff_hour,
-                    meters_rows=(meters[pos:pos + m]
+                    meters_rows=(_rows(meters, pos, pos + m)
                                  if meters is not None else None))
                 pos += m
-        return out
 
 
 _SNAP_SPEED_MPS = 8.3  # first/last-mile charged at collector free-flow
@@ -1152,6 +1440,14 @@ class RoadLegs:
         self._dur_rows: Optional[np.ndarray] = None
         self._memo: Dict[Tuple[int, int], Tuple[float, float, list]] = {}
         self._cost_memo: Dict[Tuple[int, int], Tuple[float, float]] = {}
+
+    def nbytes(self) -> int:
+        """Resident bytes a cached entry pins (the route fastlane's
+        byte-budget input) — the (M, N) solve rows dominate."""
+        n = self._pred.nbytes + self._dist_rows.nbytes + self.dist_m.nbytes
+        if self._dur_rows is not None:
+            n += self._dur_rows.nbytes
+        return int(n)
 
     def _walk_cost(self, i: int, j: int):
         """Memoized shared core: (node_seq, distance_m, duration_s) for

@@ -150,6 +150,10 @@ def main() -> None:
     parser.add_argument("--cpu", action="store_true")
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
+    # This bench measures the SOLVER: repeated identical route_legs
+    # calls would otherwise hit the route fastlane and time the cache
+    # (bench_router_serving.py is where the cache is measured).
+    os.environ.setdefault("ROUTEST_ROUTE_CACHE", "0")
     if args.cpu or os.environ.get("ROUTEST_FORCE_CPU") == "1":
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -193,6 +197,13 @@ def main() -> None:
             "note": "wall times scale with host cores; the per-phase "
                     "breakdown is the portable signal",
         },
+        # Structural, not prose: bench.py's TPU probes have CPU-fallen-
+        # back for 3 straight battery rounds, so every artifact must
+        # carry a machine-readable caveat a dashboard can filter on
+        # (ROADMAP housekeeping).
+        "host_caveat": (None if jax.default_backend() == "tpu" else
+                        f"cpu-backend record on {n_cpus} core(s): compare "
+                        f"phase ratios and oracle parity, not wall ms"),
         "waypoints": args.waypoints,
         "rows": rows,
     }

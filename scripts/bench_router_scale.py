@@ -148,6 +148,9 @@ def main() -> None:
                              "one flat row, one overlay row with both "
                              "comparisons, no committed-extract row")
     args = parser.parse_args()
+    # Solver bench: keep the route fastlane out of the matrix timings
+    # (bench_router_serving.py measures the cache).
+    os.environ.setdefault("ROUTEST_ROUTE_CACHE", "0")
     if args.quick:
         args.sizes = [2048, 24_000]
         args.osm_nodes = 0
@@ -219,9 +222,16 @@ def main() -> None:
                   f"{row['overlay_speedup']}x", flush=True)
         if (args.ml_compare and row.get("solver") == "hierarchy"
                 and row.get("overlay", {}).get("n_levels", 1) > 1):
-            single = _with_env("ROUTEST_HIER_MAX_LEVELS", "1",
-                               lambda: RoadRouter(graph=graph, use_gnn=False,
-                                                  use_transformer=False))
+            # The baseline is the PR-8 regime: ONE level, no hub
+            # labels — with labels enabled a single-level overlay
+            # would get the top for free from the label fold, and the
+            # comparison would no longer measure what stacking buys.
+            single = _with_env(
+                "ROUTEST_HIER_MAX_LEVELS", "1",
+                lambda: _with_env(
+                    "ROUTEST_HIER_LABELS", "0",
+                    lambda: RoadRouter(graph=graph, use_gnn=False,
+                                       use_transformer=False)))
             _, _, single_warm = _time_solves(single, nodes)
             row["single_level_warm_ms"] = round(1000 * single_warm, 1)
             row["multi_level_speedup"] = round(

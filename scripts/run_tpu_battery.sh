@@ -54,6 +54,10 @@ run_step() {
 # record the driver compares, then the serving-selection table) before
 # the hour-scale router runs start.
 run_step bench timeout 600 python bench.py
+# Many-query routing curve: merged K-source dispatches vs scalar solves
+# at oracle parity (artifacts/batch_solve.json; the router-side batcher
+# serves exactly these shapes).
+run_step batch_solve timeout 1800 python scripts/bench_batch_solve.py
 # Per-path (xla / pallas / aot) Mpreds/s rows per serving bucket, the
 # refreshed selection table, and the regression gate: --gate fails the
 # battery if the fused kernel now LOSES at a bucket the previous record

@@ -143,8 +143,15 @@ def test_solver_info_shapes(force_hier, monkeypatch):
     monkeypatch.setenv("ROUTEST_HIER_MIN_NODES", "0")
     flat = RoadRouter(graph=generate_road_graph(n_nodes=300, seed=3),
                       use_gnn=False, use_transformer=False)
-    assert flat.solver_info == {"solver": "flat_bf",
-                                "max_iters_bound": flat.max_iters}
+    flat_info = flat.solver_info
+    assert flat_info["solver"] == "flat_bf"
+    assert flat_info["max_iters_bound"] == flat.max_iters
+    # The routing fast path's provenance rides along on every regime
+    # (docs/PERFORMANCE.md §7): batcher dispatch stats + route-cache
+    # counters, JSON-serializable for the health row.
+    assert flat_info["batch"]["dispatches"] == 0
+    assert flat_info["route_cache"]["entries"] == 0
+    json.dumps(flat_info)
 
 
 def test_overlay_serves_metro_extract_over_http(monkeypatch, tmp_path):

@@ -22,8 +22,10 @@ import pytest
 
 import routest_tpu.chaos
 import routest_tpu.live
+import routest_tpu.loadgen
 import routest_tpu.obs
 import routest_tpu.ops
+import routest_tpu.optimize
 import routest_tpu.serve
 import routest_tpu.serve.fleet
 
@@ -50,6 +52,17 @@ LIVE_ROOT = os.path.dirname(os.path.abspath(routest_tpu.live.__file__))
 # swallowed Mosaic failure would quietly serve the slow path while the
 # bench record claims the kernel wins.
 OPS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.ops.__file__))
+# The routing fast path (solve batcher, route fastlane, overlay) sits
+# on every request_route: a silently swallowed solve failure would
+# serve stale or missing routes with nothing in the logs — and the
+# route cache's singleflight MUST propagate leader errors, never eat
+# them.
+OPTIMIZE_ROOT = os.path.dirname(
+    os.path.abspath(routest_tpu.optimize.__file__))
+# The load generator is the measurement instrument: an error it
+# swallows silently becomes a phantom "pass" in a bench artifact.
+LOADGEN_ROOT = os.path.dirname(
+    os.path.abspath(routest_tpu.loadgen.__file__))
 
 BROAD = {"Exception", "BaseException"}
 
@@ -87,9 +100,10 @@ def _offenders(path):
 
 @pytest.mark.parametrize("root",
                          [SERVE_ROOT, OBS_ROOT, FLEET_ROOT, CHAOS_ROOT,
-                          LIVE_ROOT, OPS_ROOT],
+                          LIVE_ROOT, OPS_ROOT, OPTIMIZE_ROOT,
+                          LOADGEN_ROOT],
                          ids=["serve", "obs", "fleet", "chaos", "live",
-                              "ops"])
+                              "ops", "optimize", "loadgen"])
 def test_no_silent_broad_excepts(root):
     offenders = []
     for dirpath, dirnames, filenames in os.walk(root):

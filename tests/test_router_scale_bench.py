@@ -47,8 +47,11 @@ def test_router_scale_quick(tmp_path):
     assert hier["multi_level_speedup"] >= 1.2, hier
     # The per-phase breakdown localizes regressions: every stage of the
     # stack must be present and account for most of the warm solve.
+    # (The top phase is the hub-label fold when labels built, the
+    # iterative top BF otherwise — same answers either way.)
     phases = hier["query_phases_ms"]
-    assert "phase1" in phases and "top_bf" in phases
+    assert "phase1" in phases
+    assert "top_bf" in phases or "top_labels" in phases
     assert any(k.startswith("ascend_l") for k in phases)
     assert any(k.startswith("descend_l") for k in phases)
     # Per-level build stats recorded (cache-hygiene satellite).
