@@ -161,6 +161,19 @@ class FleetConfig:
     # consecutive probe failures restart the worker.
     probe_interval_s: float = 1.0
     unhealthy_after: int = 3
+    # Topology-aware placement (``serve/fleet/placement.py``): how the
+    # host's chips are carved into replica slices. ``placement`` is
+    # ``auto`` (compare layouts: measured curve beats the mesh-
+    # efficiency model), ``replica`` (all 1-chip), ``mesh`` (one slice
+    # owns every chip), ``NxK``, or an explicit ``4,2,1`` list.
+    # ``chips=0`` detects (env override → XLA_FLAGS virtual count →
+    # JAX); ``replicas`` above caps the slice count. ``placement_eff``
+    # is the modeled per-added-chip mesh efficiency; the measured
+    # per-chip curve at ``placement_record`` overrides the model.
+    placement: str = "auto"
+    chips: int = 0
+    placement_eff: float = 0.92
+    placement_record: str = "artifacts/fleet_chips.json"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -502,6 +515,12 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         backoff_cap_s=_float("RTPU_FLEET_BACKOFF_CAP_S", 30.0),
         probe_interval_s=_float("RTPU_FLEET_PROBE_S", 1.0),
         unhealthy_after=_int("RTPU_FLEET_UNHEALTHY_AFTER", 3),
+        placement=env.get("RTPU_FLEET_PLACEMENT") or "auto",
+        chips=_int("RTPU_FLEET_CHIPS", 0),
+        placement_eff=_env_num(env, "RTPU_FLEET_PLACEMENT_EFF",
+                               0.92, float),
+        placement_record=env.get("RTPU_FLEET_PLACEMENT_RECORD")
+        or "artifacts/fleet_chips.json",
     )
     return Config(mesh=mesh, model=model, train=train, serve=serve,
                   fleet=fleet, autoscale=load_autoscale_config(env),

@@ -826,6 +826,12 @@ def create_app(config: Optional[Config] = None,
         # self-check instead of probing ORS over the internet.
         engine_res = {"status": "ok" if state.eta is not None else "error",
                       "latency_ms": 0, "engine": "jax-tpu"}
+        # Device topology (fleet placement): how many chips THIS
+        # replica actually owns, mesh axis shapes when the batch is
+        # sharded, and the placement slice label — the rollout health
+        # gate and an operator's skew check read it here.
+        if state.eta is not None:
+            engine_res["mesh"] = state.eta.mesh_info()
         # Road-router gauge (only when a router has been built — probing
         # would otherwise build the 2k graph on a health check): which
         # leg pricers are live, over what graph.

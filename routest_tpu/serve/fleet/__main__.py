@@ -29,10 +29,25 @@ _log = get_logger("routest_tpu.fleet.boot")
 def main() -> None:
     config = load_config()
     fleet = config.fleet
-    n = max(1, fleet.replicas)
-    ports = [fleet.base_port + i for i in range(n)]
-
     env = dict(os.environ)
+
+    # Topology-aware placement: enumerate the host's chips and carve
+    # them into replica slices BEFORE anything spawns — each slice's
+    # env overlay pins its devices, and its capacity units feed the
+    # gateway's weighted router + the autoscaler's pressure signals.
+    # On a CPU backend "auto" degenerates to RTPU_FLEET_REPLICAS plain
+    # 1-chip replicas (virtual devices time-share one host), so a
+    # default boot is unchanged; RTPU_FLEET_PLACEMENT forces a layout.
+    from routest_tpu.serve.fleet.placement import plan_from_env
+
+    plan = plan_from_env(env, replicas=max(1, fleet.replicas))
+    n = len(plan.slices)
+    ports = [fleet.base_port + i for i in range(n)]
+    _log.info("placement_plan", platform=plan.platform,
+              chips=plan.total_chips, layout=plan.layout,
+              source=plan.source,
+              capacity_units=round(plan.capacity_units, 2),
+              slices=[s.label for s in plan.slices])
     broker = None
     # A broker is needed whenever events must cross process boundaries:
     # SSE across >1 replica, and — live traffic — the probe stream,
@@ -55,9 +70,10 @@ def main() -> None:
         unhealthy_after=fleet.unhealthy_after,
         backoff_base_s=fleet.backoff_base_s,
         backoff_cap_s=fleet.backoff_cap_s,
-        quiet=False, version=version)
+        quiet=False, version=version, placement=plan)
     supervisor.start()
-    _log.info("supervising", replicas=n, ports=ports)
+    _log.info("supervising", replicas=n, ports=ports,
+              layout=plan.layout)
     if not supervisor.ready(timeout=300):
         _log.error("replicas_never_ready", ports=ports)
         supervisor.drain(timeout=10)
@@ -65,6 +81,10 @@ def main() -> None:
 
     gateway = Gateway([("127.0.0.1", p) for p in ports], fleet,
                       supervisor=supervisor, version=version)
+    # Stamp each boot replica's slice on its upstream entry: weighted
+    # routing and the capacity gauge reflect the plan from request one.
+    for i, s in enumerate(plan.slices):
+        gateway.set_topology(f"r{i}", chips=s.chips, capacity=s.capacity)
     gateway.serve(fleet.gateway_host, fleet.gateway_port)
     _log.info("gateway_up",
               url=f"http://{fleet.gateway_host}:{fleet.gateway_port}",

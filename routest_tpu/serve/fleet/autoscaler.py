@@ -13,7 +13,9 @@ Control policy (knobs: ``AutoscaleConfig`` / ``RTPU_AUTOSCALE_*``):
 
 - **Scale up** when ANY pressure signal holds for ``up_stable_ticks``
   consecutive ticks: the admission queue is ≥ ``up_queue_frac``
-  occupied, mean outstanding per live replica ≥ ``up_outstanding``, or
+  occupied, outstanding per fleet CAPACITY UNIT ≥ ``up_outstanding``
+  (capacity-weighted: a 4-chip mesh replica counts as 4 units, so a
+  mesh-heavy fleet is not scaled as if every replica were 1-chip), or
   the worst fast-window SLO burn ≥ ``up_burn``. OR-semantics because
   each signal sees a different failure mode first (queue depth leads
   latency; burn leads availability).
@@ -62,6 +64,11 @@ class Signals:
     max_inflight: int
     outstanding: int            # summed across live upstreams
     burn_fast: float            # worst fast-window burn across objectives
+    # Total capacity units across live upstreams (1-chip-replica
+    # equivalents). 0.0 = unknown topology → fall back to the replica
+    # count, which is exactly the old per-replica semantics.
+    capacity: float = 0.0
+    pending_capacity: float = 0.0
 
     @property
     def queue_frac(self) -> float:
@@ -71,12 +78,23 @@ class Signals:
     def outstanding_per_replica(self) -> float:
         return self.outstanding / max(1, self.replicas)
 
+    @property
+    def outstanding_per_capacity(self) -> float:
+        """Outstanding normalized by FLEET CAPACITY UNITS, not replica
+        count — the pre-placement signal treated a 4-chip replica like
+        a 1-chip one, so a mesh-heavy fleet scaled up 4× too eagerly
+        (and a shrink decision compared against the wrong load)."""
+        return self.outstanding / max(1.0, self.capacity
+                                      or float(self.replicas))
+
 
 @dataclasses.dataclass
 class _Pending:
     index: int
     port: int
     spawned_at: float
+    chips: int = 1
+    capacity: float = 1.0
 
 
 class Autoscaler:
@@ -113,6 +131,7 @@ class Autoscaler:
         with gw._lock:
             live = [r for r in gw.replicas if not r.draining]
             outstanding = sum(r.outstanding for r in live)
+            capacity = sum(getattr(r, "capacity", 1.0) for r in live)
             n_live = len(live)
             queued = gw._waiters
             inflight = gw._inflight
@@ -124,11 +143,13 @@ class Autoscaler:
             burn = max(burns, default=0.0)
         with self._lock:
             pending = len(self._pending)
+            pending_cap = sum(p.capacity for p in self._pending)
         return Signals(
             replicas=n_live, pending=pending, queued=queued,
             queue_depth=gw.config.queue_depth, inflight=inflight,
             max_inflight=gw.config.max_inflight,
-            outstanding=outstanding, burn_fast=burn)
+            outstanding=outstanding, burn_fast=burn,
+            capacity=capacity, pending_capacity=pending_cap)
 
     # ── policy (pure-ish: counters live on self, inputs are Signals) ──
 
@@ -139,9 +160,10 @@ class Autoscaler:
         out = []
         if sig.queue_frac >= cfg.up_queue_frac:
             out.append(f"queue_frac={sig.queue_frac:.2f}")
-        if sig.outstanding_per_replica >= cfg.up_outstanding:
+        if sig.outstanding_per_capacity >= cfg.up_outstanding:
             out.append(
-                f"outstanding_per_replica={sig.outstanding_per_replica:.1f}")
+                f"outstanding_per_capacity="
+                f"{sig.outstanding_per_capacity:.1f}")
         if sig.burn_fast >= cfg.up_burn:
             out.append(f"burn_fast={sig.burn_fast:.1f}")
         return out
@@ -149,7 +171,7 @@ class Autoscaler:
     def quiet(self, sig: Signals) -> bool:
         cfg = self.config
         return (sig.queued == 0
-                and sig.outstanding_per_replica <= cfg.down_outstanding
+                and sig.outstanding_per_capacity <= cfg.down_outstanding
                 and sig.burn_fast < cfg.up_burn)
 
     def decide(self, sig: Signals,
@@ -191,11 +213,20 @@ class Autoscaler:
                     cfg.max_replicas - (sig.replicas + sig.pending))
         spawned = []
         for _ in range(max(0, n_new)):
+            # The supervisor spawns the placement plan's growth slice
+            # (device overlay + chips) — not a bare 1-chip default.
             index, port = self.supervisor.add_replica()
+            status = self.supervisor.replica_status(index) or {}
+            chips = int(status.get("chips") or 1)
+            capacity = float(status.get("capacity") or chips)
             with self._lock:
                 self._pending.append(_Pending(index, port,
-                                              time.monotonic()))
-            spawned.append({"index": index, "port": port})
+                                              time.monotonic(),
+                                              chips=chips,
+                                              capacity=capacity))
+            spawned.append({"index": index, "port": port, "chips": chips,
+                            "capacity": capacity,
+                            "placement": status.get("placement_label")})
         self._last_up = time.monotonic()
         self._up_ticks = 0
         self._m_decisions.labels(direction="up").inc()
@@ -239,10 +270,19 @@ class Autoscaler:
         for p in pending:
             if self.supervisor._probe(p.port):
                 status = self.supervisor.replica_status(p.index) or {}
+                # Capacity travels with the join: the gateway's
+                # weighted router and capacity gauge must see the new
+                # slice's units from its first pick.
                 rid = self.gateway.add_replica("127.0.0.1", p.port,
                                                rid=f"r{p.index}",
                                                version=status.get(
-                                                   "version"))
+                                                   "version"),
+                                               chips=int(
+                                                   status.get("chips")
+                                                   or p.chips),
+                                               capacity=float(
+                                                   status.get("capacity")
+                                                   or p.capacity))
                 with self._lock:
                     self._pending = [x for x in self._pending
                                      if x.index != p.index]
@@ -327,6 +367,7 @@ class Autoscaler:
         with self._lock:
             history = list(self._history)
             pending = [{"index": p.index, "port": p.port,
+                        "chips": p.chips, "capacity": p.capacity,
                         "waiting_s": round(time.monotonic()
                                            - p.spawned_at, 1)}
                        for p in self._pending]

@@ -10,8 +10,11 @@ every proxied request flows through three stages:
    ``FleetConfig.deadline_ms``) would pass sheds immediately. Shed =
    429 + ``Retry-After`` — overload degrades to fast rejections, never
    collapse (the Tail-at-Scale prescription).
-2. **Routing** — least-outstanding-requests across replicas whose
-   circuit breaker is closed. ``eject_after`` consecutive failures
+2. **Routing** — capacity-weighted least-outstanding across replicas
+   whose circuit breaker is closed: outstanding requests are
+   normalized by each replica's advertised capacity units (its
+   placement slice's chips / predicted throughput), so a 4-chip mesh
+   replica draws ~4× the concurrent work of a 1-chip peer. ``eject_after`` consecutive failures
    (connect errors or 5xx) open a replica's breaker for ``cooldown_s``;
    after cooldown exactly one half-open probe request decides between
    close and re-open. Idempotent requests that die on a connection
@@ -117,7 +120,8 @@ class _Upstream:
     circuit breaker, connection pool, counters."""
 
     def __init__(self, rid: str, host: str, port: int,
-                 version: Optional[str] = None) -> None:
+                 version: Optional[str] = None,
+                 chips: int = 1, capacity: Optional[float] = None) -> None:
         self.id = rid
         self.host = host
         self.port = port
@@ -125,6 +129,15 @@ class _Upstream:
         # version-labeled per-route request families so canary and
         # baseline are separately observable. None = "unversioned".
         self.version = version
+        # Topology: how many chips this replica's slice owns, and its
+        # capacity units (predicted throughput normalized to a 1-chip
+        # replica — the placement plan's number, or simply ``chips``).
+        # ``_pick`` normalizes outstanding by capacity so a 4-chip
+        # replica absorbs ~4× the work before it looks as loaded as a
+        # 1-chip peer.
+        self.chips = max(1, int(chips))
+        self.capacity = float(capacity) if capacity and capacity > 0 \
+            else float(self.chips)
         # Draining: scheduled for removal — excluded from routing while
         # outstanding requests finish (dynamic membership, see
         # Gateway.remove_replica).
@@ -239,6 +252,14 @@ class Gateway:
             "rtpu_fleet_replicas",
             "Replicas registered with the gateway (draining excluded).")
         self._m_replicas.set(len(self.replicas))
+        # Total capacity units across non-draining replicas: what the
+        # autoscaler's pressure signals normalize by — a fleet of one
+        # 4-chip replica reads 4.0, not 1.0.
+        self._m_capacity = reg.gauge(
+            "rtpu_fleet_capacity_units",
+            "Sum of replica capacity units (1-chip-replica equivalents) "
+            "registered with the gateway, draining excluded.")
+        self._m_capacity.set(sum(r.capacity for r in self.replicas))
         self._m_canary_fraction = reg.gauge(
             "rtpu_gateway_canary_fraction",
             "Traffic fraction routed to the canary cohort (0 = none).")
@@ -319,13 +340,19 @@ class Gateway:
 
     def add_replica(self, host: str, port: int,
                     rid: Optional[str] = None,
-                    version: Optional[str] = None) -> str:
+                    version: Optional[str] = None,
+                    chips: int = 1,
+                    capacity: Optional[float] = None) -> str:
         """Register one more upstream at runtime. The newcomer enters
         in the HALF_OPEN breaker state — the same path a recovered
         replica takes: ``_pick`` hands it exactly ONE probe request,
         and only a success admits it to normal rotation, so a worker
         that answered its startup probe but wedges on real traffic
-        never absorbs a burst. Returns the replica id."""
+        never absorbs a burst. ``chips``/``capacity`` advertise the
+        replica's slice (the placement plan's numbers, passed through
+        by the autoscaler/rollout joins) so weighted routing and the
+        capacity gauge see it from the first pick. Returns the
+        replica id."""
         with self._lock:
             if rid is None:
                 rid = f"r{self._next_rid}"
@@ -334,16 +361,42 @@ class Gateway:
                 self._next_rid = max(self._next_rid, int(rid[1:]) + 1)
             if any(r.id == rid for r in self.replicas):
                 raise ValueError(f"replica id {rid!r} already registered")
-            up = _Upstream(rid, host, port, version=version)
+            up = _Upstream(rid, host, port, version=version,
+                           chips=chips, capacity=capacity)
             up.state = HALF_OPEN
             up.opened_at = time.time()
             self.replicas.append(up)
             self._version_by_rid[rid] = version
             live = sum(1 for r in self.replicas if not r.draining)
+            cap = sum(r.capacity for r in self.replicas if not r.draining)
         self._m_replicas.set(live)
+        self._m_capacity.set(cap)
         _log.info("replica_registered", replica=rid, host=host, port=port,
-                  version=version, replicas=live)
+                  version=version, chips=chips, capacity=up.capacity,
+                  replicas=live)
         return rid
+
+    def set_topology(self, rid: str, chips: Optional[int] = None,
+                     capacity: Optional[float] = None) -> bool:
+        """Update one upstream's advertised slice after registration
+        (the fleet boot path: the Gateway is constructed from bare
+        (host, port) targets, then each replica's placement slice is
+        stamped here; a startup probe that measures real preds/s can
+        refine ``capacity`` the same way). Returns False for an
+        unknown id."""
+        with self._lock:
+            up = next((r for r in self.replicas if r.id == rid), None)
+            if up is None:
+                return False
+            if chips is not None:
+                up.chips = max(1, int(chips))
+            if capacity is not None and capacity > 0:
+                up.capacity = float(capacity)
+            elif chips is not None and capacity is None:
+                up.capacity = float(up.chips)
+            cap = sum(r.capacity for r in self.replicas if not r.draining)
+        self._m_capacity.set(cap)
+        return True
 
     # ── canary routing ────────────────────────────────────────────────
 
@@ -387,7 +440,9 @@ class Gateway:
                 return False
             up.draining = True
             live = sum(1 for r in self.replicas if not r.draining)
+            cap = sum(r.capacity for r in self.replicas if not r.draining)
         self._m_replicas.set(live)
+        self._m_capacity.set(cap)
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._lock:
@@ -407,6 +462,7 @@ class Gateway:
         now = time.time()
         with self._lock:
             candidates = []
+            probe_gated = []
             for r in self.replicas:
                 if r.id in exclude or r.draining:
                     continue
@@ -416,10 +472,20 @@ class Gateway:
                     else:
                         continue
                 if r.state == HALF_OPEN and r.probe_inflight:
+                    probe_gated.append(r)
                     continue
                 candidates.append(r)
             if not candidates:
-                return None
+                # Last resort: a half-open replica whose probe is still
+                # in flight is ALIVE, merely rationed to one request —
+                # when it is the only replica left (a 2-replica rolling
+                # restart drains the baseline moments after the
+                # successor joins), serving it concurrent traffic beats
+                # a 503. Breaker-OPEN replicas stay excluded: those are
+                # evidence-sick, not merely unproven.
+                if not probe_gated:
+                    return None
+                candidates = probe_gated
             # Canary split: when both cohorts can serve, the credit
             # counter sends exactly the configured fraction of picks to
             # the canary set (retries/hedges that excluded every member
@@ -440,14 +506,17 @@ class Gateway:
             # A half-open replica that is due its probe takes priority
             # for exactly ONE request (probe_inflight gates the rest) —
             # otherwise a recovered replica starves behind its closed
-            # peers and never re-joins. Everything else: least
-            # outstanding, round-robin tie-break.
+            # peers and never re-joins. Everything else: WEIGHTED least
+            # outstanding — outstanding normalized by capacity units,
+            # so a 4-chip replica absorbs ~4× the concurrent work of a
+            # 1-chip peer before looking equally loaded — round-robin
+            # tie-break.
             chosen = next((r for r in candidates if r.state == HALF_OPEN),
                           None)
             if chosen is None:
                 chosen = min(
                     candidates,
-                    key=lambda r: (r.outstanding,
+                    key=lambda r: (r.outstanding / r.capacity,
                                    (self.replicas.index(r) - self._rr)
                                    % len(self.replicas)))
             chosen.outstanding += 1
@@ -797,6 +866,8 @@ class Gateway:
                     "version": r.version,
                     "canary": r.id in self._canary_rids,
                     "draining": r.draining,
+                    "chips": r.chips,
+                    "capacity": r.capacity,
                     "outstanding": r.outstanding,
                     "requests": r.requests,
                     "errors": r.errors,
@@ -807,6 +878,9 @@ class Gateway:
             fleet = {
                 "uptime_s": round(time.time() - self.started, 1),
                 "replica_count": len(self.replicas),
+                "capacity_units": round(
+                    sum(r.capacity for r in self.replicas
+                        if not r.draining), 3),
                 "inflight": self._inflight,
                 "queued": self._waiters,
                 "max_inflight": self.config.max_inflight,
@@ -837,7 +911,9 @@ class Gateway:
         with self._lock:
             labels = {r.id: {"version": r.version,
                              "canary": r.id in self._canary_rids,
-                             "draining": r.draining}
+                             "draining": r.draining,
+                             "chips": r.chips,
+                             "capacity": r.capacity}
                       for r in self.replicas}
         out = {}
         for rid, entry in labels.items():
@@ -1164,7 +1240,8 @@ def _prometheus_fleet_text(snapshot: dict) -> str:
 
     fleet = snapshot["fleet"]
     lines = []
-    gauges = ("inflight", "queued", "replica_count", "uptime_s")
+    gauges = ("inflight", "queued", "replica_count", "capacity_units",
+              "uptime_s")
     counters = ("shed", "retries", "hedges", "hedge_wins", "restarts")
     for key in gauges:
         if key in fleet:
@@ -1175,7 +1252,7 @@ def _prometheus_fleet_text(snapshot: dict) -> str:
             lines.append(f"# TYPE routest_fleet_{key} counter")
             lines.append(f"routest_fleet_{key} {fleet[key]}")
     rep_counters = ("requests", "errors", "ejections")
-    rep_gauges = ("outstanding",)
+    rep_gauges = ("outstanding", "chips", "capacity")
     for key in rep_counters + rep_gauges:
         kind = "gauge" if key in rep_gauges else "counter"
         lines.append(f"# TYPE routest_fleet_replica_{key} {kind}")

@@ -133,9 +133,19 @@ def replace_replica(supervisor, gateway, rid: str, *,
                             "env": old.get("env")}}
     gateway.remove_replica(rid, timeout=drain_timeout_s)
     supervisor.remove_replica(index, timeout=drain_timeout_s)
-    new_index, new_port = supervisor.add_replica(env=env, version=version)
+    # The successor inherits the victim's PLACEMENT verbatim (device
+    # overlay, chips, capacity, slice label): a rolling restart or
+    # canary changes what a replica serves, never which devices it
+    # owns — otherwise every rollout would silently unpin the fleet.
+    new_index, new_port = supervisor.add_replica(
+        env=env, version=version,
+        placement=old.get("placement_env"),
+        chips=old.get("chips"), capacity=old.get("capacity"),
+        label=old.get("placement_label"))
     result.update({"index": new_index, "port": new_port,
-                   "version": version})
+                   "version": version,
+                   "chips": old.get("chips"),
+                   "placement": old.get("placement_label")})
     deadline = time.monotonic() + boot_timeout_s
     booted = False
     while time.monotonic() < deadline:
@@ -172,9 +182,11 @@ def replace_replica(supervisor, gateway, rid: str, *,
             result.update({"reason": "verify_failed", "model": detail})
             return result
         result["model"] = detail
-    new_rid = gateway.add_replica("127.0.0.1", new_port,
-                                  rid=f"r{new_index}", version=version)
     status = supervisor.replica_status(new_index) or {}
+    new_rid = gateway.add_replica("127.0.0.1", new_port,
+                                  rid=f"r{new_index}", version=version,
+                                  chips=int(status.get("chips") or 1),
+                                  capacity=status.get("capacity"))
     result.update({"ok": True, "new_rid": new_rid,
                    "restarts_at_join": status.get("restarts", 0)})
     return result
@@ -632,9 +644,11 @@ class RolloutController:
                             "index": index})
                 failed = True
                 break
-            rid = self.gateway.add_replica("127.0.0.1", port,
-                                           rid=f"r{index}",
-                                           version=base_version)
+            status = self.supervisor.replica_status(index) or {}
+            rid = self.gateway.add_replica(
+                "127.0.0.1", port, rid=f"r{index}", version=base_version,
+                chips=int(status.get("chips") or 1),
+                capacity=status.get("capacity"))
             self._note({"event": "rollback_respawn", "replica": rid,
                         "port": port})
         if failed:

@@ -51,11 +51,15 @@ class _Replica:
     __slots__ = ("index", "port", "proc", "restarts", "started_at",
                  "next_start_at", "consecutive_crashes", "health_failures",
                  "last_exit_code", "last_probe_at", "ever_up", "waiting",
-                 "retired", "env", "version")
+                 "retired", "env", "version", "placement_env", "chips",
+                 "capacity", "placement_label")
 
     def __init__(self, index: int, port: int,
                  env: Optional[Dict[str, str]] = None,
-                 version: Optional[str] = None) -> None:
+                 version: Optional[str] = None,
+                 placement: Optional[Dict[str, str]] = None,
+                 chips: int = 1, capacity: Optional[float] = None,
+                 label: Optional[str] = None) -> None:
         # Set under the supervisor lock when the replica is being
         # scaled away: the monitor must never restart a retired worker.
         self.retired = False
@@ -68,6 +72,16 @@ class _Replica:
         # reverts a replica to the fleet default).
         self.env = dict(env) if env else None
         self.version = version
+        # Topology: the placement overlay that pins this replica's
+        # devices (kept SEPARATE from the rollout overlay above so a
+        # rolling restart can change the version overlay while the
+        # device pinning survives verbatim), how many chips the slice
+        # owns, and its capacity units (predicted throughput in 1-chip
+        # units — what the gateway's weighted router normalizes by).
+        self.placement_env = dict(placement) if placement else None
+        self.chips = max(1, int(chips))
+        self.capacity = float(capacity) if capacity else float(self.chips)
+        self.placement_label = label
         self.proc: Optional[subprocess.Popen] = None
         self.restarts = 0
         self.started_at = 0.0
@@ -108,14 +122,31 @@ class ReplicaSupervisor:
                  backoff_cap_s: float = 30.0,
                  health_path: str = "/up",
                  quiet: bool = True,
-                 version: Optional[str] = None) -> None:
+                 version: Optional[str] = None,
+                 placement=None) -> None:
         # Fleet-default version label + env overlay for NEW replicas
         # (``set_default`` repoints them after a promoted rollout, so
         # autoscaler spawns come up on the promoted version).
         self._default_version = version
         self._default_overlay: Optional[Dict[str, str]] = None
-        self._replicas = [_Replica(i, p, version=version)
-                          for i, p in enumerate(ports)]
+        # Topology-aware placement (serve/fleet/placement.py): slice i
+        # pins replica i's devices via its env overlay; growth spawns
+        # (autoscaler) take the plan's growth slice instead of an
+        # unpinned 1-chip default. None = the device-blind legacy
+        # behavior (every replica sees whatever the base env shows).
+        self._plan = placement
+        slices = list(placement.slices) if placement is not None else []
+        self._replicas = []
+        for i, p in enumerate(ports):
+            s = slices[i] if i < len(slices) else (
+                placement.growth_slice(i) if placement is not None
+                else None)
+            self._replicas.append(_Replica(
+                i, p, version=version,
+                placement=dict(s.env) if s is not None else None,
+                chips=s.chips if s is not None else 1,
+                capacity=s.capacity if s is not None else None,
+                label=s.label if s is not None else None))
         self._next_index = len(self._replicas)   # monotonic, never reused
         self._command = command or default_worker_command
         self._env = dict(env if env is not None else os.environ)
@@ -151,6 +182,12 @@ class ReplicaSupervisor:
 
     def _spawn(self, r: _Replica) -> None:
         env = dict(self._env)
+        # Placement (device pinning) under the rollout overlay: a
+        # canary/rollout overlay may change anything EXCEPT which
+        # devices the replica owns — unless it explicitly names one of
+        # the placement keys, in which case the operator wins.
+        if r.placement_env:
+            env.update(r.placement_env)
         if r.env:
             env.update(r.env)
         env["PORT"] = str(r.port)
@@ -209,7 +246,11 @@ class ReplicaSupervisor:
 
     def add_replica(self, port: Optional[int] = None, *,
                     env: Optional[Dict[str, str]] = None,
-                    version: Optional[str] = None) -> Tuple[int, int]:
+                    version: Optional[str] = None,
+                    placement: Optional[Dict[str, str]] = None,
+                    chips: Optional[int] = None,
+                    capacity: Optional[float] = None,
+                    label: Optional[str] = None) -> Tuple[int, int]:
         """Spawn one more worker → ``(index, port)``. The index comes
         from the monotonic counter (never reused); the port defaults to
         a fresh OS-assigned one — deterministic ``base_port + i``
@@ -220,7 +261,11 @@ class ReplicaSupervisor:
         ``env`` overlays the base environment for THIS replica (and its
         monitor respawns); ``version`` labels it for rollout/version-
         skew tracking. Both default to the fleet defaults installed by
-        ``set_default`` (which a promoted rollout repoints)."""
+        ``set_default`` (which a promoted rollout repoints).
+        ``placement``/``chips``/``capacity``/``label`` pin the device
+        slice; when omitted and a placement plan is installed, the
+        plan's growth slice is used — autoscaler growth spawns the next
+        slice of the plan, never an unpinned 1-chip default."""
         if port is None:
             port = self._free_port()
         with self._lock:
@@ -228,7 +273,14 @@ class ReplicaSupervisor:
                 env = self._default_overlay
             if version is None:
                 version = self._default_version
-            r = _Replica(self._next_index, port, env=env, version=version)
+            if placement is None and chips is None \
+                    and self._plan is not None:
+                s = self._plan.growth_slice(self._next_index)
+                placement, chips = dict(s.env), s.chips
+                capacity, label = s.capacity, s.label
+            r = _Replica(self._next_index, port, env=env, version=version,
+                         placement=placement, chips=chips or 1,
+                         capacity=capacity, label=label)
             self._next_index += 1
             self._replicas.append(r)
             self._spawn(r)
@@ -262,6 +314,11 @@ class ReplicaSupervisor:
                 "last_exit_code": r.last_exit_code,
                 "version": r.version,
                 "env": dict(r.env) if r.env else None,
+                "placement_env": dict(r.placement_env)
+                if r.placement_env else None,
+                "chips": r.chips,
+                "capacity": r.capacity,
+                "placement_label": r.placement_label,
             }
 
     def wait_port_ready(self, port: int, timeout: float = 120.0) -> bool:
@@ -457,6 +514,9 @@ class ReplicaSupervisor:
                     "alive": alive,
                     "restarts": r.restarts,
                     "version": r.version,
+                    "chips": r.chips,
+                    "capacity": r.capacity,
+                    "placement": r.placement_label,
                     "uptime_s": round(time.time() - r.started_at, 1)
                     if alive else 0.0,
                 }

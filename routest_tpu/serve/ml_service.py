@@ -1349,6 +1349,28 @@ class EtaService:
             info["win_bucket"] = self._win_provenance
         return info
 
+    def mesh_info(self) -> dict:
+        """The replica's device topology at a glance (health's
+        ``checks.engine.mesh``): how many devices this process actually
+        owns (the placement overlay's pinning, verified — not what the
+        plan intended), the mesh axis shapes when batch sharding is on,
+        and the placement slice label the supervisor stamped."""
+        import jax
+
+        info: dict = {
+            "devices": len(jax.devices()),
+            "platform": jax.default_backend(),
+            "sharded": self._runtime is not None,
+        }
+        label = os.environ.get("RTPU_FLEET_PLACEMENT_LABEL")
+        if label:
+            info["placement"] = label
+        if self._runtime is not None:
+            info["axis_shapes"] = {
+                str(name): int(self._runtime.mesh.shape[name])
+                for name in self._runtime.mesh.axis_names}
+        return info
+
     def predict_batch(self, rows: np.ndarray) -> Optional[np.ndarray]:
         return self._predict_rows(self._serving, rows)
 

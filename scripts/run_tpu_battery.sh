@@ -63,6 +63,13 @@ run_step batch_solve timeout 1800 python scripts/bench_batch_solve.py
 # battery if the fused kernel now LOSES at a bucket the previous record
 # said it wins (serving would keep auto-selecting a slower path).
 run_step kernel_bench timeout 2400 python scripts/bench_serving_kernel.py --gate
+# Per-chip fleet scaling: the chips={1,2,4,8} preds/s curve, the
+# 8-chip placement comparison (8x1 vs 2x4 vs 1x8), weighted routing
+# shares, and the overlay-preserving rolling restart
+# (artifacts/fleet_chips.json; host_caveat is structural and clears
+# on a real TPU backend — this is the BASELINE >=10k preds/s/chip
+# claim measured PER CHIP for the first time).
+run_step fleet_chips timeout 2400 python scripts/bench_fleet_chips.py
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
 run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
   --osm-nodes 250000 --verify --flat-compare

@@ -1,0 +1,88 @@
+"""Slow: the per-chip fleet scaling bench end-to-end, with the
+ISSUE-12 acceptance invariants as DIRECTION guardbands (a 1-core CI
+host proves the algorithmic ordering via the structural
+``chips_effective`` normalization, not absolute wall times — the
+``test_router_scale_bench.py`` pattern):
+
+- the chips={1,2,4,8} curve is monotone non-decreasing in chips
+  (within a noise band: sharding over more virtual devices must never
+  COST throughput) and per-chip efficiency at 8 virtual chips ≥ 0.5;
+- every placement (8×1, 2×4, 1×8) serves at parity with the
+  single-replica scorer oracle, with zero client errors;
+- weighted routing spreads held work within ±10% of capacity shares;
+- the rolling restart preserves every replica's device overlay with
+  zero client errors.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_fleet_chips_quick(tmp_path):
+    out = tmp_path / "fleet_chips.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_fleet_chips.py"),
+         "--quick", "--out", str(out)],
+        cwd=REPO, timeout=2400, capture_output=True, text=True,
+        env={**os.environ, "ROUTEST_FORCE_CPU": "1"})
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    record = json.loads(out.read_text())
+    assert record["host_caveat"] is not None or \
+        record["host"]["backend"] == "tpu"   # structural caveat present
+
+    curve = record["curve"]
+    assert [r["chips"] for r in curve] == [1, 2, 4, 8]
+    # Placement pinning actually happened: each replica REPORTS the
+    # chip count it was pinned to, and multi-chip rows serve sharded.
+    for r in curve:
+        assert r["mesh"]["devices"] == r["chips"], r
+        assert r["sharded"] == (r["chips"] > 1), r
+        assert r["client_errors"] == 0, r
+    # Monotone non-decreasing in chips on the PROJECTED curve
+    # (preds_per_s × chips/chips_effective — identical to raw preds/s
+    # on real hardware, where this is the scaling claim proper), plus
+    # a collapse guard on the raw curve: sharding over more virtual
+    # chips may cost time-sharing overhead but must never halve
+    # throughput.
+    for prev, nxt in zip(curve, curve[1:]):
+        assert nxt["preds_per_s_projected"] >= \
+            prev["preds_per_s_projected"], (prev, nxt)
+        assert nxt["preds_per_s"] >= 0.5 * prev["preds_per_s"], \
+            (prev, nxt)
+    # Per-chip efficiency ≥ 0.5 at 8 virtual chips (chips_effective
+    # normalization: on a 1-core host this bounds the sharding
+    # overhead at ≤2×; on an 8-chip TPU host it is the true per-chip
+    # efficiency floor).
+    eight = curve[-1]
+    assert eight["efficiency"] >= 0.5, eight
+    # Oracle parity along the curve (same fixed batch, same scores).
+    for r in curve[1:]:
+        assert r["oracle_max_abs_diff"] <= 1e-2, r
+
+    # Placement comparison: same 8 chips three ways, all at parity
+    # with the single-replica scorer oracle, zero client errors.
+    layouts = {p["layout"] for p in record["placements"]}
+    assert layouts == {"8x1", "2x4", "1x8"}, layouts
+    for p in record["placements"]:
+        assert p["chips_total"] == 8, p
+        assert p["client_errors"] == 0, p
+        assert p["oracle_max_abs_diff"] <= 1e-2, p
+
+    # Weighted routing: held work tracks capacity within ±10%.
+    assert record["weighted_routing"]["within_10pct_of_capacity"], \
+        record["weighted_routing"]
+
+    # Rolling restart under load: zero client errors, overlays
+    # preserved (device pinning survives the rollout machinery).
+    rr = record["rolling_restart"]
+    assert rr["restart_ok"], rr
+    assert rr["client_errors"] == 0, rr
+    assert rr["overlay_preserved"], rr

@@ -117,3 +117,11 @@ def test_no_silent_broad_excepts(root):
     assert not offenders, (
         "silent broad except (log a JsonLogger event, count a metric, "
         "or narrow the type): " + ", ".join(offenders))
+
+
+def test_sweep_sees_the_placement_planner():
+    # ISSUE-12: the placement planner decides which devices every
+    # replica owns — a swallowed failure there strands chips silently.
+    # It lives under serve/fleet, which the "fleet" sweep walks; this
+    # pin fails if the module moves out of the swept tree.
+    assert os.path.exists(os.path.join(FLEET_ROOT, "placement.py"))
