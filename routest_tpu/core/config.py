@@ -276,6 +276,87 @@ class ObsConfig:
     trace_export_path: Optional[str] = None
     device_trace_dir: Optional[str] = None
     device_trace_max: int = 1
+    # Tail-based retention (``RTPU_TAIL_SAMPLE_*``): buffer every
+    # request's spans briefly and decide KEEP at root completion —
+    # slow (over the route's SLO latency threshold, or ``tail_slow_ms``
+    # when set), errored, or reservoir-sampled. Off by default: head
+    # sampling (above) stays the measured-baseline posture.
+    tail: bool = False
+    # 0 = derive per-route thresholds from the SLO objective spec
+    # (``RTPU_SLO_OBJECTIVES`` / built-in defaults); > 0 = one flat
+    # slow threshold for every route.
+    tail_slow_ms: float = 0.0
+    # Probability a normal (fast, ok) trace is kept anyway — the
+    # baseline sample that keeps /api/trace representative, not only
+    # pathological.
+    tail_reservoir: float = 0.02
+    tail_max_pending: int = 256
+    tail_ttl_s: float = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TimelineConfig:
+    """In-process metric timeline (``routest_tpu/obs/timeline.py``):
+    the registry ticked into bounded multi-resolution rings — counters
+    as per-window deltas, gauges as last value, histograms as
+    per-window bucket deltas (→ windowed percentile estimates) — behind
+    ``GET /api/timeline`` on both tiers, with the gateway additionally
+    scraping each replica's timeline into per-replica / per-version /
+    fleet-rollup views. All knobs are ``RTPU_TIMELINE_*`` env vars.
+
+    ``resolutions`` is a ``"<step_s>x<slots>,…"`` spec, finest first —
+    the default keeps 1 h at 10 s and 6 h at 60 s. The anomaly
+    ``watch``er compares each fresh finest-resolution window against
+    the trailing baseline (latency shift, error-rate step, throughput
+    collapse, cache-hit-rate collapse) and fires a flight-recorder
+    bundle — which embeds the timeline slice, so a postmortem answers
+    *when did it start*."""
+
+    enabled: bool = True
+    resolutions: Tuple[Tuple[float, int], ...] = ((10.0, 360), (60.0, 360))
+    watch: bool = True
+    # The watcher needs this many trailing finest frames of baseline
+    # before it judges anything (a cold process must not page on its
+    # first window), and re-fires per (kind, family) at most every
+    # ``watch_cooldown_s``.
+    watch_baseline_frames: int = 3
+    watch_cooldown_s: float = 120.0
+    # Latency shift: newest-window p95 ≥ factor × baseline p95 AND the
+    # shift exceeds the floor (a 2 ms → 5 ms move is not an incident).
+    watch_latency_factor: float = 2.0
+    watch_latency_floor_ms: float = 50.0
+    # Error-rate step: newest-window error fraction ≥ baseline + step.
+    watch_error_step: float = 0.05
+    # Throughput collapse: newest rate ≤ frac × baseline rate while the
+    # baseline was actually serving (≥ min_rate events/s).
+    watch_throughput_frac: float = 0.3
+    watch_min_rate: float = 1.0
+    # Minimum events in the newest window before any verdict (tiny
+    # windows are all noise).
+    watch_min_count: int = 5
+    # The slice every postmortem bundle embeds (finest resolution).
+    bundle_window_s: float = 900.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileConfig:
+    """Triggered on-path profiling (``routest_tpu/obs/profiler.py``):
+    a bounded Python stack-sample capture (plus an optional
+    ``jax.profiler`` device trace) armed by the SLO warn/page edge or
+    ``POST /api/debug/profile``, written as a flight-recorder bundle.
+    All knobs are ``RTPU_PROFILE_*`` env vars. The per-process budget
+    (``max_captures``) and ``min_interval_s`` spacing bound the cost:
+    profiling is evidence collection, never a steady-state tax."""
+
+    enabled: bool = True
+    duration_s: float = 2.0
+    interval_ms: float = 10.0
+    max_captures: int = 4
+    min_interval_s: float = 60.0
+    # Also capture a jax.profiler device trace for the window (written
+    # under the recorder dir; xplane captures are heavyweight, so this
+    # is opt-in even when armed).
+    device_trace: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -399,6 +480,10 @@ class Config:
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     recorder: RecorderConfig = dataclasses.field(
         default_factory=RecorderConfig)
+    timeline: TimelineConfig = dataclasses.field(
+        default_factory=TimelineConfig)
+    profile: ProfileConfig = dataclasses.field(
+        default_factory=ProfileConfig)
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -490,14 +575,7 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         ors_api_key=_env(env, "ORS_API_KEY", "OPENROUTESERVICE_API_KEY"),
         version=_env(env, "RENDER_GIT_COMMIT", "GIT_COMMIT_SHA"),
     )
-    obs = ObsConfig(
-        enabled=env.get("RTPU_OBS_TRACE", "1") != "0",
-        sample_rate=_float("RTPU_OBS_SAMPLE", 1.0),
-        buffer_spans=_int("RTPU_OBS_BUFFER", 2048),
-        trace_export_path=env.get("RTPU_OBS_EXPORT_PATH"),
-        device_trace_dir=env.get("RTPU_OBS_DEVICE_TRACE_DIR"),
-        device_trace_max=_int("RTPU_OBS_DEVICE_TRACE_MAX", 1),
-    )
+    obs = load_obs_config(env)
     fleet = FleetConfig(
         replicas=_int("RTPU_FLEET_REPLICAS", 2),
         gateway_host=env.get("RTPU_GATEWAY_HOST", "127.0.0.1"),
@@ -528,7 +606,9 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
                   obs=obs, live=load_live_config(env),
                   chaos=load_chaos_config(env),
                   slo=load_slo_config(env),
-                  recorder=load_recorder_config(env))
+                  recorder=load_recorder_config(env),
+                  timeline=load_timeline_config(env),
+                  profile=load_profile_config(env))
 
 
 def load_live_config(env: Optional[Mapping[str, str]] = None) -> LiveConfig:
@@ -705,4 +785,80 @@ def load_obs_config(env: Optional[Mapping[str, str]] = None) -> ObsConfig:
         trace_export_path=env.get("RTPU_OBS_EXPORT_PATH"),
         device_trace_dir=env.get("RTPU_OBS_DEVICE_TRACE_DIR"),
         device_trace_max=_num("RTPU_OBS_DEVICE_TRACE_MAX", 1, int),
+        tail=env.get("RTPU_TAIL_SAMPLE", "0") == "1",
+        tail_slow_ms=_num("RTPU_TAIL_SAMPLE_SLOW_MS", 0.0, float),
+        tail_reservoir=_num("RTPU_TAIL_SAMPLE_RESERVOIR", 0.02, float),
+        tail_max_pending=_num("RTPU_TAIL_SAMPLE_MAX_PENDING", 256, int),
+        tail_ttl_s=_num("RTPU_TAIL_SAMPLE_TTL_S", 60.0, float),
+    )
+
+
+def _parse_resolutions(raw: Optional[str]) -> Tuple[Tuple[float, int], ...]:
+    """``"10x360,60x360"`` → ((10.0, 360), (60.0, 360)), finest first.
+    Malformed specs keep the default (ops knob: a typo must not abort
+    boot)."""
+    default = TimelineConfig.resolutions
+    if not raw:
+        return default
+    out = []
+    try:
+        for tok in raw.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            step, _, slots = tok.partition("x")
+            step_s, n = float(step), int(slots)
+            if step_s <= 0 or n <= 0:
+                return default
+            out.append((step_s, n))
+    except ValueError:
+        return default
+    if not out:
+        return default
+    return tuple(sorted(out))
+
+
+def load_timeline_config(
+        env: Optional[Mapping[str, str]] = None) -> TimelineConfig:
+    """Just the timeline knobs (read by ``routest_tpu/obs/timeline.py``
+    and serving bring-up without paying for a full Config build)."""
+    env = dict(env if env is not None else os.environ)
+    return TimelineConfig(
+        enabled=env.get("RTPU_TIMELINE", "1") != "0",
+        resolutions=_parse_resolutions(env.get("RTPU_TIMELINE_RES")),
+        watch=env.get("RTPU_TIMELINE_WATCH", "1") != "0",
+        watch_baseline_frames=_env_num(
+            env, "RTPU_TIMELINE_WATCH_BASELINE", 3, int),
+        watch_cooldown_s=_env_num(
+            env, "RTPU_TIMELINE_WATCH_COOLDOWN_S", 120.0, float),
+        watch_latency_factor=_env_num(
+            env, "RTPU_TIMELINE_WATCH_LATENCY_FACTOR", 2.0, float),
+        watch_latency_floor_ms=_env_num(
+            env, "RTPU_TIMELINE_WATCH_LATENCY_FLOOR_MS", 50.0, float),
+        watch_error_step=_env_num(
+            env, "RTPU_TIMELINE_WATCH_ERROR_STEP", 0.05, float),
+        watch_throughput_frac=_env_num(
+            env, "RTPU_TIMELINE_WATCH_THROUGHPUT_FRAC", 0.3, float),
+        watch_min_rate=_env_num(
+            env, "RTPU_TIMELINE_WATCH_MIN_RATE", 1.0, float),
+        watch_min_count=_env_num(
+            env, "RTPU_TIMELINE_WATCH_MIN_COUNT", 5, int),
+        bundle_window_s=_env_num(
+            env, "RTPU_TIMELINE_BUNDLE_WINDOW_S", 900.0, float),
+    )
+
+
+def load_profile_config(
+        env: Optional[Mapping[str, str]] = None) -> ProfileConfig:
+    """Just the triggered-profiling knobs (read by
+    ``routest_tpu/obs/profiler.py`` and serving bring-up)."""
+    env = dict(env if env is not None else os.environ)
+    return ProfileConfig(
+        enabled=env.get("RTPU_PROFILE", "1") != "0",
+        duration_s=_env_num(env, "RTPU_PROFILE_DURATION_S", 2.0, float),
+        interval_ms=_env_num(env, "RTPU_PROFILE_INTERVAL_MS", 10.0, float),
+        max_captures=_env_num(env, "RTPU_PROFILE_MAX", 4, int),
+        min_interval_s=_env_num(env, "RTPU_PROFILE_MIN_INTERVAL_S",
+                                60.0, float),
+        device_trace=env.get("RTPU_PROFILE_DEVICE", "0") == "1",
     )

@@ -1,26 +1,35 @@
 """Observability spine: request tracing + one process-wide metrics registry.
 
-Five pieces (ISSUE 2 + ISSUE 5; Dapper §2, W3C Trace Context, SRE
-workbook ch. 5):
+Seven pieces (ISSUEs 2, 5, 13; Dapper §2, W3C Trace Context, SRE
+workbook ch. 5, the Monarch in-process-TSDB lineage):
 
 - ``trace``   — a sampling :class:`Tracer` producing :class:`Span`s with
   contextvar-carried parentage and ``traceparent`` inject/extract, so one
   trace id survives client → gateway → replica → batcher → device;
+  tail-based retention (``RTPU_TAIL_SAMPLE=1``) moves the keep decision
+  to trace completion so the buffer reliably holds the slowest requests;
 - ``registry`` — process-wide counters/gauges/histograms (fixed log-scale
   buckets, per-bucket trace exemplars) behind one API, exported as JSON
-  and Prometheus text;
-- ``export``  — bounded in-memory span buffer with JSONL and Chrome
-  ``trace_event`` dumps, plus the optional per-span device-trace hook;
+  and Prometheus/OpenMetrics text;
+- ``export``  — bounded in-memory span buffer + the tail sampler, JSONL
+  and Chrome ``trace_event`` dumps, the per-span device-trace hook;
+- ``timeline`` — the registry ticked into bounded multi-resolution rings
+  (windowed deltas + percentile estimates) behind ``/api/timeline``,
+  fleet-scraped at the gateway, watched for anomalies;
 - ``slo``     — per-route objectives evaluated over rolling multi-window
   burn rates (``ok → warn → page``), rolled up from the registry;
 - ``recorder`` — the always-on flight recorder: bounded request/log
-  rings that dump self-contained postmortem bundles on trigger.
+  rings that dump self-contained postmortem bundles (now embedding the
+  timeline slice) on trigger;
+- ``profiler`` — triggered on-path stack-sample captures, armed by the
+  SLO warn/page edge or ``POST /api/debug/profile``.
 
-``slo`` and ``recorder`` import lazily (``from routest_tpu.obs.slo
-import …``) — they pull ``core.config``, which the spine itself must
-not. Everything here is stdlib-only (the fleet gateway imports it) and
-safe to call on hot paths: an unsampled span is one small object and
-two contextvar operations; a disabled tracer is a shared no-op.
+``slo``, ``timeline``, ``profiler``, and ``recorder`` import lazily
+(``from routest_tpu.obs.slo import …``) — they pull ``core.config``,
+which the spine itself must not. Everything here is stdlib-only (the
+fleet gateway imports it) and safe to call on hot paths: an unsampled
+span is one small object and two contextvar operations; a disabled
+tracer is a shared no-op.
 """
 
 from routest_tpu.obs.export import (SpanBuffer, to_chrome_trace,  # noqa: F401

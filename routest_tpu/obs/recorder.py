@@ -92,6 +92,11 @@ class FlightRecorder:
         # SLO engines whose state belongs in the manifest (wired by the
         # serving layer; the recorder never constructs one).
         self.slo_engines: List = []
+        # Timeline stores whose recent history belongs in every bundle
+        # (``timeline.json``) — the "when did it start" evidence a
+        # registry snapshot cannot carry. Same wiring contract as the
+        # SLO engines: one slot per component, serving layer registers.
+        self.timelines: List = []
         self.bundles_written = 0
         self.triggers_suppressed = 0
         reg = get_registry()
@@ -203,14 +208,31 @@ class FlightRecorder:
                 if getattr(e, "component", None) != engine.component]
             self.slo_engines.append(engine)
 
+    def register_timeline(self, store) -> None:
+        """Embed ``store``'s recent history (finest resolution, the
+        store's ``bundle_window_s``) as ``timeline.json`` in every
+        bundle — a postmortem can then show WHEN the latency/error
+        curves moved, not just where they ended up. One slot per
+        component, same replacement rule as the SLO engines."""
+        with self._lock:
+            self.timelines = [
+                t for t in self.timelines
+                if getattr(t, "component", None) != store.component]
+            self.timelines.append(store)
+
     # ── triggers + bundles ────────────────────────────────────────────
 
     def trigger(self, reason: str, detail: Optional[dict] = None,
-                force: bool = False) -> Optional[str]:
+                force: bool = False,
+                extra_files: Optional[Dict[str, str]] = None
+                ) -> Optional[str]:
         """Write a postmortem bundle; returns its path, or None when
         disabled or rate-limited. ``force`` (manual triggers: SIGUSR2,
         ``/api/debug/snapshot``) bypasses the rate limit — the disk
-        bounds still hold."""
+        bounds still hold. ``extra_files`` (name → text content) land
+        in the bundle directory alongside the standard rings — the
+        triggered profiler ships its stack captures this way, so
+        profiles inherit the same disk bounds and pruning."""
         if not self.config.enabled:
             return None
         with self._lock:
@@ -224,7 +246,7 @@ class FlightRecorder:
                 return None
             self._last_bundle_mono = now
         try:
-            path = self._write_bundle(reason, detail or {})
+            path = self._write_bundle(reason, detail or {}, extra_files)
         except Exception as e:
             # LOUD failure: a recorder that cannot write its bundle is
             # an incident inside the incident — never swallow it.
@@ -270,7 +292,8 @@ class FlightRecorder:
             shutil.rmtree(os.path.join(root, victim), ignore_errors=True)
             _log.info("postmortem_pruned", bundle=victim)
 
-    def _write_bundle(self, reason: str, detail: dict) -> str:
+    def _write_bundle(self, reason: str, detail: dict,
+                      extra_files: Optional[Dict[str, str]] = None) -> str:
         from routest_tpu.obs.trace import get_tracer
 
         root = self._bundle_root()
@@ -287,7 +310,17 @@ class FlightRecorder:
             requests = list(self._requests)
             logs = list(self._logs)
             events = list(self._events)
+            timelines = list(self.timelines)
         spans = get_tracer().buffer.snapshot()
+        # Timeline slices: each registered store's recent finest-
+        # resolution history — the bundle's "when did it start" axis.
+        timeline_doc = None
+        if timelines:
+            timeline_doc = {}
+            for store in timelines:
+                window = getattr(store.config, "bundle_window_s", 900.0)
+                timeline_doc[store.component] = store.query(
+                    window_s=window, partial=True)
         manifest = {
             "reason": reason,
             "detail": detail,
@@ -295,13 +328,19 @@ class FlightRecorder:
             "pid": os.getpid(),
             "config": _config_fingerprint(),
             "counts": {"requests": len(requests), "spans": len(spans),
-                       "logs": len(logs), "events": len(events)},
+                       "logs": len(logs), "events": len(events),
+                       "timeline_frames": sum(
+                           len(t["frames"])
+                           for t in (timeline_doc or {}).values())},
             "registry": get_registry().snapshot(),
             "slo": [engine.snapshot() for engine in self.slo_engines],
             "chaos": _chaos_snapshot(),
         }
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=2, default=str)
+        if timeline_doc is not None:
+            with open(os.path.join(path, "timeline.json"), "w") as f:
+                json.dump(timeline_doc, f, default=str)
         for name, rows in (("requests.jsonl", requests),
                            ("spans.jsonl", spans),
                            ("logs.jsonl", logs),
@@ -309,6 +348,10 @@ class FlightRecorder:
             with open(os.path.join(path, name), "w") as f:
                 for row in rows:
                     f.write(json.dumps(row, default=str) + "\n")
+        for name, content in (extra_files or {}).items():
+            safe = os.path.basename(name)
+            with open(os.path.join(path, safe), "w") as f:
+                f.write(content)
         return path
 
     # ── introspection ─────────────────────────────────────────────────

@@ -271,6 +271,30 @@ class MetricsRegistry:
 
     # ── export ────────────────────────────────────────────────────────
 
+    def cumulative_sample(self) -> dict:
+        """Raw cumulative state for delta-based consumers (the timeline
+        store): ``name → {kind, labelnames, buckets, series}`` where
+        ``series`` maps the label-value tuple to the counter/gauge
+        value or, for histograms, ``(bucket counts tuple, sum, count)``.
+        Rawer and cheaper than :meth:`snapshot` — no quantile math, no
+        exemplar copies — because it runs on every timeline tick."""
+        out = {}
+        with self._lock:
+            metrics = list(self._metrics.items())
+        for name, m in metrics:
+            series = {}
+            for key, child in m.items():
+                if m.kind == "histogram":
+                    assert isinstance(child, Histogram)
+                    with child._lock:
+                        series[key] = (tuple(child.counts), child.sum,
+                                       child.count)
+                else:
+                    series[key] = child.value
+            out[name] = {"kind": m.kind, "labelnames": m.labelnames,
+                         "buckets": m.buckets, "series": series}
+        return out
+
     def snapshot(self) -> dict:
         """JSON-shaped dump: name → {type, help, series:[{labels, …}]}.
         Histogram series carry count/sum plus interpolated p50/p95/p99
@@ -300,8 +324,15 @@ class MetricsRegistry:
         return out
 
     def prometheus_text(self) -> str:
-        """Exposition format 0.0.4: HELP/TYPE per family; histograms as
-        cumulative ``_bucket{le=…}`` + ``_sum`` + ``_count``."""
+        """Exposition format 0.0.4 + OpenMetrics exemplar annotations:
+        HELP/TYPE per family; histograms as cumulative
+        ``_bucket{le=…}`` + ``_sum`` + ``_count``, each bucket carrying
+        its most recent sampled exemplar as the OpenMetrics
+        ``# {trace_id="…"} value timestamp`` suffix — the link from a
+        p99 bucket to a dumpable trace survives the text exposition,
+        not only the JSON snapshot (exemplar-aware scrapers parse it;
+        classic parsers that reject exemplars should scrape the JSON
+        surface instead — docs/OBSERVABILITY.md "Exemplars")."""
         lines: List[str] = []
         with self._lock:
             metrics = sorted(self._metrics.items())
@@ -313,14 +344,25 @@ class MetricsRegistry:
                 base = _fmt_labels(m.labelnames, key)
                 if m.kind == "histogram":
                     assert isinstance(child, Histogram)
-                    for bound, cum in child.cumulative():
+                    bounds = list(child.buckets) + [math.inf]
+                    with child._lock:
+                        counts = list(child.counts)
+                        exemplars = list(child.exemplars)
+                        hsum, hcount = child.sum, child.count
+                    running = 0
+                    for bound, c, ex in zip(bounds, counts, exemplars):
+                        running += c
                         le = "+Inf" if math.isinf(bound) else repr(bound)
-                        lines.append(
+                        line = (
                             f"{name}_bucket"
                             f"{_fmt_labels(m.labelnames, key, (('le', le),))}"
-                            f" {cum}")
-                    lines.append(f"{name}_sum{base} {child.sum}")
-                    lines.append(f"{name}_count{base} {child.count}")
+                            f" {running}")
+                        if ex is not None:
+                            line += (f' # {{trace_id="{ex[0]}"}} '
+                                     f"{ex[1]:g} {ex[2] / 1000.0:.3f}")
+                        lines.append(line)
+                    lines.append(f"{name}_sum{base} {hsum}")
+                    lines.append(f"{name}_count{base} {hcount}")
                 else:
                     lines.append(f"{name}{base} {child.value}")
         return "\n".join(lines) + "\n"

@@ -277,6 +277,11 @@ class SloEngine:
         self._lock = threading.Lock()
         self._stop: Optional[threading.Event] = None
         self.on_page: List[Callable[[str, dict], None]] = []
+        # Fired on any UPWARD transition (ok→warn, warn→page, ok→page):
+        # the earliest evidence edge — the triggered profiler arms here
+        # so the capture brackets the incident's onset, not its
+        # aftermath. Callbacks get (slo_name, detail) like on_page.
+        self.on_warn: List[Callable[[str, dict], None]] = []
         reg = metrics_registry if metrics_registry is not None \
             else get_registry()
         labels = ("component", "slo")
@@ -341,6 +346,7 @@ class SloEngine:
         now = time.monotonic() if now is None else now
         cfg = self.config
         paged: List[Tuple[str, dict]] = []
+        warned: List[Tuple[str, dict]] = []
         with self._lock:
             tracks = list(self._tracks.values())
         for track in tracks:
@@ -355,7 +361,18 @@ class SloEngine:
                              cfg.slow_window_s + 2 * cfg.tick_s)
                 edge = self._evaluate_locked(track)
             if edge is not None:
-                paged.append(edge)
+                upward, level, name, detail = edge
+                if upward:
+                    warned.append((name, detail))
+                if level == PAGE:
+                    paged.append((name, detail))
+        for name, detail in warned:
+            for cb in list(self.on_warn):
+                try:
+                    cb(name, detail)
+                except Exception as e:
+                    _log.error("slo_warn_callback_failed", slo=name,
+                               error=f"{type(e).__name__}: {e}")
         for name, detail in paged:
             for cb in list(self.on_page):
                 try:
@@ -364,7 +381,9 @@ class SloEngine:
                     _log.error("slo_page_callback_failed", slo=name,
                                error=f"{type(e).__name__}: {e}")
 
-    def _evaluate_locked(self, track: _Track) -> Optional[Tuple[str, dict]]:
+    def _evaluate_locked(self, track: _Track
+                         ) -> Optional[Tuple[bool, str, str, dict]]:
+        """→ None (no transition) or ``(upward, level, name, detail)``."""
         cfg = self.config
         budget = 1.0 - track.objective.target
         rate_fast = track.rate_over(cfg.fast_window_s)
@@ -402,12 +421,10 @@ class SloEngine:
             "target": track.objective.target, "kind": track.objective.kind,
             **track.objective.detail,
         }
-        log = _log.warning if _LEVELS[level] > _LEVELS[previous] \
-            else _log.info
+        upward = _LEVELS[level] > _LEVELS[previous]
+        log = _log.warning if upward else _log.info
         log("slo_transition", slo=name, **detail)
-        if level == PAGE:
-            return name, detail
-        return None
+        return upward, level, name, detail
 
     # ── lifecycle + export ────────────────────────────────────────────
 

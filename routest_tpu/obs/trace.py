@@ -58,14 +58,19 @@ def _new_span_id() -> str:
 
 class SpanContext:
     """The propagatable identity of a span: enough to parent children
-    and to serialize as ``traceparent``, nothing more."""
+    and to serialize as ``traceparent``, nothing more. ``remote`` marks
+    a context that arrived over the wire (``parse_traceparent``) — the
+    span parented under it is this PROCESS's root, which is where
+    tail-based retention makes its per-process verdict."""
 
-    __slots__ = ("trace_id", "span_id", "sampled")
+    __slots__ = ("trace_id", "span_id", "sampled", "remote")
 
-    def __init__(self, trace_id: str, span_id: str, sampled: bool) -> None:
+    def __init__(self, trace_id: str, span_id: str, sampled: bool,
+                 remote: bool = False) -> None:
         self.trace_id = trace_id
         self.span_id = span_id
         self.sampled = sampled
+        self.remote = remote
 
 
 class Span:
@@ -73,14 +78,19 @@ class Span:
     mutating helpers are no-ops after finish."""
 
     __slots__ = ("name", "ctx", "parent_id", "attrs", "status",
-                 "start_unix", "_t0", "duration_ms", "thread")
+                 "start_unix", "_t0", "duration_ms", "thread",
+                 "remote_parent")
 
     def __init__(self, name: str, ctx: SpanContext,
-                 parent_id: Optional[str], attrs: Dict) -> None:
+                 parent_id: Optional[str], attrs: Dict,
+                 remote_parent: bool = False) -> None:
         self.name = name
         self.ctx = ctx
         self.parent_id = parent_id
         self.attrs = attrs
+        # Parent lives in another process (adopted traceparent): this
+        # span is the process-LOCAL root of its trace.
+        self.remote_parent = remote_parent
         self.status = "ok"
         self.start_unix = time.time()
         self._t0 = time.perf_counter()
@@ -108,7 +118,7 @@ class Span:
         if error is not None:
             self.status = "error"
             self.attrs.setdefault("error", f"{type(error).__name__}: {error}")
-        return {
+        rec = {
             "name": self.name,
             "trace_id": self.ctx.trace_id,
             "span_id": self.ctx.span_id,
@@ -119,6 +129,9 @@ class Span:
             "thread": self.thread,
             "attrs": self.attrs,
         }
+        if self.remote_parent:
+            rec["remote_parent"] = True
+        return rec
 
 
 class _NoopSpan:
@@ -158,7 +171,8 @@ def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
     version, trace_id, span_id, flags = m.groups()
     if version == "ff" or set(trace_id) == {"0"} or set(span_id) == {"0"}:
         return None
-    return SpanContext(trace_id, span_id, bool(int(flags, 16) & 0x01))
+    return SpanContext(trace_id, span_id, bool(int(flags, 16) & 0x01),
+                       remote=True)
 
 
 def format_traceparent(ctx: SpanContext) -> str:
@@ -176,15 +190,23 @@ class Tracer:
       inherit the root's decision (whole traces, never fragments).
     - ``export_path``: every finished sampled span is also appended as
       one JSON line (crash-durable; the buffer is bounded and volatile).
+    - ``tail``: a :class:`~routest_tpu.obs.export.TailSampler` replaces
+      the head decision — every root samples (so attrs and exemplars
+      are captured), spans buffer per trace, and retention is decided
+      at root completion (slow / errored / reservoir). The buffer then
+      reliably holds the slowest requests instead of a probabilistic
+      cross-section.
     """
 
     def __init__(self, enabled: bool = True, sample_rate: float = 1.0,
                  buffer_size: int = 2048,
-                 export_path: Optional[str] = None) -> None:
+                 export_path: Optional[str] = None,
+                 tail=None) -> None:
         self.enabled = enabled
         self.sample_rate = max(0.0, min(1.0, sample_rate))
         self.buffer = SpanBuffer(buffer_size)
         self.export_path = export_path
+        self.tail = tail
         self._export_lock = threading.Lock()
         self._rng = random.Random()
 
@@ -199,16 +221,27 @@ class Tracer:
             return
         parent_ctx = current_context() if parent is CURRENT else \
             getattr(parent, "ctx", parent)
+        remote_parent = parent_ctx is not None and \
+            getattr(parent_ctx, "remote", False)
         if parent_ctx is None:
             trace_id = _new_trace_id()
-            sampled = self._rng.random() < self.sample_rate
+            # Tail mode records EVERY root (the decision moves to the
+            # trace's completion); head mode decides here, once.
+            sampled = True if self.tail is not None \
+                else self._rng.random() < self.sample_rate
             parent_id = None
         else:
             trace_id = parent_ctx.trace_id
-            sampled = parent_ctx.sampled
+            # A remote parent makes this span the process-LOCAL root:
+            # in tail mode it records regardless of the upstream flags
+            # (retention is per process — this replica's verdict must
+            # not depend on the gateway's posture).
+            sampled = True if (self.tail is not None and remote_parent) \
+                else parent_ctx.sampled
             parent_id = parent_ctx.span_id
         ctx = SpanContext(trace_id, _new_span_id(), sampled)
-        span = Span(name, ctx, parent_id, attrs if sampled else {})
+        span = Span(name, ctx, parent_id, attrs if sampled else {},
+                    remote_parent=remote_parent)
         token = _current.set(ctx)
         error: Optional[BaseException] = None
         try:
@@ -219,7 +252,14 @@ class Tracer:
         finally:
             _current.reset(token)
             if sampled:
-                self._record(span._finish(error))
+                rec = span._finish(error)
+                if self.tail is None:
+                    self._record(rec)
+                else:
+                    kept = self.tail.offer(rec)
+                    if kept is not None:
+                        for buffered in kept[1]:
+                            self._record(buffered)
 
     def _record(self, rec: dict) -> None:
         self.buffer.add(rec)
@@ -253,9 +293,14 @@ def _from_env() -> Tracer:
     from routest_tpu.core.config import load_obs_config
 
     obs = load_obs_config()
+    tail = None
+    if obs.enabled and obs.tail:
+        from routest_tpu.obs.export import TailSampler
+
+        tail = TailSampler.from_obs_config(obs)
     return Tracer(enabled=obs.enabled, sample_rate=obs.sample_rate,
                   buffer_size=obs.buffer_spans,
-                  export_path=obs.trace_export_path)
+                  export_path=obs.trace_export_path, tail=tail)
 
 
 def get_tracer() -> Tracer:

@@ -49,6 +49,7 @@ from routest_tpu.optimize.hierarchy import (
     relax_from,
     tight_pred,
 )
+from routest_tpu.obs.trace import trace_span
 from routest_tpu.utils.logging import get_logger
 
 _INF = jnp.float32(3e38)
@@ -259,7 +260,8 @@ def _batcher_config() -> Tuple[bool, int, float]:
 
 
 class _BatchEntry:
-    __slots__ = ("sources", "live", "key", "event", "dist", "pred", "error")
+    __slots__ = ("sources", "live", "key", "event", "dist", "pred", "error",
+                 "dispatch_rows", "dispatch_requests")
 
     def __init__(self, sources: np.ndarray, live, key) -> None:
         self.sources = sources
@@ -268,6 +270,11 @@ class _BatchEntry:
         self.event = threading.Event()
         self.dist = self.pred = None
         self.error: Optional[BaseException] = None
+        # Stamped by _dispatch: how big the merged device dispatch that
+        # carried this entry actually was (trace provenance — a slow
+        # solve span says whether it rode a 1-row or a 32-row merge).
+        self.dispatch_rows = 0
+        self.dispatch_requests = 0
 
 
 class _SolveBatcher:
@@ -317,6 +324,18 @@ class _SolveBatcher:
                     "mean_rows_per_dispatch": round(self._rows / d, 3)}
 
     def solve(self, sources: np.ndarray, live):
+        """One caller's solve through the merge queue, traced: the span
+        records how many rows rode the merged dispatch that carried it
+        (``dispatch_rows``/``merged_requests``) — the provenance a
+        tail-sampled slow route trace needs to say whether the solve
+        was a lone dispatch or amortized across a merge."""
+        with trace_span("router.batch_solve", rows=len(sources)) as span:
+            entry = self._solve_entry(sources, live)
+            span.set_attr("dispatch_rows", entry.dispatch_rows)
+            span.set_attr("merged_requests", entry.dispatch_requests)
+            return entry.dist, entry.pred
+
+    def _solve_entry(self, sources: np.ndarray, live) -> "_BatchEntry":
         key = live.epoch if (live is not None and live.route) else 0
         entry = _BatchEntry(sources, live if key else None, key)
         with self._lock:
@@ -330,7 +349,7 @@ class _SolveBatcher:
                 raise TimeoutError("router solve batcher wedged")
             if entry.error is not None:
                 raise entry.error
-            return entry.dist, entry.pred
+            return entry
         drain_error: Optional[BaseException] = None
         try:
             if self.window_s > 0:
@@ -387,7 +406,7 @@ class _SolveBatcher:
                     it.event.set()
         if entry.error is not None:
             raise entry.error
-        return entry.dist, entry.pred
+        return entry
 
     def _dispatch(self, batch: List[_BatchEntry]) -> None:
         merged = (batch[0].sources if len(batch) == 1
@@ -404,6 +423,8 @@ class _SolveBatcher:
             m = len(it.sources)
             it.dist = dist[pos:pos + m]
             it.pred = pred[pos:pos + m]
+            it.dispatch_rows = len(merged)
+            it.dispatch_requests = len(batch)
             pos += m
             it.event.set()
 
@@ -1224,6 +1245,17 @@ class RoadRouter:
         return self.route_legs_batch([(points_latlon, time_scale, hour)])[0]
 
     def route_legs_batch(self, problems) -> List["RoadLegs"]:
+        """Traced entry: the ``router.route_legs`` span carries the
+        per-request provenance the PR 10–12 fast paths added — route-
+        cache hits/misses/waits, hub-labels vs top-BF solver path,
+        serving metric epoch, road-model generation — so a tail-sampled
+        slow route trace says WHICH path it took. Body in
+        :meth:`_route_legs_batch_traced`."""
+        with trace_span("router.route_legs",
+                        problems=len(problems)) as span:
+            return self._route_legs_batch_traced(problems, span)
+
+    def _route_legs_batch_traced(self, problems, span) -> List["RoadLegs"]:
         """Many waypoint sets → one :class:`RoadLegs` each, sharing as
         FEW device solves as memory allows.
 
@@ -1287,6 +1319,27 @@ class RoadRouter:
                 else:
                     my_leads[key] = i
                     solve_idx.append(i)
+
+        # Trace provenance: which solver regime, metric generation, and
+        # cache outcome served THIS batch (the attrs a tail-sampled
+        # slow trace needs to say which path it took).
+        span.set_attr(
+            "solver",
+            "hub_labels" if (self._hier is not None
+                             and self._hier._labels is not None)
+            else ("overlay_top_bf" if self._hier is not None
+                  else "flat_bf"))
+        span.set_attr("metric_epoch",
+                      live.epoch if live is not None else 0)
+        span.set_attr("model_generation", self._model_gen)
+        if cache is None:
+            span.set_attr("route_cache", "off")
+        else:
+            span.set_attr("route_cache_hits",
+                          sum(1 for o in out if o is not None))
+            span.set_attr("route_cache_misses", len(solve_idx))
+            span.set_attr("route_cache_waits", len(waits))
+            span.set_attr("route_cache_aliases", len(aliases))
 
         try:
             if solve_idx:

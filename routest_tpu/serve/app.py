@@ -123,6 +123,41 @@ def create_app(config: Optional[Config] = None,
         if config.slo.tick_s > 0:
             app.slo.start()
 
+    # Metric timeline (docs/OBSERVABILITY.md "Metric timeline"): the
+    # request-stats registry AND the process registry ticked into
+    # bounded multi-resolution rings behind /api/timeline, with the
+    # anomaly watcher comparing each fresh window against the trailing
+    # baseline. Bundles embed the timeline (register_timeline), so a
+    # postmortem answers "when did it start".
+    from routest_tpu.obs import get_registry as _get_registry
+    from routest_tpu.obs.timeline import AnomalyWatcher, TimelineStore
+
+    app.timeline = None
+    app.watcher = None
+    timeline_cfg = getattr(config, "timeline", None)
+    if timeline_cfg is not None and timeline_cfg.enabled:
+        app.timeline = TimelineStore(
+            [app.request_stats.registry, _get_registry()],
+            timeline_cfg, component="replica")
+        recorder.register_timeline(app.timeline)
+        if timeline_cfg.watch:
+            app.watcher = AnomalyWatcher(app.timeline, timeline_cfg,
+                                         recorder).attach()
+        app.timeline.start()
+
+    # Triggered on-path profiling (docs/OBSERVABILITY.md "Triggered
+    # profiling"): armed by the SLO engine's upward edges (warn→page)
+    # and POST /api/debug/profile, budgeted per process.
+    from routest_tpu.obs.profiler import TriggeredProfiler
+
+    app.profiler = None
+    profile_cfg = getattr(config, "profile", None)
+    if profile_cfg is not None and profile_cfg.enabled:
+        app.profiler = TriggeredProfiler(profile_cfg, recorder,
+                                         component="replica")
+        if app.slo is not None:
+            app.slo.on_warn.append(app.profiler.on_slo_edge)
+
     # Live traffic (RTPU_LIVE=1, docs/ARCHITECTURE.md "Live traffic"):
     # probe-stream ingest → per-edge congestion state → periodic metric
     # refresh on the road router. Armed asynchronously — the router
@@ -786,6 +821,50 @@ def create_app(config: Optional[Config] = None,
             return {"enabled": False}, 200
         app.slo.tick()
         return app.slo.snapshot(), 200
+
+    @app.route("/api/timeline", methods=("GET",))
+    def timeline_query(request):
+        # Metric history (docs/OBSERVABILITY.md "Metric timeline"):
+        # windowed deltas/percentiles from the bounded in-process
+        # rings. ?family= substring-filters, ?window= trims to the
+        # trailing seconds, ?step= picks the covering resolution.
+        if app.timeline is None:
+            return {"enabled": False}, 200
+
+        def _num(name):
+            raw = request.args.get(name)
+            if not raw:
+                return None
+            try:
+                return float(raw)
+            except ValueError:
+                return None
+
+        out = app.timeline.query(
+            family=request.args.get("family") or None,
+            window_s=_num("window"), step_s=_num("step"))
+        out["enabled"] = True
+        if app.watcher is not None:
+            out["watcher"] = app.watcher.snapshot()
+        return out, 200
+
+    @app.route("/api/debug/profile", methods=("POST",))
+    def debug_profile(request):
+        # Manual on-path profile trigger (docs/OBSERVABILITY.md
+        # "Triggered profiling"): arms a bounded stack-sample capture;
+        # the result lands as a flight-recorder bundle (profile.folded
+        # + profile.json). 202 armed / 409 when a capture is already
+        # running or the per-process budget is spent.
+        if app.profiler is None:
+            return {"error": "profiler disabled"}, 503
+        body = get_json(request) or {}
+        duration = body.get("duration_s")
+        if duration is not None and not isinstance(duration, (int, float)):
+            return {"error": "duration_s must be a number"}, 400
+        armed = app.profiler.arm("manual_api", {"source": "api"},
+                                 duration_s=duration)
+        return ({"armed": armed, "profiler": app.profiler.snapshot()},
+                202 if armed else 409)
 
     @app.route("/api/debug/snapshot", methods=("POST",))
     def debug_snapshot(request):

@@ -146,11 +146,18 @@ class FastLane:
     # ── the hot path ──────────────────────────────────────────────────
 
     def predict(self, rows: np.ndarray, generation,
-                compute: Callable[[np.ndarray], np.ndarray]) -> np.ndarray:
+                compute: Callable[[np.ndarray], np.ndarray],
+                span=None) -> np.ndarray:
+        """``span`` (optional): a trace span to stamp with THIS
+        request's cache provenance (hits/misses/coalesced) — a
+        tail-sampled slow trace then says whether the fast lane helped
+        or the rows paid full device price."""
         rows = np.ascontiguousarray(rows, np.float32)
         n = len(rows)
         if not self.accepts(n):
             self._m_bypass.inc()
+            if span is not None:
+                span.set_attr("cache", "bypass")
             return compute(rows)
         # ONE tobytes for the whole batch, then per-row slices: a
         # per-row rows[i].tobytes() loop was measurable fixed overhead
@@ -202,6 +209,10 @@ class FastLane:
             self._m_misses.inc(misses)
         if coalesced:
             self._m_coalesced.inc(coalesced)
+        if span is not None:
+            span.set_attr("cache_hits", hits)
+            span.set_attr("cache_misses", misses)
+            span.set_attr("cache_coalesced", coalesced)
 
         all_leads = len(lead_rows) == n
         if lead_keys:

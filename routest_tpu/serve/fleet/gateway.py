@@ -297,6 +297,15 @@ class Gateway:
             self.slo = build_gateway_engine(slo_cfg)
             self.slo.on_page.append(self._recorder.on_slo_page)
             self._recorder.register_slo_engine(self.slo)
+        # Metric timeline (docs/OBSERVABILITY.md "Metric timeline"):
+        # the gateway keeps its own registry history (client-observed
+        # per-route latency, admission, hedges) AND scrapes each
+        # upstream's /api/timeline into per-replica / per-version /
+        # fleet-rollup views. Built here, armed in serve() — a Gateway
+        # constructed for one handle() call must not spawn threads.
+        self.timeline = None
+        self.fleet_timeline = None
+        self.watcher = None
 
     # ── admission control ─────────────────────────────────────────────
 
@@ -986,6 +995,8 @@ class Gateway:
                     return self._metrics()
                 if bare == "/api/trace":
                     return self._trace()
+                if bare == "/api/timeline":
+                    return self._timeline()
                 if bare == "/api/slo":
                     return self._slo()
                 if bare == "/api/autoscale":
@@ -1125,6 +1136,51 @@ class Gateway:
                                 "recorder": gw._recorder.snapshot()},
                                default=str).encode())
 
+            def _timeline(self):
+                """Fleet metric history (docs/OBSERVABILITY.md "Metric
+                timeline"): ``?scope=fleet`` (default — the merged
+                rollup of every replica's scraped frames),
+                ``replicas`` (per-rid), ``versions`` (merged per
+                serving version), or ``local`` (the gateway's own
+                registry history: client-observed per-route latency,
+                admission, hedges). ``?family=``/``?window=``/
+                ``?step=`` as on the replica endpoint."""
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+
+                def one(name):
+                    return (q.get(name) or [None])[0]
+
+                def num(name):
+                    raw = one(name)
+                    try:
+                        return float(raw) if raw else None
+                    except ValueError:
+                        return None
+
+                scope = one("scope") or "fleet"
+                family = one("family") or None
+                window, step = num("window"), num("step")
+                if gw.timeline is None:
+                    payload = {"enabled": False}
+                elif scope == "local":
+                    payload = gw.timeline.query(
+                        family=family, window_s=window, step_s=step)
+                    payload["enabled"] = True
+                    if gw.watcher is not None:
+                        payload["watcher"] = gw.watcher.snapshot()
+                elif gw.fleet_timeline is None:
+                    payload = {"enabled": False, "scope": scope}
+                else:
+                    payload = gw.fleet_timeline.query(
+                        scope=scope, family=family, window_s=window)
+                    payload["enabled"] = True
+                    payload["scraper"] = gw.fleet_timeline.snapshot()
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
+
             def _trace(self):
                 """Span flight-recorder dump (same contract as the
                 replica's ``/api/trace``): JSON spans, or Chrome
@@ -1205,6 +1261,28 @@ class Gateway:
         self._httpd = httpd
         if self.slo is not None and self.slo.config.tick_s > 0:
             self.slo.start()  # burn-rate ticker lives with the listener
+        # Timeline + fleet scraper live with the listener too.
+        from routest_tpu.core.config import load_timeline_config
+
+        timeline_cfg = load_timeline_config()
+        if timeline_cfg.enabled and self.timeline is None:
+            from routest_tpu.obs.timeline import (AnomalyWatcher,
+                                                  FleetTimelineScraper,
+                                                  TimelineStore)
+
+            self.timeline = TimelineStore([get_registry()], timeline_cfg,
+                                          component="gateway")
+            self._recorder.register_timeline(self.timeline)
+            if timeline_cfg.watch:
+                self.watcher = AnomalyWatcher(
+                    self.timeline, timeline_cfg, self._recorder).attach()
+            self.timeline.start()
+            self.fleet_timeline = FleetTimelineScraper(
+                self._fetch_replica_json, timeline_cfg,
+                versions_fn=lambda: {
+                    rid: v or "unversioned"
+                    for rid, v in self._version_by_rid.items()})
+            self.fleet_timeline.start()
         thread = threading.Thread(target=httpd.serve_forever, daemon=True,
                                   name="fleet-gateway")
         thread.start()
@@ -1226,6 +1304,10 @@ class Gateway:
             time.sleep(0.05)
         if self.slo is not None:
             self.slo.stop()
+        if self.timeline is not None:
+            self.timeline.stop()
+        if self.fleet_timeline is not None:
+            self.fleet_timeline.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -1402,10 +1402,18 @@ class EtaService:
             # metric flip retires every cached prediction the same way
             # a model swap does, so no served number outlives either
             # kind of change. Epoch is 0 (one stable key) while live
-            # traffic is off.
-            preds = fl.predict(
-                rows, (serving.generation, metric_epoch()),
-                lambda miss: self._submit_chunked(batcher, miss))
+            # traffic is off. The span carries the per-request
+            # provenance — which model generation/metric epoch served
+            # these rows and how many came from cache — so a
+            # tail-sampled slow trace says WHICH path it took.
+            epoch = metric_epoch()
+            with trace_span("fastlane.predict", rows=len(rows),
+                            model_generation=serving.generation,
+                            metric_epoch=epoch) as fspan:
+                preds = fl.predict(
+                    rows, (serving.generation, epoch),
+                    lambda miss: self._submit_chunked(batcher, miss),
+                    span=fspan)
         else:
             preds = self._submit_chunked(batcher, rows)
         if bad.any() and preds is not None:

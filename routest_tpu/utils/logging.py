@@ -102,7 +102,16 @@ class JsonLogger:
             tee(record)
         line = json.dumps(record, default=str)
         with self._lock:
-            print(line, file=self._stream, flush=True)
+            try:
+                print(line, file=self._stream, flush=True)
+            except ValueError:
+                # The stream can be closed under us (pytest tears its
+                # capture stream down while daemon threads — SLO
+                # ticker, timeline ticker, triggered profiler — are
+                # still finishing). The tee above already delivered the
+                # record to the flight recorder; a log line must never
+                # crash the thread that emitted it.
+                pass
 
     def debug(self, event: str, **fields: Any) -> None:
         self._emit("debug", event, **fields)

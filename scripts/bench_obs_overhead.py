@@ -1,17 +1,26 @@
-"""Observability overhead: everything-off vs sampled vs always-on.
+"""Observability overhead: everything-off vs sampled vs always-on vs
+the ISSUE-13 telemetry layer (timeline+watcher, tail sampling).
 
 The acceptance bar (ISSUE 2, re-pinned by ISSUE 5 with the SLO engine
-and flight recorder in the stack) is that the always-on posture costs
-≤5% on the ``load_test`` predict_eta p95. This script measures it
-honestly: identical server subprocesses (the same spawn-and-wait
-pattern as ``scripts/load_test.py``), differing ONLY in env:
+and flight recorder in the stack, and by ISSUE 13 with the timeline)
+is that the always-on posture costs ≤5% on the ``load_test``
+predict_eta p95. This script measures it honestly: identical server
+subprocesses (the same spawn-and-wait pattern as
+``scripts/load_test.py``), differing ONLY in env:
 
-- ``off``       — tracing, flight recorder, AND SLO engine disabled
-                  (``RTPU_OBS_TRACE=0 RTPU_RECORDER=0 RTPU_SLO=0``);
-- ``sampled``   — trace sampling 0.1, recorder+SLO on (production
-                  default posture);
-- ``always_on`` — trace sampling 1.0, recorder+SLO on (every request
-                  traced, recorded, and rolled into burn rates).
+- ``off``       — tracing, flight recorder, SLO engine, AND timeline
+                  disabled (``RTPU_OBS_TRACE=0 RTPU_RECORDER=0
+                  RTPU_SLO=0 RTPU_TIMELINE=0``) — the true baseline;
+- ``sampled``   — trace sampling 0.1, recorder+SLO+timeline on
+                  (production default posture);
+- ``always_on`` — trace sampling 1.0, recorder+SLO+timeline on (every
+                  request traced, recorded, rolled into burn rates,
+                  and ticked into the timeline rings);
+- ``timeline``  — ONLY the timeline store + anomaly watcher on, over
+                  the off baseline (isolates the ticker's cost);
+- ``tail``      — the always-on posture plus tail-based trace
+                  retention (``RTPU_TAIL_SAMPLE=1`` — every trace
+                  buffers; the decision moves to root completion).
 
 Each mode runs the load_test single-row phase (the per-request-overhead-
 dominated endpoint: tiny payloads, so any observability cost is
@@ -86,11 +95,20 @@ def _wait_ready(lt, proc, base: str, timeout: float = 300.0) -> None:
 
 MODES = (
     ("off", {"RTPU_OBS_TRACE": "0", "RTPU_RECORDER": "0",
-             "RTPU_SLO": "0"}),
+             "RTPU_SLO": "0", "RTPU_TIMELINE": "0",
+             "RTPU_TAIL_SAMPLE": "0"}),
     ("sampled", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "0.1",
-                 "RTPU_RECORDER": "1", "RTPU_SLO": "1"}),
+                 "RTPU_RECORDER": "1", "RTPU_SLO": "1",
+                 "RTPU_TIMELINE": "1"}),
     ("always_on", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "1.0",
-                   "RTPU_RECORDER": "1", "RTPU_SLO": "1"}),
+                   "RTPU_RECORDER": "1", "RTPU_SLO": "1",
+                   "RTPU_TIMELINE": "1"}),
+    ("timeline", {"RTPU_OBS_TRACE": "0", "RTPU_RECORDER": "0",
+                  "RTPU_SLO": "0", "RTPU_TIMELINE": "1",
+                  "RTPU_TIMELINE_WATCH": "1"}),
+    ("tail", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "1.0",
+              "RTPU_RECORDER": "1", "RTPU_SLO": "1",
+              "RTPU_TIMELINE": "1", "RTPU_TAIL_SAMPLE": "1"}),
 )
 
 
@@ -205,9 +223,10 @@ def main() -> None:
         overhead = (p95("always_on") - p95("off")) / p95("off") * 100.0
         report["p95_overhead_always_on_pct"] = round(overhead, 2)
         report["within_5pct_budget"] = bool(overhead <= 5.0)
-    if p95("off") and p95("sampled"):
-        report["p95_overhead_sampled_pct"] = round(
-            (p95("sampled") - p95("off")) / p95("off") * 100.0, 2)
+    for mode in ("sampled", "timeline", "tail"):
+        if p95("off") and p95(mode):
+            report[f"p95_overhead_{mode}_pct"] = round(
+                (p95(mode) - p95("off")) / p95("off") * 100.0, 2)
     bo = results.get("off", {}).get("predict_eta_batch", {})
     ba = results.get("always_on", {}).get("predict_eta_batch", {})
     if bo.get("preds_per_s") and ba.get("preds_per_s"):

@@ -121,6 +121,13 @@ def boot_fleet(recorder_dir: str, extra_env=None, warm: bool = True):
     from routest_tpu.serve.fleet.gateway import Gateway
     from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
 
+    # Fine-grained timeline windows for BOTH tiers: the scenarios run
+    # tens of seconds, so 1 s frames are what makes the ISSUE-13
+    # "bundle embeds the incident's timeline" assertion meaningful
+    # (the production 10 s default would leave a --quick page bundle
+    # with at most a frame or two). The in-process gateway reads
+    # os.environ at serve() time, the workers inherit env.
+    os.environ["RTPU_TIMELINE_RES"] = "1x600,10x360"
     configure_recorder(FlightRecorder(RecorderConfig(
         dir=os.path.join(recorder_dir, "gateway"), min_interval_s=0.0)))
     ports = [_free_port()]
@@ -132,6 +139,7 @@ def boot_fleet(recorder_dir: str, extra_env=None, warm: bool = True):
         "ETA_MODEL_PATH": MODEL,
         "RTPU_RECORDER_DIR": os.path.join(recorder_dir, "workers"),
         "RTPU_RECORDER_MIN_INTERVAL_S": "0",
+        "RTPU_TIMELINE_RES": "1x600,10x360",
     })
     env.update(extra_env or {})
     sup = ReplicaSupervisor(ports, env=env, cwd=REPO,
@@ -172,6 +180,7 @@ class DetectionRun:
         self.offending: set = set()
         self.statuses: dict = {}
         self.t_inject: float = 0.0
+        self.t_inject_wall: float = 0.0   # unix — timeline frames use it
         self.paged_at: float = 0.0
         self.page_objective: str = ""
         self.page_component: str = ""
@@ -275,6 +284,58 @@ def _scenario(name, args, extra_env=None, warm=True):
     return recorder_dir, sup, gw, base
 
 
+def _page_bundle_timelines(dirs, t_inject_wall, timeout_s=30.0):
+    """ISSUE-13: every ``slo_page*`` bundle must embed a NON-EMPTY
+    timeline slice, and the scenario's page bundles together must cover
+    the incident (≥1 frame whose window ends at/after the injection
+    instant — the follow-up bundle guarantees one exists). → dict of
+    the assertion results."""
+    deadline = time.monotonic() + timeout_s
+    result = {"page_bundles": 0, "page_bundles_with_timeline": 0,
+              "timeline_frames": 0, "timeline_covers_incident": False}
+    while time.monotonic() < deadline:
+        bundles = []
+        for root in dirs:
+            if not os.path.isdir(root):
+                continue
+            bundles.extend(os.path.join(root, d)
+                           for d in sorted(os.listdir(root))
+                           if d.startswith("pm_"))
+        page_bundles = []
+        for bundle in bundles:
+            try:
+                manifest = json.load(
+                    open(os.path.join(bundle, "manifest.json")))
+            except (OSError, ValueError):
+                continue  # racing an in-progress write
+            if str(manifest.get("reason", "")).startswith("slo_page"):
+                page_bundles.append(bundle)
+        if page_bundles:
+            result["page_bundles"] = len(page_bundles)
+            result["page_bundles_with_timeline"] = 0
+            result["timeline_frames"] = 0
+            covers = False
+            for bundle in page_bundles:
+                try:
+                    doc = json.load(
+                        open(os.path.join(bundle, "timeline.json")))
+                except (OSError, ValueError):
+                    continue
+                frames = [f for comp in doc.values()
+                          for f in comp.get("frames", [])]
+                if frames:
+                    result["page_bundles_with_timeline"] += 1
+                    result["timeline_frames"] += len(frames)
+                if any(f["t"] >= t_inject_wall for f in frames):
+                    covers = True
+            result["timeline_covers_incident"] = covers
+            if covers and result["page_bundles_with_timeline"] \
+                    == result["page_bundles"]:
+                return result
+        time.sleep(0.5)
+    return result
+
+
 def _finish(run, recorder_dir, bundles_extra=None):
     out = run.summary()
     dirs = [os.path.join(recorder_dir, "workers"),
@@ -284,8 +345,16 @@ def _finish(run, recorder_dir, bundles_extra=None):
     out["bundle"] = bundle
     out["bundle_offending_traces"] = matched
     out["bundle_has_offender"] = matched > 0
+    timeline = _page_bundle_timelines(dirs, run.t_inject_wall)
+    out.update(timeline)
+    out["bundle_has_timeline"] = bool(
+        timeline["page_bundles"]
+        and timeline["page_bundles_with_timeline"]
+        == timeline["page_bundles"]
+        and timeline["timeline_covers_incident"])
     out["pass"] = bool(out["paged"] and out["within_bound"]
-                       and out["bundle_has_offender"])
+                       and out["bundle_has_offender"]
+                       and out["bundle_has_timeline"])
     if bundles_extra:
         out.update(bundles_extra)
     shutil.rmtree(recorder_dir, ignore_errors=True)
@@ -301,6 +370,7 @@ def scenario_deadline_storm(args):
             for _ in range(args.healthy_n):
                 run.send("/api/predict_eta", PREDICT_BODY)
             run.t_inject = time.monotonic()
+            run.t_inject_wall = time.time()
             i = 0
             while not run._stop.is_set():
                 # unique rows per request: the fast-lane cache would
@@ -333,6 +403,7 @@ def scenario_replica_crash(args):
             for _ in range(args.healthy_n):
                 run.send("/api/predict_eta", PREDICT_BODY)
             run.t_inject = time.monotonic()
+            run.t_inject_wall = time.time()
             sup.kill_replica(0)
             while not run._stop.is_set():
                 run.send("/api/predict_eta", PREDICT_BODY)
@@ -362,6 +433,7 @@ def scenario_device_error_burst(args):
             for _ in range(args.healthy_n):
                 run.send("/api/update_tracker", {"route_id": "x"})
             run.t_inject = time.monotonic()
+            run.t_inject_wall = time.time()
             i = 0
             while not run._stop.is_set():
                 # unique rows: repeated bodies would be answered by the
@@ -403,6 +475,7 @@ def scenario_store_outage(args):
             for _ in range(args.healthy_n):
                 run.send("/api/predict_eta", PREDICT_BODY)
             run.t_inject = time.monotonic()
+            run.t_inject_wall = time.time()
             while not run._stop.is_set():
                 run.send("/api/optimize_route", ROUTE_BODY,
                          offending_if=degraded_or_5xx)

@@ -1,0 +1,474 @@
+"""Telemetry end-to-end: an injected latency regression, seen three ways.
+
+The ISSUE-13 acceptance scenario: under open-loop load against a real
+2-replica fleet, a latency regression is "deployed" (a rolling restart
+onto a version whose env overlay carries seeded ``device.compute``
+latency chaos — the dominant real incident shape: a bad deploy), and
+the telemetry layer must catch it end to end:
+
+(a) **timeline** — the regression is visible in the gateway FLEET
+    timeline (the scraped per-replica frames merged per slot) in the
+    first complete window after injection: merged p95 over the
+    regression factor vs the pre-injection baseline;
+(b) **tail sampling** — the replica span buffers hold ≥1 tail-KEPT
+    trace of an actually-slow request (root over its route's SLO
+    threshold) carrying the provenance attrs (``fastlane.predict``
+    with model generation + metric epoch + cache outcome) — the trace
+    head sampling would have found only by luck;
+(c) **bundles** — an anomaly- or page-triggered flight-recorder bundle
+    embeds a non-empty ``timeline.json`` slice covering the injection
+    instant — the postmortem answers *when did it start*;
+(d) **budget** — the committed ``artifacts/obs_overhead.json`` shows
+    the always-on posture within the ≤5% p95 budget vs obs-off.
+
+Also recorded (and gated): the per-VERSION timeline view separates the
+regressed version from the baseline, and the SLO warn/page edge armed
+a triggered profile capture on the replica.
+
+Writes ``artifacts/telemetry.json``.
+
+Usage: python scripts/bench_telemetry.py [--quick]
+       [--out artifacts/telemetry.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODEL = os.path.join(REPO, "artifacts", "eta_mlp.msgpack")
+
+STEP_S = 1.0          # finest timeline resolution for the scenario
+SLOW_MS = 250.0       # per-route SLO latency threshold (env-set below)
+CHAOS_MS = 400       # injected device latency (≫ SLOW_MS)
+REGRESSION_FACTOR = 2.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get_json(base, path, timeout=15.0):
+    try:
+        with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as r:
+            return json.loads(r.read())
+    except (urllib.error.URLError, OSError, ValueError):
+        return {}
+
+
+def boot_fleet(recorder_dir: str):
+    """Two real serving workers behind an in-process gateway, armed
+    with the full ISSUE-13 posture: 1 s timeline frames, tail-based
+    trace retention, tight predict latency SLO, watcher + profiler on."""
+    from routest_tpu.core.config import FleetConfig, RecorderConfig
+    from routest_tpu.obs.recorder import FlightRecorder, configure_recorder
+    from routest_tpu.serve.fleet.gateway import Gateway
+    from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
+
+    telemetry_env = {
+        "RTPU_TIMELINE_RES": "1x600,10x360",
+        "RTPU_TAIL_SAMPLE": "1",
+        "RTPU_SLO_OBJECTIVES":
+            f"/api/predict_eta:availability=0.999,latency_ms={SLOW_MS:g},"
+            "latency_target=0.95",
+        "RTPU_RECORDER_MIN_INTERVAL_S": "0",
+    }
+    # The in-process gateway reads os.environ (tracer, timeline).
+    os.environ.update(telemetry_env)
+    configure_recorder(FlightRecorder(RecorderConfig(
+        dir=os.path.join(recorder_dir, "gateway"), min_interval_s=0.0)))
+    ports = [_free_port(), _free_port()]
+    env = dict(os.environ)
+    env.update({
+        "ROUTEST_FORCE_CPU": "1",
+        "ROUTEST_WARM_BUCKETS": "0",
+        "ROUTEST_MESH": "0",
+        "ETA_MODEL_PATH": MODEL,
+        "RTPU_RECORDER_DIR": os.path.join(recorder_dir, "workers"),
+        **telemetry_env,
+    })
+    sup = ReplicaSupervisor(ports, env=env, cwd=REPO,
+                            probe_interval_s=0.5, backoff_base_s=0.2,
+                            backoff_cap_s=2.0)
+    sup.start()
+    if not sup.ready(timeout=300):
+        sup.drain(timeout=10)
+        raise RuntimeError("fleet workers never became ready")
+    cfg = FleetConfig(eject_after=5, cooldown_s=1.0, max_inflight=64,
+                      queue_depth=256, hedge=False)
+    gw = Gateway([("127.0.0.1", p) for p in ports], cfg, supervisor=sup,
+                 version="v1-baseline")
+    httpd = gw.serve("127.0.0.1", 0)
+    return sup, gw, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def start_load(base: str, rate: float, duration_s: float,
+               stop: threading.Event):
+    """Open-loop paced predict_eta load (coordinated-omission-correct:
+    the generator never slows down because the fleet did). Every body
+    is unique so each request does real device work — a cached answer
+    cannot mask a device-latency regression."""
+    from routest_tpu.loadgen.arrivals import RateCurve, paced_schedule
+    from routest_tpu.loadgen.engine import run_open_loop
+    from routest_tpu.loadgen.workload import PlannedRequest
+
+    offsets = paced_schedule(RateCurve.constant(rate), duration_s)
+    requests = [PlannedRequest(
+        method="POST", path="/api/predict_eta",
+        body={"summary": {"distance": 8000 + i}, "weather": "Sunny",
+              "traffic": "Medium", "driver_age": 35,
+              "pickup_time": "2026-08-05T18:00:00"},
+        route="predict_eta") for i in range(len(offsets))]
+    records: list = []
+    thread = threading.Thread(
+        target=lambda: records.extend(run_open_loop(
+            [base], offsets, requests, workers=24, timeout=30.0,
+            stop=stop)),
+        daemon=True)
+    thread.start()
+    return thread, records
+
+
+def inject_regression(sup, gw, boot_timeout_s: float):
+    """Roll the fleet onto the regressed version: each replica is
+    replaced (drain → spawn → boot watch → health gate → join) with an
+    env overlay carrying seeded device-latency chaos. Returns the unix
+    instant the FIRST regressed replica joined (= regression onset)."""
+    from routest_tpu.serve.fleet.rollout import replace_replica
+
+    overlay = {"RTPU_CHAOS_SPEC":
+               f"device.compute:latency=1.0/{CHAOS_MS}",
+               "RTPU_CHAOS_SEED": "3"}
+    with gw._lock:
+        rids = sorted((r.id for r in gw.replicas if not r.draining),
+                      key=lambda rid: int(rid[1:]))
+    t_first = None
+    for rid in rids:
+        result = replace_replica(
+            sup, gw, rid, version="v2-regressed", env=overlay,
+            boot_timeout_s=boot_timeout_s, health_timeout_s=30.0)
+        if not result.get("ok"):
+            raise RuntimeError(f"injection rollout failed: {result}")
+        if t_first is None:
+            t_first = time.time()
+    return t_first
+
+
+def _hist_p95(frame, family="request_duration_seconds"):
+    fam = (frame.get("families") or {}).get(family)
+    if not fam:
+        return None, 0
+    le = fam.get("le") or ()
+    buckets = None
+    count = 0
+    for row in fam["series"]:
+        count += row.get("count", 0)
+        b = row.get("buckets")
+        if b is not None:
+            buckets = (list(b) if buckets is None
+                       else [x + y for x, y in zip(buckets, b)])
+    if not buckets or not le:
+        return None, count
+    from routest_tpu.obs.timeline import bucket_quantile
+
+    return bucket_quantile(le, buckets, 0.95), count
+
+
+def check_fleet_timeline(base: str, t_inject: float, timeout_s: float,
+                         baseline_p95: float) -> dict:
+    """(a): poll the gateway fleet timeline for the first complete
+    post-injection window and judge its merged p95."""
+    deadline = time.monotonic() + timeout_s
+    out = {"baseline_p95_s": round(baseline_p95, 4)}
+    while time.monotonic() < deadline:
+        doc = _get_json(base, f"/api/timeline?scope=fleet&step={STEP_S:g}"
+                              "&family=request_duration_seconds")
+        frames = [f for f in (doc.get("frames") or [])
+                  if f["t"] - f["dur"] >= t_inject]
+        for frame in frames:
+            p95, count = _hist_p95(frame)
+            if p95 is None or count < 3:
+                continue
+            out.update({
+                "frame_t": frame["t"],
+                "frame_count": count,
+                "p95_s": round(p95, 4),
+                "windows_after_inject": round(
+                    (frame["t"] - t_inject) / STEP_S, 2),
+                "regression_visible": bool(
+                    p95 >= REGRESSION_FACTOR * baseline_p95
+                    and p95 >= SLOW_MS / 1000.0),
+            })
+            if out["regression_visible"]:
+                return out
+        time.sleep(STEP_S / 2)
+    out.setdefault("regression_visible", False)
+    return out
+
+
+def baseline_fleet_p95(base: str) -> float:
+    doc = _get_json(base, f"/api/timeline?scope=fleet&step={STEP_S:g}"
+                          "&family=request_duration_seconds")
+    best, weight = 0.0, 0
+    for frame in doc.get("frames") or []:
+        p95, count = _hist_p95(frame)
+        if p95 is not None and count >= 3 and count > weight:
+            best, weight = p95, count
+    return best or 0.02
+
+
+def check_tail_traces(sup) -> dict:
+    """(b): the replicas' span buffers hold tail-kept SLOW traces of
+    actually-slow requests with provenance attrs."""
+    found = {"tail_slow_roots": 0, "with_provenance": 0, "example": None}
+    for port in sup.ports:
+        doc = _get_json(f"http://127.0.0.1:{port}", "/api/trace")
+        spans = doc.get("spans") or []
+        by_trace: dict = {}
+        for s in spans:
+            by_trace.setdefault(s.get("trace_id"), []).append(s)
+        for s in spans:
+            # The replica's tail-kept root sits BEHIND the gateway, so
+            # its parent_id points at the gateway's forward span —
+            # local roots are parentless OR remote-parented.
+            local_root = s.get("parent_id") is None \
+                or s.get("remote_parent")
+            if not local_root or s.get("tail") != "slow":
+                continue
+            if s.get("duration_ms", 0) < SLOW_MS:
+                continue
+            found["tail_slow_roots"] += 1
+            tree = by_trace.get(s.get("trace_id"), [])
+            prov = next((c for c in tree
+                         if c.get("name") == "fastlane.predict"
+                         and "model_generation" in (c.get("attrs") or {})),
+                        None)
+            if prov is not None:
+                found["with_provenance"] += 1
+                if found["example"] is None:
+                    found["example"] = {
+                        "trace_id": s["trace_id"],
+                        "duration_ms": s["duration_ms"],
+                        "threshold_ms": SLOW_MS,
+                        "provenance": prov["attrs"],
+                    }
+    found["ok"] = found["with_provenance"] >= 1
+    return found
+
+
+def check_bundles(recorder_dir: str, t_inject: float,
+                  timeout_s: float = 45.0) -> dict:
+    """(c): an anomaly/page bundle embeds a timeline slice covering the
+    injection instant; also report the triggered-profile bundle."""
+    dirs = [os.path.join(recorder_dir, "workers"),
+            os.path.join(recorder_dir, "gateway")]
+    deadline = time.monotonic() + timeout_s
+    out = {"bundles": [], "incident_bundle": None, "profile_bundle": None}
+    while time.monotonic() < deadline:
+        out["bundles"] = []
+        for root in dirs:
+            if not os.path.isdir(root):
+                continue
+            for name in sorted(os.listdir(root)):
+                if not name.startswith("pm_"):
+                    continue
+                bundle = os.path.join(root, name)
+                try:
+                    manifest = json.load(
+                        open(os.path.join(bundle, "manifest.json")))
+                except (OSError, ValueError):
+                    continue
+                reason = str(manifest.get("reason", ""))
+                entry = {"reason": reason, "name": name}
+                out["bundles"].append(entry)
+                if reason.startswith("profile_") \
+                        and out["profile_bundle"] is None:
+                    folded = os.path.join(bundle, "profile.folded")
+                    if os.path.exists(folded) \
+                            and os.path.getsize(folded) > 0:
+                        out["profile_bundle"] = entry
+                if not (reason.startswith("anomaly_")
+                        or reason.startswith("slo_page")):
+                    continue
+                try:
+                    doc = json.load(
+                        open(os.path.join(bundle, "timeline.json")))
+                except (OSError, ValueError):
+                    continue
+                frames = [f for comp in doc.values()
+                          for f in comp.get("frames", [])]
+                covers = any(f["t"] >= t_inject for f in frames)
+                if frames and covers and out["incident_bundle"] is None:
+                    out["incident_bundle"] = {
+                        **entry, "timeline_frames": len(frames),
+                        "covers_incident": covers}
+        if out["incident_bundle"] and out["profile_bundle"]:
+            break
+        time.sleep(1.0)
+    out["ok"] = out["incident_bundle"] is not None
+    out["profile_ok"] = out["profile_bundle"] is not None
+    return out
+
+
+def check_version_view(base: str, t_inject: float) -> dict:
+    """The per-version tentpole view: the regressed version's merged
+    p95 must sit above the baseline version's."""
+    doc = _get_json(base, "/api/timeline?scope=versions"
+                          "&family=request_duration_seconds")
+    versions = doc.get("versions") or {}
+    out = {"versions_seen": sorted(versions)}
+    p95s = {}
+    for label, payload in versions.items():
+        best, weight = None, 0
+        for frame in payload.get("frames") or []:
+            p95, count = _hist_p95(frame)
+            if p95 is not None and count > weight:
+                best, weight = p95, count
+        if best is not None:
+            p95s[label] = round(best, 4)
+    out["p95_by_version"] = p95s
+    base_p95 = p95s.get("v1-baseline")
+    reg_p95 = p95s.get("v2-regressed")
+    out["ok"] = bool(base_p95 is not None and reg_p95 is not None
+                     and reg_p95 >= REGRESSION_FACTOR * base_p95)
+    return out
+
+
+def wait_for_page(base: str, timeout_s: float) -> dict:
+    """Poll /api/slo?replicas=1 until a latency objective pages."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        snap = _get_json(base, "/api/slo?replicas=1", timeout=10.0)
+        candidates = [("gateway", snap)]
+        for rid, rep in (snap.get("replica_slo") or {}).items():
+            candidates.append((f"replica:{rid}", rep))
+        for component, payload in candidates:
+            for name, obj in (payload.get("objectives") or {}).items():
+                if obj.get("state") == "page":
+                    return {"paged": True, "objective": name,
+                            "component": component,
+                            "at_unix": round(time.time(), 2)}
+        time.sleep(0.25)
+    return {"paged": False}
+
+
+def main() -> None:
+    from routest_tpu.utils.logging import get_logger
+
+    log = get_logger("routest_tpu.bench_telemetry")
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--quick", action="store_true",
+                        help="shorter phases (CI re-verification)")
+    parser.add_argument("--rate", type=float, default=8.0,
+                        help="open-loop request rate (per second)")
+    parser.add_argument("--out", default=os.path.join(
+        REPO, "artifacts", "telemetry.json"))
+    args = parser.parse_args()
+    baseline_s = 10.0 if args.quick else 20.0
+    regression_s = 60.0 if args.quick else 120.0
+    boot_timeout_s = 240.0
+
+    recorder_dir = tempfile.mkdtemp(prefix="telemetry-bench-")
+    t0 = time.time()
+    sup, gw, base = boot_fleet(recorder_dir)
+    stop = threading.Event()
+    record = {
+        "generated_unix": int(t0),
+        "host": {"cpu_count": os.cpu_count(), "platform": sys.platform},
+        "scenario": {
+            "replicas": 2, "rate_rps": args.rate,
+            "baseline_s": baseline_s,
+            "slow_threshold_ms": SLOW_MS,
+            "injected_device_latency_ms": CHAOS_MS,
+            "timeline_step_s": STEP_S,
+            "injection": "rolling restart onto version v2-regressed "
+                         "whose env overlay carries seeded "
+                         "device.compute latency chaos (a bad deploy)",
+        },
+    }
+    try:
+        load_thread, _records = start_load(
+            base, args.rate, baseline_s + regression_s + 300.0, stop)
+        log.info("telemetry_baseline_phase", seconds=baseline_s)
+        time.sleep(baseline_s)
+        baseline_p95 = baseline_fleet_p95(base)
+        log.info("telemetry_injecting", baseline_p95_s=baseline_p95)
+        t_inject = inject_regression(sup, gw, boot_timeout_s)
+        record["t_inject_unix"] = round(t_inject, 2)
+
+        timeline = check_fleet_timeline(base, t_inject,
+                                        timeout_s=regression_s,
+                                        baseline_p95=baseline_p95)
+        record["fleet_timeline"] = timeline
+        log.info("telemetry_timeline_checked", **timeline)
+
+        record["slo"] = wait_for_page(base, timeout_s=regression_s)
+        record["tail_traces"] = check_tail_traces(sup)
+        record["bundles"] = check_bundles(recorder_dir, t_inject)
+        record["version_view"] = check_version_view(base, t_inject)
+    finally:
+        stop.set()
+        try:
+            load_thread.join(timeout=30)
+        except Exception:
+            pass
+        from routest_tpu.obs.recorder import configure_recorder
+
+        try:
+            gw.drain(timeout=5)
+        finally:
+            sup.drain(timeout=15)
+            configure_recorder(None)
+            shutil.rmtree(recorder_dir, ignore_errors=True)
+
+    # (d) the standing overhead budget, from the artifact of record.
+    try:
+        overhead = json.load(open(os.path.join(
+            REPO, "artifacts", "obs_overhead.json")))
+        record["obs_overhead"] = {
+            "p95_overhead_always_on_pct":
+                overhead.get("p95_overhead_always_on_pct"),
+            "within_5pct_budget": overhead.get("within_5pct_budget"),
+        }
+    except (OSError, ValueError):
+        record["obs_overhead"] = {"within_5pct_budget": None}
+
+    record["checks"] = {
+        "timeline_visible": record["fleet_timeline"].get(
+            "regression_visible", False),
+        "tail_trace_with_provenance": record["tail_traces"]["ok"],
+        "bundle_covers_incident": record["bundles"]["ok"],
+        "version_view_separates": record["version_view"]["ok"],
+        "profile_captured": record["bundles"]["profile_ok"],
+        "slo_paged": record["slo"]["paged"],
+        "overhead_within_budget": bool(
+            record["obs_overhead"]["within_5pct_budget"]),
+    }
+    record["all_pass"] = all(record["checks"].values())
+    record["wall_s"] = round(time.time() - t0, 1)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    log.info("telemetry_written", path=args.out,
+             all_pass=record["all_pass"], **record["checks"])
+    print(json.dumps(record, indent=2))
+    if not record["all_pass"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,11 @@ run_step kernel_bench timeout 2400 python scripts/bench_serving_kernel.py --gate
 # on a real TPU backend — this is the BASELINE >=10k preds/s/chip
 # claim measured PER CHIP for the first time).
 run_step fleet_chips timeout 2400 python scripts/bench_fleet_chips.py
+# Telemetry end-to-end (ISSUE 13): an injected latency regression must
+# be visible in the gateway fleet timeline within a tick, tail-kept as
+# a slow trace with provenance, and captured in a bundle embedding the
+# timeline slice (artifacts/telemetry.json).
+run_step telemetry timeout 1500 python scripts/bench_telemetry.py
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
 run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
   --osm-nodes 250000 --verify --flat-compare

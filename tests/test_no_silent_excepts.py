@@ -125,3 +125,13 @@ def test_sweep_sees_the_placement_planner():
     # It lives under serve/fleet, which the "fleet" sweep walks; this
     # pin fails if the module moves out of the swept tree.
     assert os.path.exists(os.path.join(FLEET_ROOT, "placement.py"))
+
+
+def test_sweep_sees_the_telemetry_layer():
+    # ISSUE-13: the timeline ticker, fleet scraper, and triggered
+    # profiler all run on daemon threads during incidents — a silently
+    # swallowed failure there erases exactly the evidence the incident
+    # needs. They live under obs/, which the "obs" sweep walks; this
+    # pin fails if they move out of the swept tree.
+    for module in ("timeline.py", "profiler.py", "export.py"):
+        assert os.path.exists(os.path.join(OBS_ROOT, module))

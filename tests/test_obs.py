@@ -168,6 +168,37 @@ def test_registry_prometheus_exposition_cumulative_and_escaped():
             assert line.count(" ") >= 1 and not line.startswith("le=")
 
 
+def test_prometheus_text_carries_openmetrics_exemplars():
+    """ISSUE-13 satellite: exemplars existed in the JSON snapshot since
+    PR 5 but were dropped from the text exposition — bucket lines now
+    carry the OpenMetrics ``# {trace_id="…"} value ts`` annotation."""
+    from routest_tpu.obs.trace import Tracer, configure_tracer, get_tracer
+
+    reg = MetricsRegistry()
+    h = reg.histogram("ex_seconds", "h", buckets=(0.01, 0.1, 1.0))
+    previous = get_tracer()
+    tracer = configure_tracer(Tracer(enabled=True, sample_rate=1.0))
+    try:
+        with tracer.span("unit") as span:
+            h.observe(0.05)
+        trace_id = span.trace_id
+    finally:
+        configure_tracer(previous)
+    h.observe(0.5)  # outside any span: that bucket has NO exemplar
+    text = reg.prometheus_text()
+    lines = {ln.split(" ", 1)[0]: ln for ln in text.splitlines()
+             if ln.startswith("ex_seconds_bucket")}
+    ex_line = lines['ex_seconds_bucket{le="0.1"}']
+    assert f'# {{trace_id="{trace_id}"}} 0.05 ' in ex_line
+    # Exemplar timestamp is seconds (OpenMetrics), ~now.
+    ts = float(ex_line.rsplit(" ", 1)[1])
+    assert abs(ts - time.time()) < 60.0
+    assert "#" not in lines['ex_seconds_bucket{le="1.0"}']
+    # _sum/_count stay plain.
+    assert "#" not in next(ln for ln in text.splitlines()
+                           if ln.startswith("ex_seconds_count"))
+
+
 def test_registry_counter_gauge_and_type_conflicts():
     reg = MetricsRegistry()
     c = reg.counter("jobs_total", "jobs", ("kind",))

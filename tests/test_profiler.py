@@ -1,0 +1,89 @@
+"""Triggered profiling (ISSUE 13): a capture samples real stacks into
+a flight-recorder bundle, budgets hold, and the SLO warn edge arms it."""
+
+import json
+import os
+import time
+
+from routest_tpu.core.config import ProfileConfig, RecorderConfig
+from routest_tpu.obs.profiler import TriggeredProfiler
+from routest_tpu.obs.recorder import FlightRecorder
+
+
+def _profiler(tmp_path, **cfg_kw):
+    recorder = FlightRecorder(RecorderConfig(dir=str(tmp_path),
+                                             min_interval_s=0.0))
+    cfg = ProfileConfig(**{"duration_s": 0.15, "interval_ms": 5.0,
+                           "min_interval_s": 0.0, **cfg_kw})
+    return TriggeredProfiler(cfg, recorder), recorder
+
+
+def _wait_done(prof, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = prof.snapshot()
+        if not snap["running"] and snap["last_bundle"]:
+            return snap
+        time.sleep(0.02)
+    raise AssertionError(f"capture never finished: {prof.snapshot()}")
+
+
+def test_capture_writes_folded_stacks_bundle(tmp_path):
+    prof, _rec = _profiler(tmp_path)
+    assert prof.arm("unit_test", {"why": "test"})
+    snap = _wait_done(prof)
+    bundle = snap["last_bundle"]
+    folded = open(os.path.join(bundle, "profile.folded")).read()
+    # Folded flamegraph lines: "thread;frame;...;leaf count" — and this
+    # very test's thread shows up (it was sleeping in _wait_done).
+    lines = [ln for ln in folded.splitlines() if ln.strip()]
+    assert lines and all(ln.rsplit(" ", 1)[1].isdigit() for ln in lines)
+    assert "test_profiler" in folded or "threading" in folded
+    meta = json.load(open(os.path.join(bundle, "profile.json")))
+    assert meta["trigger"] == "unit_test"
+    assert meta["samples"] > 0 and meta["threads"] >= 1
+    assert meta["top_self"]
+    manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+    assert manifest["reason"] == "profile_unit_test"
+
+
+def test_budget_and_spacing_suppress(tmp_path):
+    prof, _rec = _profiler(tmp_path, max_captures=1,
+                           min_interval_s=3600.0)
+    assert prof.arm("first")
+    _wait_done(prof)
+    assert not prof.arm("second")  # budget of 1 spent
+    prof2, _ = _profiler(tmp_path, max_captures=10,
+                         min_interval_s=3600.0)
+    assert prof2.arm("first")
+    _wait_done(prof2)
+    assert not prof2.arm("second")  # inside the spacing window
+    prof3, _ = _profiler(tmp_path, enabled=False)
+    assert not prof3.arm("never")
+
+
+def test_only_one_capture_at_a_time(tmp_path):
+    prof, _rec = _profiler(tmp_path, duration_s=0.5)
+    assert prof.arm("first")
+    assert not prof.arm("second")  # one already running
+    _wait_done(prof)
+
+
+def test_slo_warn_edge_arms_capture(tmp_path):
+    prof, _rec = _profiler(tmp_path)
+    prof.on_slo_edge("latency:/api/predict_eta",
+                     {"from": "ok", "to": "warn", "burn_fast": 9.0,
+                      "burn_slow": 7.0, "route": "/api/predict_eta"})
+    snap = _wait_done(prof)
+    assert snap["last_reason"] == "slo_warn"
+    meta = json.load(open(os.path.join(snap["last_bundle"],
+                                       "profile.json")))
+    assert meta["detail"]["slo"] == "latency:/api/predict_eta"
+
+
+def test_manual_duration_is_clamped(tmp_path):
+    prof, _rec = _profiler(tmp_path)
+    t0 = time.monotonic()
+    assert prof.arm("manual_api", duration_s=0.1)
+    _wait_done(prof)
+    assert time.monotonic() - t0 < 5.0  # honored the short duration

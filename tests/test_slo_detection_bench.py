@@ -6,7 +6,10 @@ composed loop through ``scripts/bench_slo_detection.py --quick`` and
 asserts the ISSUE-5 acceptance invariants: every replayed chaos
 scenario reaches the ``page`` alert state within the slow-window
 bound, and each scenario's postmortem bundle contains the trace id of
-at least one offending request."""
+at least one offending request — plus the ISSUE-13 invariant: every
+page-trigger bundle embeds a non-empty timeline slice covering the
+incident window (``timeline.json``), so a postmortem answers "when
+did it start"."""
 
 import json
 import os
@@ -36,6 +39,15 @@ def test_slo_detection_quick(tmp_path):
         assert s["time_to_detect_s"] is not None \
             and s["time_to_detect_s"] <= s["slow_window_bound_s"], (name, s)
         assert s.get("bundle_has_offender"), (name, s)
+        # ISSUE-13: every page-trigger bundle carries a non-empty
+        # timeline slice, and the scenario's page bundles cover the
+        # incident instant.
+        assert s.get("bundle_has_timeline"), (name, s)
+        assert s.get("page_bundles", 0) >= 1 \
+            and s.get("page_bundles_with_timeline") == s["page_bundles"], \
+            (name, s)
+        assert s.get("timeline_frames", 0) > 0, (name, s)
+        assert s.get("timeline_covers_incident"), (name, s)
     assert record["all_pass"]
 
 
@@ -51,3 +63,4 @@ def test_committed_artifact_passes():
         assert s["pass"], (name, s)
         assert s["time_to_detect_s"] <= s["slow_window_bound_s"]
         assert s["bundle_offending_traces"] >= 1
+        assert s["bundle_has_timeline"], (name, s)

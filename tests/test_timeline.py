@@ -1,0 +1,459 @@
+"""Timeline store (ISSUE 13): delta correctness vs a hand-rolled
+oracle across ring wrap, multi-resolution rollup, gateway fleet
+aggregation over stub replicas, the anomaly watcher's verdicts, and
+the recorder's timeline-embedding bundles.
+
+Everything here drives ``tick()`` with synthetic wall-clock instants —
+no ticker threads, no sleeps — so window math is exact and the oracle
+comparisons are deterministic.
+"""
+
+import json
+import os
+
+import pytest
+
+from routest_tpu.core.config import (RecorderConfig, TimelineConfig,
+                                     load_timeline_config)
+from routest_tpu.obs.recorder import FlightRecorder
+from routest_tpu.obs.registry import MetricsRegistry
+from routest_tpu.obs.timeline import (AnomalyWatcher, FleetTimelineScraper,
+                                      TimelineStore, bucket_quantile,
+                                      merge_frames)
+
+T0 = 1_700_000_000.0  # any step-aligned instant
+
+
+def _store(reg, res="1x4", **kw):
+    cfg = load_timeline_config({"RTPU_TIMELINE_RES": res})
+    if kw:
+        cfg = TimelineConfig(**{**cfg.__dict__, **kw})
+    return TimelineStore([reg], cfg, component="test")
+
+
+# ── delta correctness vs oracle ──────────────────────────────────────
+
+def test_counter_deltas_match_oracle_across_ring_wrap():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "", ("kind",))
+    store = _store(reg, res="1x4")
+    increments = [3, 0, 7, 2, 5, 1, 4, 9, 6, 8]  # 10 windows, ring of 4
+    store.tick(T0)
+    total = 0
+    for i, inc in enumerate(increments):
+        if inc:
+            c.labels(kind="a").inc(inc)
+        total += inc
+        store.tick(T0 + i + 1)
+    frames = store.frames()
+    # Ring holds exactly the LAST 4 windows, oldest first.
+    assert len(frames) == 4
+    oracle = increments[-4:]
+    for frame, expect in zip(frames, oracle):
+        fam = frame["families"].get("jobs_total")
+        if expect == 0:
+            assert fam is None  # sparse: a quiet window stores nothing
+            continue
+        (row,) = fam["series"]
+        assert row["labels"] == {"kind": "a"}
+        assert row["delta"] == pytest.approx(expect)
+        assert row["rate"] == pytest.approx(expect / frame["dur"])
+    assert [f["t"] for f in frames] == [T0 + i + 1 for i in
+                                        range(6, 10)]
+    # Cumulative state on the registry is untouched by the windowing.
+    assert c.labels(kind="a").value == total
+
+
+def test_histogram_window_percentiles_reflect_only_that_window():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "", ("route",))
+    store = _store(reg, res="1x8")
+    store.tick(T0)
+    for _ in range(50):
+        h.labels(route="/x").observe(0.004)   # fast regime
+    store.tick(T0 + 1)
+    for _ in range(50):
+        h.labels(route="/x").observe(2.0)     # regression regime
+    store.tick(T0 + 2)
+    fast, slow = store.frames()
+    f_row = fast["families"]["lat_seconds"]["series"][0]
+    s_row = slow["families"]["lat_seconds"]["series"][0]
+    assert f_row["count"] == 50 and s_row["count"] == 50
+    # The regression is fully visible in ITS window — not diluted by
+    # the 50 fast observations of the previous one (the cumulative
+    # histogram would report a blended p95 here).
+    assert f_row["p95"] < 0.01
+    assert s_row["p95"] > 1.0
+    assert sum(s_row["buckets"]) == 50
+
+
+def test_multi_resolution_rollup_coarse_equals_sum_of_fine():
+    reg = MetricsRegistry()
+    c = reg.counter("ops_total", "")
+    h = reg.histogram("op_seconds", "")
+    g = reg.gauge("depth", "")
+    store = _store(reg, res="1x8,4x4")
+    store.tick(T0)
+    per_window = [2, 3, 5, 7]
+    for i, n in enumerate(per_window):
+        c.inc(n)
+        for _ in range(n):
+            h.observe(0.01 * (i + 1))
+        g.set(i)
+        store.tick(T0 + i + 1)
+    fine = store.frames(step_s=1)
+    coarse = store.frames(step_s=4)
+    assert len(fine) == 4 and len(coarse) == 1
+    cf = coarse[0]["families"]
+    assert cf["ops_total"]["series"][0]["delta"] == sum(per_window)
+    crow = cf["op_seconds"]["series"][0]
+    assert crow["count"] == sum(per_window)
+    fine_buckets = [f["families"]["op_seconds"]["series"][0]["buckets"]
+                    for f in fine]
+    summed = [sum(col) for col in zip(*fine_buckets)]
+    assert crow["buckets"] == summed
+    # Gauges are last-value, not summed.
+    assert cf["depth"]["series"][0]["value"] == 3.0
+
+
+def test_restarted_series_rebaselines_without_negative_delta():
+    reg = MetricsRegistry()
+    c = reg.counter("n_total", "")
+    store = _store(reg, res="1x4")
+    store.tick(T0)
+    c.inc(5)
+    store.tick(T0 + 1)
+    # Simulate a swapped private registry: cumulative value DROPS.
+    c._default().value = 1.0
+    store.tick(T0 + 2)
+    frames = store.frames()
+    deltas = [f["families"].get("n_total") for f in frames]
+    assert deltas[0]["series"][0]["delta"] == 5.0
+    assert deltas[1] is None  # negative delta suppressed, re-baselined
+
+
+def test_query_window_family_filter_and_step_selection():
+    reg = MetricsRegistry()
+    c = reg.counter("a_total", "")
+    d = reg.counter("b_total", "")
+    store = _store(reg, res="1x16,8x4")
+    store.tick(T0)
+    for i in range(10):
+        c.inc()
+        d.inc(2)
+        store.tick(T0 + i + 1)
+    out = store.query(family="a_", window_s=3.0)
+    assert out["step_s"] == 1.0
+    assert len(out["frames"]) == 3
+    assert all(set(f["families"]) <= {"a_total"}
+               for f in out["frames"])
+    # step=5 picks the largest step ≤ 5 → the 1 s ring; step=8 → 8 s.
+    assert store.query(step_s=5.0)["step_s"] == 1.0
+    assert store.query(step_s=8.0)["step_s"] == 8.0
+    assert store.query(step_s=100.0)["step_s"] == 8.0
+
+
+def test_stalled_ticker_emits_one_honest_wide_frame():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "")
+    store = _store(reg, res="1x8")
+    store.tick(T0)
+    c.inc(6)
+    store.tick(T0 + 3)  # ticker stalled for 3 windows
+    (frame,) = store.frames()
+    assert frame["dur"] == 3.0
+    row = frame["families"]["x_total"]["series"][0]
+    assert row["delta"] == 6.0 and row["rate"] == pytest.approx(2.0)
+
+
+# ── fleet aggregation ────────────────────────────────────────────────
+
+def _stub_frame(t, count, bucket_idx, le=(0.1, 1.0), errors=0.0):
+    buckets = [0, 0, 0]
+    buckets[bucket_idx] = count
+    fams = {
+        "request_duration_seconds": {
+            "kind": "histogram", "le": list(le),
+            "series": [{"labels": {"route": "POST /x"}, "count": count,
+                        "sum": 0.05 * count, "buckets": buckets}]},
+        "requests_total": {
+            "kind": "counter",
+            "series": [{"labels": {}, "delta": float(count),
+                        "rate": float(count)}]},
+    }
+    if errors:
+        fams["request_errors_total"] = {
+            "kind": "counter",
+            "series": [{"labels": {}, "delta": errors, "rate": errors}]}
+    return {"t": t, "dur": 1.0, "families": fams}
+
+
+def test_merge_frames_sums_and_recomputes_percentiles():
+    fast = _stub_frame(T0, 90, 0)   # 90 requests under 0.1 s
+    slow = _stub_frame(T0, 10, 2)   # 10 in the +Inf bucket
+    merged = merge_frames([fast, slow])
+    assert merged["replicas"] == 2
+    assert merged["families"]["requests_total"]["series"][0]["delta"] \
+        == 100.0
+    row = merged["families"]["request_duration_seconds"]["series"][0]
+    assert row["count"] == 100 and row["buckets"] == [90, 0, 10]
+    # Fleet p95 comes from the MERGED distribution: rank 95 lands in
+    # the overflow bucket (clamped to the top bound) — averaging the
+    # two replicas' p95s could never say this.
+    assert row["p95"] == pytest.approx(1.0)
+    assert row["p50"] < 0.1
+
+
+def test_fleet_scraper_aggregates_stub_replicas_and_versions():
+    replies = {
+        "r0": {"component": "replica", "step_s": 1.0,
+               "frames": [_stub_frame(T0, 50, 0),
+                          _stub_frame(T0 + 1, 50, 0)]},
+        "r1": {"component": "replica", "step_s": 1.0,
+               "frames": [_stub_frame(T0 + 1, 50, 2, errors=5.0)]},
+        "r2": {"error": "HTTPException: boom"},
+    }
+    calls = []
+
+    def fetch(path):
+        calls.append(path)
+        return replies
+
+    scraper = FleetTimelineScraper(
+        fetch, load_timeline_config({"RTPU_TIMELINE_RES": "1x8"}),
+        versions_fn=lambda: {"r0": "v1", "r1": "v2"})
+    scraper.scrape()
+    scraper.scrape()  # idempotent: same slots dedupe by t
+    assert calls and "/api/timeline?" in calls[0]
+
+    fleet = scraper.query(scope="fleet")
+    assert fleet["errors"] == {"r2": "HTTPException: boom"}
+    assert [f["t"] for f in fleet["frames"]] == [T0, T0 + 1]
+    both = fleet["frames"][1]
+    assert both["replicas"] == 2
+    row = both["families"]["request_duration_seconds"]["series"][0]
+    assert row["count"] == 100
+    assert row["p95"] == pytest.approx(1.0)  # r1's tail dominates
+
+    per = scraper.query(scope="replicas")["replicas"]
+    assert len(per["r0"]["frames"]) == 2
+    assert len(per["r1"]["frames"]) == 1
+
+    vers = scraper.query(scope="versions")["versions"]
+    assert set(vers) == {"v1", "v2"}
+    v2 = vers["v2"]["frames"][0]["families"]
+    assert v2["request_errors_total"]["series"][0]["delta"] == 5.0
+    # family filter applies to views too
+    only = scraper.query(scope="fleet",
+                         family="request_errors")["frames"]
+    assert all(set(f["families"]) <= {"request_errors_total"}
+               for f in only)
+
+
+def test_fleet_scraper_ring_bounded():
+    t = [T0]
+
+    def fetch(_path):
+        t[0] += 1
+        return {"r0": {"frames": [_stub_frame(t[0], 1, 0)]}}
+
+    scraper = FleetTimelineScraper(
+        fetch, load_timeline_config({"RTPU_TIMELINE_RES": "1x4"}))
+    for _ in range(10):
+        scraper.scrape()
+    assert scraper.snapshot()["replicas"]["r0"] == 4
+
+
+# ── anomaly watcher ──────────────────────────────────────────────────
+
+class _RecorderStub:
+    def __init__(self):
+        self.triggers = []
+
+    def trigger(self, reason, detail=None, force=False, extra_files=None):
+        self.triggers.append((reason, detail))
+        return f"/tmp/{reason}"
+
+
+def _watch_setup(tmp_path=None, **cfg_kw):
+    reg = MetricsRegistry()
+    h = reg.histogram("request_duration_seconds", "", ("route",))
+    e = reg.counter("request_errors_total", "", ("route",))
+    cfg = load_timeline_config({"RTPU_TIMELINE_RES": "1x32"})
+    cfg = TimelineConfig(**{**cfg.__dict__, "watch_baseline_frames": 3,
+                            "watch_cooldown_s": 3600.0, **cfg_kw})
+    store = TimelineStore([reg], cfg, component="test")
+    rec = _RecorderStub()
+    watcher = AnomalyWatcher(store, cfg, rec)
+    return reg, h, e, store, rec, watcher
+
+
+def test_latency_shift_fires_once_and_respects_cooldown():
+    _reg, h, _e, store, rec, watcher = _watch_setup()
+    store.tick(T0)
+    for i in range(4):                      # healthy baseline: ~5 ms
+        for _ in range(20):
+            h.labels(route="/x").observe(0.005)
+        store.tick(T0 + i + 1)
+        assert watcher.check() == []
+    for _ in range(20):                     # regression window: ~2 s
+        h.labels(route="/x").observe(2.0)
+    store.tick(T0 + 5)
+    fired = watcher.check()
+    assert [f["kind"] for f in fired] == ["latency_shift"]
+    assert rec.triggers and rec.triggers[0][0] == "anomaly_latency_shift"
+    assert rec.triggers[0][1]["p95_s"] > 1.0
+    # Same anomaly next window: cooldown suppresses the re-fire.
+    for _ in range(20):
+        h.labels(route="/x").observe(2.0)
+    store.tick(T0 + 6)
+    assert watcher.check() == []
+    assert len(rec.triggers) == 1
+
+
+def test_error_rate_step_fires():
+    _reg, h, e, store, rec, watcher = _watch_setup()
+    store.tick(T0)
+    for i in range(4):
+        for _ in range(20):
+            h.labels(route="/x").observe(0.005)
+        store.tick(T0 + i + 1)
+        watcher.check()
+    for _ in range(20):
+        h.labels(route="/x").observe(0.005)
+    e.labels(route="/x").inc(10)            # 50% errors, baseline 0%
+    store.tick(T0 + 5)
+    kinds = [f["kind"] for f in watcher.check()]
+    assert "error_rate_step" in kinds
+
+
+def test_throughput_collapse_fires_on_empty_window():
+    _reg, h, _e, store, rec, watcher = _watch_setup()
+    store.tick(T0)
+    for i in range(4):
+        for _ in range(30):
+            h.labels(route="/x").observe(0.005)
+        store.tick(T0 + i + 1)
+        watcher.check()
+    store.tick(T0 + 5)                      # nobody served anything
+    kinds = [f["kind"] for f in watcher.check()]
+    assert kinds == ["throughput_collapse"]
+
+
+def test_cache_hit_collapse_fires():
+    reg = MetricsRegistry()
+    hits = reg.counter("rtpu_cache_hits_total", "")
+    miss = reg.counter("rtpu_cache_misses_total", "")
+    cfg = TimelineConfig(**{**load_timeline_config(
+        {"RTPU_TIMELINE_RES": "1x32"}).__dict__,
+        "watch_baseline_frames": 3, "watch_cooldown_s": 3600.0})
+    store = TimelineStore([reg], cfg, component="test")
+    rec = _RecorderStub()
+    watcher = AnomalyWatcher(store, cfg, rec)
+    store.tick(T0)
+    for i in range(4):                      # baseline: 90% hit rate
+        hits.inc(18)
+        miss.inc(2)
+        store.tick(T0 + i + 1)
+        assert watcher.check() == []
+    hits.inc(2)                             # collapse: 10% hit rate
+    miss.inc(18)
+    store.tick(T0 + 5)
+    kinds = [f["kind"] for f in watcher.check()]
+    assert "cache_hit_collapse" in kinds
+
+
+def test_watcher_needs_baseline_before_judging():
+    _reg, h, _e, store, rec, watcher = _watch_setup()
+    store.tick(T0)
+    for _ in range(50):
+        h.labels(route="/x").observe(5.0)   # horrifying, but no baseline
+    store.tick(T0 + 1)
+    assert watcher.check() == []
+    assert rec.triggers == []
+
+
+# ── bundles embed the timeline ───────────────────────────────────────
+
+def test_bundle_embeds_timeline_slice(tmp_path):
+    import time as _time
+
+    reg = MetricsRegistry()
+    c = reg.counter("evidence_total", "")
+    store = TimelineStore(
+        [reg], load_timeline_config({"RTPU_TIMELINE_RES": "1x16"}),
+        component="replica")
+    # Wall-clock-aligned ticks: the bundle query's window trims
+    # relative to NOW (it appends the in-progress partial frame).
+    now = _time.time()
+    t_base = (now // 1.0) * 1.0 - 2.0
+    store.tick(t_base)
+    c.inc(4)
+    store.tick(t_base + 1)
+    recorder = FlightRecorder(RecorderConfig(dir=str(tmp_path),
+                                             min_interval_s=0.0))
+    recorder.register_timeline(store)
+    bundle = recorder.trigger("unit_test", force=True)
+    assert bundle is not None
+    doc = json.load(open(os.path.join(bundle, "timeline.json")))
+    frames = doc["replica"]["frames"]
+    complete = [f for f in frames if not f.get("partial")]
+    assert len(complete) == 1
+    assert complete[0]["families"]["evidence_total"]["series"][0]["delta"] \
+        == 4.0
+    manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+    assert manifest["counts"]["timeline_frames"] == len(frames)
+
+
+def test_recorder_extra_files_land_in_bundle(tmp_path):
+    recorder = FlightRecorder(RecorderConfig(dir=str(tmp_path),
+                                             min_interval_s=0.0))
+    bundle = recorder.trigger(
+        "unit_test", force=True,
+        extra_files={"profile.folded": "main;f 3\n",
+                     "../evil": "clipped to basename"})
+    assert open(os.path.join(bundle, "profile.folded")).read() \
+        == "main;f 3\n"
+    # Path traversal in a name is neutralized to the basename.
+    assert os.path.exists(os.path.join(bundle, "evil"))
+    assert not os.path.exists(os.path.join(str(tmp_path), "evil"))
+
+
+def test_partial_query_shows_in_progress_window(tmp_path):
+    """A bundle written moments after boot (no complete frame yet)
+    still carries the activity that triggered it: the recorder queries
+    with ``partial=True``."""
+    reg = MetricsRegistry()
+    c = reg.counter("fresh_total", "")
+    store = TimelineStore(
+        [reg], load_timeline_config({"RTPU_TIMELINE_RES": "60x8"}),
+        component="replica")
+    store.tick()           # baseline only — no 60 s window has closed
+    c.inc(7)
+    assert store.frames() == []
+    out = store.query(partial=True)
+    assert len(out["frames"]) == 1
+    frame = out["frames"][0]
+    assert frame["partial"] is True
+    assert frame["families"]["fresh_total"]["series"][0]["delta"] == 7.0
+    # And the recorder path embeds exactly this.
+    recorder = FlightRecorder(RecorderConfig(dir=str(tmp_path),
+                                             min_interval_s=0.0))
+    recorder.register_timeline(store)
+    bundle = recorder.trigger("fresh_boot", force=True)
+    doc = json.load(open(os.path.join(bundle, "timeline.json")))
+    assert doc["replica"]["frames"][-1]["partial"] is True
+
+
+# ── helpers ──────────────────────────────────────────────────────────
+
+def test_bucket_quantile_matches_histogram_quantile():
+    reg = MetricsRegistry()
+    h = reg.histogram("q_seconds", "")
+    for v in (0.001, 0.004, 0.004, 0.02, 0.3, 2.0, 70.0):
+        h.observe(v)
+    child = h._default()
+    counts = list(child.counts)
+    for q in (0.5, 0.95, 0.99):
+        assert bucket_quantile(child.buckets, counts, q) \
+            == pytest.approx(child.quantile(q))
