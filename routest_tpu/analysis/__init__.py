@@ -1,0 +1,48 @@
+"""rtpulint: project-native static analysis for routest-tpu.
+
+``python -m routest_tpu.analysis [--gate] [--json] [--rule ID ...]``
+runs two rule families over the whole package in one process, one AST
+parse per file:
+
+- **Invariant lints** (pure AST): ``silent-except``, ``bare-print``,
+  ``broad-except-unlogged``, ``blocking-call-under-lock``,
+  ``thread-unmanaged``, and the JAX hazards ``jit-impure-host-call``,
+  ``jit-host-pull``, ``jit-donated-reuse``.
+- **Drift detectors** (code ↔ registry cross-reference):
+  ``env-knob-undeclared`` / ``env-knob-undocumented`` (reads vs
+  core/config.py and the docs knob tables), ``metric-undocumented`` /
+  ``metric-stale-doc`` (registered families vs docs/OBSERVABILITY.md,
+  both directions), ``api-route-undocumented`` (serve/ route strings
+  vs docs/API.md), and ``chaos-point-undocumented`` /
+  ``chaos-point-collision`` (inject() names vs docs/ROBUSTNESS.md).
+
+Findings carry a rule id, severity, and a one-line fix hint;
+grandfathered findings live in ``analysis/baseline.json`` (reason
+required per entry); deliberate waivers use
+``# rtpulint: disable=<rule> -- <reason>`` at the site. See
+docs/ANALYSIS.md for the catalog and the adding-a-rule recipe.
+"""
+
+from routest_tpu.analysis.engine import (  # noqa: F401
+    AnalysisResult,
+    Corpus,
+    Finding,
+    Rule,
+    all_rules,
+    analyze,
+    load_baseline,
+    load_corpus,
+    repo_root,
+)
+
+__all__ = [
+    "AnalysisResult",
+    "Corpus",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "analyze",
+    "load_baseline",
+    "load_corpus",
+    "repo_root",
+]

@@ -1,0 +1,232 @@
+"""JAX hazard lints: host impurity inside traced code, donated-buffer
+reuse.
+
+A ``@jit``-ted function runs its Python body ONCE at trace time; host
+calls inside it (``time.time()``, ``datetime.now()``, host RNG) bake a
+constant into the compiled program and silently stop varying — the
+classic "my timestamp never changes" production bug. Host ``np.``
+conversion of a traced argument either crashes at trace time or forces
+a device sync; and a buffer passed through ``donate_argnums`` is dead
+the moment the compiled call dispatches — touching it afterwards reads
+garbage (TPU) or deleted-array errors (CPU jax).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from routest_tpu.analysis.engine import (
+    Corpus, Finding, Rule, call_leaf, dotted_name, register,
+)
+
+# Host calls whose value is frozen at trace time inside jit.
+_IMPURE_DOTTED = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "datetime.now",
+    "datetime.utcnow", "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+_IMPURE_PREFIX = ("np.random.", "numpy.random.", "random.")
+
+# Host-side numpy pulls that force/crash on traced values.
+_HOST_PULL = {"np.asarray", "np.array", "np.frombuffer", "np.copy",
+              "numpy.asarray", "numpy.array"}
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dn = dotted_name(target)
+        if dn in ("jit", "jax.jit"):
+            return True
+        # functools.partial(jax.jit, static_argnums=...) decorator form.
+        if isinstance(dec, ast.Call) and dn in ("partial",
+                                                "functools.partial"):
+            if dec.args and dotted_name(dec.args[0]) in ("jit", "jax.jit"):
+                return True
+    return False
+
+
+def _jitted_functions(sf) -> List[ast.AST]:
+    """Functions traced by jit: decorator form plus the call form
+    ``x = jax.jit(fn, ...)`` naming a function defined in this file."""
+    by_name: Dict[str, ast.AST] = {}
+    out: List[ast.AST] = []
+    for node in sf.nodes():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            if _jit_decorated(node):
+                out.append(node)
+    for node in sf.nodes():
+        if (isinstance(node, ast.Call)
+                and dotted_name(node.func) in ("jit", "jax.jit")
+                and node.args and isinstance(node.args[0], ast.Name)):
+            fn = by_name.get(node.args[0].id)
+            if fn is not None and fn not in out:
+                out.append(fn)
+    return out
+
+
+@register(
+    "jit-impure-host-call", "error",
+    "`time.time()` / `datetime.now()` / host RNG inside a jit-traced "
+    "function — the call runs once at trace time and its result is "
+    "baked into the compiled program as a constant",
+    "hoist the host call out of the jitted function and pass the value "
+    "in as an argument (RNG: thread a `jax.random` key)")
+def jit_impure_host_call(rule: Rule, corpus: Corpus) -> Iterator[Finding]:
+    for sf in corpus.files:
+        for fn in _jitted_functions(sf):
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dn = dotted_name(sub.func)
+                if (dn in _IMPURE_DOTTED
+                        or any(dn.startswith(p) for p in _IMPURE_PREFIX)):
+                    yield rule.finding(
+                        sf.relpath, sub.lineno,
+                        f"host call `{dn}` inside jitted "
+                        f"`{getattr(fn, 'name', '?')}` is evaluated at "
+                        f"trace time only")
+
+
+@register(
+    "jit-host-pull", "error",
+    "host `np.` conversion (or `.block_until_ready()`) applied to a "
+    "traced argument inside a jit-traced function — it either raises a "
+    "TracerConversionError at trace time or forces a host sync",
+    "keep the math in jax.numpy inside jit; convert on the host before "
+    "calling, or return the value and convert after")
+def jit_host_pull(rule: Rule, corpus: Corpus) -> Iterator[Finding]:
+    for sf in corpus.files:
+        for fn in _jitted_functions(sf):
+            params = _param_names(fn)
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dn = dotted_name(sub.func)
+                if (dn in _HOST_PULL and sub.args
+                        and isinstance(sub.args[0], ast.Name)
+                        and sub.args[0].id in params):
+                    yield rule.finding(
+                        sf.relpath, sub.lineno,
+                        f"`{dn}({sub.args[0].id})` converts a traced "
+                        f"argument of jitted "
+                        f"`{getattr(fn, 'name', '?')}` on the host")
+                elif call_leaf(sub) == "block_until_ready":
+                    yield rule.finding(
+                        sf.relpath, sub.lineno,
+                        f"`.block_until_ready()` inside jitted "
+                        f"`{getattr(fn, 'name', '?')}` is meaningless "
+                        f"under trace (and a sync point outside it)")
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+@register(
+    "jit-donated-reuse", "error",
+    "a buffer passed at a `donate_argnums` position of a compiled call "
+    "is referenced again afterwards — donation hands the buffer's "
+    "memory to XLA, so the old array is dead the moment the call "
+    "dispatches",
+    "stop touching the donated array after the call (use the call's "
+    "result), or drop donate_argnums for this argument")
+def jit_donated_reuse(rule: Rule, corpus: Corpus) -> Iterator[Finding]:
+    for sf in corpus.files:
+        scopes: List[ast.AST] = [sf.tree] + [
+            n for n in sf.nodes()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from _donated_reuse_in_scope(rule, sf, scope)
+
+
+def _donated_indices(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    if dotted_name(call.func) not in ("jit", "jax.jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    out.append(elt.value)
+            return tuple(out)
+    return None
+
+
+def _scope_nodes(scope: ast.AST) -> List[ast.AST]:
+    """Nodes lexically in ``scope``, not descending into nested
+    function definitions (each gets its own scope pass)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _donated_reuse_in_scope(rule: Rule, sf, scope: ast.AST
+                            ) -> Iterator[Finding]:
+    # Pass 1: names bound to jit(..., donate_argnums=...) in this scope.
+    jitted: Dict[str, Tuple[int, ...]] = {}
+    nodes = _scope_nodes(scope)
+    for node in nodes:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            idx = _donated_indices(node.value)
+            if idx:
+                jitted[node.targets[0].id] = idx
+    if not jitted:
+        return
+    # Pass 2: calls of those names → (buffer var, call line).
+    donations: List[Tuple[str, int]] = []
+    for node in nodes:
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in jitted):
+            for i in jitted[node.func.id]:
+                if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                    donations.append((node.args[i].id, node.lineno))
+    if not donations:
+        return
+    # Pass 3: loads of a donated var strictly after its donating call,
+    # with no rebinding in between.
+    stores: Dict[str, List[int]] = {}
+    loads: Dict[str, List[int]] = {}
+    for node in nodes:
+        if isinstance(node, ast.Name):
+            (stores if isinstance(node.ctx, ast.Store) else loads) \
+                .setdefault(node.id, []).append(node.lineno)
+    for var, call_line in donations:
+        # >= : `buf = compiled(buf, …)` rebinds on the call's own line —
+        # the store target receives the result, so later loads are safe.
+        rebinds = [ln for ln in stores.get(var, []) if ln >= call_line]
+        first_rebind = min(rebinds) if rebinds else None
+        for ln in sorted(loads.get(var, [])):
+            if ln <= call_line:
+                continue
+            if first_rebind is not None and ln >= first_rebind:
+                break
+            yield rule.finding(
+                sf.relpath, ln,
+                f"`{var}` was donated to a compiled call on line "
+                f"{call_line} and is reused here")
+            break  # one finding per donation is enough signal

@@ -102,7 +102,7 @@ class ContinuousTrainer:
                 self._model = dataclasses.replace(model,
                                                   policy=F32_POLICY)
                 self._params = params
-        except Exception:
+        except Exception:  # rtpulint: disable=broad-except-unlogged -- warm-start is best-effort: any load failure falls back to fresh init
             self._model = None  # fresh init below; reason irrelevant
         if self._model is None:
             self._model = RoadGNN(n_nodes=len(self._graph["node_coords"]),

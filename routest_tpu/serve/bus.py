@@ -166,7 +166,7 @@ class RedisBus:
     def ping(self) -> bool:
         try:
             return bool(self._redis.ping())
-        except Exception:
+        except Exception:  # rtpulint: disable=broad-except-unlogged -- health probe: any backend failure maps to unhealthy=False
             return False
 
     @property

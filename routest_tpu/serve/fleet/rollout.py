@@ -89,7 +89,7 @@ def _get_json(port: int, path: str, timeout: float = 3.0) -> Optional[dict]:
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
             return json.loads(resp.read())
-    except Exception:
+    except Exception:  # rtpulint: disable=broad-except-unlogged -- poll helper: unreachable replicas are an expected probing outcome
         return None
 
 

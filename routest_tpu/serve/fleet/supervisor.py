@@ -437,7 +437,7 @@ class ReplicaSupervisor:
             with urllib.request.urlopen(
                     req, timeout=self._probe_timeout_s) as resp:
                 return 200 <= resp.status < 400
-        except Exception:
+        except Exception:  # rtpulint: disable=broad-except-unlogged -- liveness probe: any failure maps to unhealthy=False
             return False
 
     def _backoff_s(self, r: _Replica) -> float:

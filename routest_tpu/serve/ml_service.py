@@ -1015,7 +1015,7 @@ class EtaService:
                      if isinstance(r, dict) and r.get("pallas_tile")}
             return int(rec.get("pallas_wins_max_bucket") or 0), tiles, \
                 provenance
-        except Exception:  # any malformed record means "no recorded win"
+        except Exception:  # rtpulint: disable=broad-except-unlogged -- a malformed bench record means "no recorded win"; provenance keeps the path
             return 0, {}, {"path": path, "backend": None,
                            "recorded_unix": None}
 
@@ -1154,7 +1154,7 @@ class EtaService:
             from routest_tpu.models.gbdt import load_xgboost_eta
 
             self._model, self._params = load_xgboost_eta(path)
-        except Exception:
+        except Exception:  # rtpulint: disable=broad-except-unlogged -- the primary loader's error (first_error) is what health surfaces
             self._error = first_error
 
     def _artifact_mtime_ns(self) -> Optional[int]:
@@ -1271,7 +1271,7 @@ class EtaService:
         if bound > 0 and serving.batcher is not None:
             try:
                 old = self._predict_rows(serving, golden)
-            except Exception:
+            except Exception:  # rtpulint: disable=broad-except-unlogged -- live model unscoreable: the finiteness gate alone decides the swap
                 old = None  # live model unscoreable: finiteness decides
             if old is not None:
                 old = np.asarray(old, np.float64)
@@ -1458,7 +1458,7 @@ class EtaService:
             preds = self._predict_rows(serving, rows)
         except DeadlineExceeded:
             raise  # 504, not "model unavailable": the budget ran out
-        except Exception:
+        except Exception:  # rtpulint: disable=broad-except-unlogged -- degrade contract: a scoring failure serves the route without ML fields
             return None, None
         if preds is None:
             return None, None
@@ -1495,7 +1495,7 @@ class EtaService:
                 return_quantiles=True)
         except DeadlineExceeded:
             raise  # budget expiry must surface as 504, not a null field
-        except Exception:
+        except Exception:  # rtpulint: disable=broad-except-unlogged -- degrade contract: a scoring failure serves the route without ML fields
             # Same degrade-gracefully contract as predict_eta_minutes: a
             # scoring failure is (None, None), never an exception — the
             # route response must still be served without ML fields.

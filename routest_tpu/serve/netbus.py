@@ -58,7 +58,7 @@ class _BrokerHandler(socketserver.StreamRequestHandler):
                 try:
                     msg = json.loads(raw)
                     op = msg.get("op")
-                except Exception:
+                except Exception:  # rtpulint: disable=broad-except-unlogged -- the error IS surfaced: the peer gets a structured bad-json reply
                     self._send({"ok": False, "error": "bad json"})
                     continue
                 if op == "ping":
@@ -312,6 +312,7 @@ class NetBus:
                 try:
                     if self._conn is None:
                         self._conn, self._rfile = self._connect()
+                    # rtpulint: disable=blocking-call-under-lock -- the lock IS the socket's write-serialization point: concurrent publishers must not interleave frames
                     self._conn.sendall(payload)
                 except (ConnectionError, OSError):
                     self._reset()
@@ -477,7 +478,7 @@ class NetBus:
         try:
             return bool(self._command({"op": "ping"},
                                       retry_after_ack_loss=True).get("ok"))
-        except Exception:
+        except Exception:  # rtpulint: disable=broad-except-unlogged -- health probe: any broker failure maps to unhealthy=False
             return False
 
     @property

@@ -1244,8 +1244,8 @@ class Interpreter:
             if isinstance(err, dict):  # Error-shaped: show the payload
                 try:
                     err = _json_stringify(err)
-                except Exception:      # non-JSON members (host objects)
-                    pass               # fall back to [object Object]
+                except (TypeError, ValueError, RecursionError):
+                    pass  # non-JSON members: fall back to [object Object]
             raise JSError(f"unhandled promise rejection: "
                           f"{_js_display(err)}")
 

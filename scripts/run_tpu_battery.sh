@@ -49,6 +49,11 @@ run_step() {
     failed="$failed $name"
   fi
 }
+# Static-analysis gate first (docs/ANALYSIS.md): whole-repo, one
+# process, ~1 s — a drifted knob/metric/route registry or a broken
+# invariant should fail the battery before an hour of bench time is
+# spent producing artifacts for a commit that can't merge anyway.
+run_step rtpulint timeout 60 python -m routest_tpu.analysis --gate
 # Shortest steps first: a tunnel that recovers for only part of the
 # window should still yield the highest-value artifacts (the bench
 # record the driver compares, then the serving-selection table) before

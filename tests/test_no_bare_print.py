@@ -2,48 +2,30 @@
 
 Half the stack used to bypass the structured ``JsonLogger`` with ad-hoc
 status prints (serve/fleet entry points, the netbus broker banner, the
-train loop's epoch lines). Those are structured events now, and this
-test keeps the invariant from regressing: the ONLY permitted ``print``
-call is the logger's own emitter (``utils/logging.py``), which is how
-JSON lines physically reach stderr.
+train loop's epoch lines). Those are structured events now; the
+invariant lives in the rtpulint engine (``bare-print`` in
+``routest_tpu/analysis``, docs/ANALYSIS.md). The only sanctioned print
+call sites are the logger's own emitter (``utils/logging.py`` — how
+JSON lines physically reach stderr) and the lint CLI itself
+(``analysis/__main__.py`` — its stdout IS its interface).
 
-AST-based, not grep-based: strings, comments, and identifiers that
-merely contain "print" (``graph_fingerprint``) must not trip it.
+This file is the tier-1 shim over the rule API; the full gate is
+``tests/test_analysis.py``.
 """
 
-import ast
-import os
-
-import routest_tpu
-
-PKG_ROOT = os.path.dirname(os.path.abspath(routest_tpu.__file__))
-
-# The logger's emitter is the one sanctioned print call site.
-ALLOWED = {os.path.join("utils", "logging.py")}
-
-
-def _print_calls(path):
-    with open(path, "r", encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"):
-            yield node.lineno
+from routest_tpu.analysis import analyze, load_corpus
+from routest_tpu.analysis.invariants import PRINT_ALLOWED
 
 
 def test_no_bare_print_in_package():
-    offenders = []
-    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in filenames:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, PKG_ROOT)
-            if rel in ALLOWED:
-                continue
-            offenders.extend(f"{rel}:{line}" for line in _print_calls(path))
-    assert not offenders, (
-        "bare print() found (use utils.logging.JsonLogger): "
-        + ", ".join(offenders))
+    result = analyze(load_corpus(), rules=["bare-print"])
+    assert not result.findings, (
+        "bare print() found (use utils.logging.JsonLogger):\n"
+        + "\n".join(f.format() for f in result.findings))
+
+
+def test_allowlist_stays_minimal():
+    # The escape hatch must not quietly grow: exactly the JSON-line
+    # emitter and the lint CLI may print.
+    assert PRINT_ALLOWED == {"routest_tpu/utils/logging.py",
+                             "routest_tpu/analysis/__main__.py"}

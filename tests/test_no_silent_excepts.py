@@ -1,137 +1,66 @@
-"""Static sweep: no silent broad exception swallows under ``serve/``
-or ``obs/``.
+"""Static sweep: no silent broad exception swallows — package-wide.
 
 The store used to eat outages with ``except Exception: return False``
 and the bus fell back to in-memory with ``except Exception: pass`` —
-invisible degradation that PR 3's chaos work made observable. This
-sweep keeps the invariant: an ``except`` handler that catches
-``Exception``/``BaseException`` (or is bare) may not have a body of
-just ``pass`` — it must log a structured event, count a metric, or
-re-raise. Narrow handlers (``except OSError: pass`` on a close() path)
-stay legal: swallowing a specific, expected cleanup error is policy,
-swallowing EVERYTHING silently is a bug factory.
+invisible degradation that PR 3's chaos work made observable. The
+invariant lives in the rtpulint engine now (``silent-except`` in
+``routest_tpu/analysis``, docs/ANALYSIS.md): an ``except`` handler that
+catches ``Exception``/``BaseException`` (or is bare) may not have a
+body of just ``pass`` — it must log a structured event, count a metric,
+or re-raise. Narrow handlers (``except OSError: pass`` on a close()
+path) stay legal.
 
-AST-based, like ``test_no_bare_print.py``: comments and strings that
-merely mention excepts must not trip it.
+This file is the tier-1 shim over the rule API: where the pre-engine
+sweep walked a hand-listed set of subdirectories, the rule covers the
+WHOLE package (core/, data/, models/, native/, parallel/, train/,
+utils/ included — widening it surfaced and fixed a real swallow in
+``utils/minijs.py``). The broader gate (every rule, drift detectors
+included) is ``tests/test_analysis.py``.
 """
 
-import ast
 import os
 
 import pytest
 
-import routest_tpu.chaos
-import routest_tpu.live
-import routest_tpu.loadgen
-import routest_tpu.obs
-import routest_tpu.ops
-import routest_tpu.optimize
-import routest_tpu.serve
-import routest_tpu.serve.fleet
-
-SERVE_ROOT = os.path.dirname(os.path.abspath(routest_tpu.serve.__file__))
-# The recorder's trigger paths run during incidents: a silently
-# swallowed bundle-write failure would erase the postmortem evidence
-# exactly when it matters — same invariant, second tree.
-OBS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.obs.__file__))
-# serve/fleet is inside SERVE_ROOT's walk already, but gets its own
-# explicit id: the rollout controller's replace/rollback sequences are
-# exactly where a swallowed failure would leave a fleet half-rolled
-# with nothing in the logs — a failure here must name the tree.
-FLEET_ROOT = os.path.dirname(
-    os.path.abspath(routest_tpu.serve.fleet.__file__))
-# The chaos engine is what every robustness claim leans on; it must
-# never eat its own errors either.
-CHAOS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.chaos.__file__))
-# Live traffic runs on daemon threads (ingest, customize, retrain): a
-# silently swallowed failure there means a silently frozen world —
-# stale metrics serving forever with nothing in the logs.
-LIVE_ROOT = os.path.dirname(os.path.abspath(routest_tpu.live.__file__))
-# The kernel layer's selection fallbacks (fused_kernel_ignored /
-# fused_kernel_unavailable, pack failures) must stay LOUD: a silently
-# swallowed Mosaic failure would quietly serve the slow path while the
-# bench record claims the kernel wins.
-OPS_ROOT = os.path.dirname(os.path.abspath(routest_tpu.ops.__file__))
-# The routing fast path (solve batcher, route fastlane, overlay) sits
-# on every request_route: a silently swallowed solve failure would
-# serve stale or missing routes with nothing in the logs — and the
-# route cache's singleflight MUST propagate leader errors, never eat
-# them.
-OPTIMIZE_ROOT = os.path.dirname(
-    os.path.abspath(routest_tpu.optimize.__file__))
-# The load generator is the measurement instrument: an error it
-# swallows silently becomes a phantom "pass" in a bench artifact.
-LOADGEN_ROOT = os.path.dirname(
-    os.path.abspath(routest_tpu.loadgen.__file__))
-
-BROAD = {"Exception", "BaseException"}
+from routest_tpu.analysis import analyze, load_corpus
 
 
-def _type_names(node):
-    """Exception-type expression → set of dotted-name leaves; None type
-    (bare except) → {"<bare>"}."""
-    if node is None:
-        return {"<bare>"}
-    if isinstance(node, ast.Tuple):
-        out = set()
-        for elt in node.elts:
-            out |= _type_names(elt)
-        return out
-    if isinstance(node, ast.Name):
-        return {node.id}
-    if isinstance(node, ast.Attribute):
-        return {node.attr}
-    return {"<expr>"}
+@pytest.fixture(scope="module")
+def corpus():
+    return load_corpus()
 
 
-def _offenders(path):
-    with open(path, "r", encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        body_is_pass = all(isinstance(stmt, ast.Pass) for stmt in node.body)
-        if not body_is_pass:
-            continue
-        names = _type_names(node.type)
-        if names & BROAD or "<bare>" in names:
-            yield node.lineno
-
-
-@pytest.mark.parametrize("root",
-                         [SERVE_ROOT, OBS_ROOT, FLEET_ROOT, CHAOS_ROOT,
-                          LIVE_ROOT, OPS_ROOT, OPTIMIZE_ROOT,
-                          LOADGEN_ROOT],
-                         ids=["serve", "obs", "fleet", "chaos", "live",
-                              "ops", "optimize", "loadgen"])
-def test_no_silent_broad_excepts(root):
-    offenders = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in filenames:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root)
-            offenders.extend(f"{rel}:{line}" for line in _offenders(path))
-    assert not offenders, (
+def test_no_silent_broad_excepts_package_wide(corpus):
+    result = analyze(corpus, rules=["silent-except"])
+    assert not result.findings, (
         "silent broad except (log a JsonLogger event, count a metric, "
-        "or narrow the type): " + ", ".join(offenders))
+        "or narrow the type):\n"
+        + "\n".join(f.format() for f in result.findings))
 
 
-def test_sweep_sees_the_placement_planner():
+def test_sweep_is_package_wide(corpus):
+    # The pre-engine sweep hand-listed subdirectories and missed new
+    # trees until someone remembered to add them; the rule walks every
+    # package file. Pin that: the corpus must include modules from
+    # trees the old sweep never covered.
+    seen_dirs = {f.relpath.split("/")[1] for f in corpus.files
+                 if f.relpath.count("/") >= 2}
+    for tree in ("core", "utils", "train", "models", "serve", "obs",
+                 "optimize", "live", "loadgen", "chaos", "analysis"):
+        assert tree in seen_dirs, f"corpus misses routest_tpu/{tree}/"
+
+
+def test_sweep_sees_the_placement_planner(corpus):
     # ISSUE-12: the placement planner decides which devices every
     # replica owns — a swallowed failure there strands chips silently.
-    # It lives under serve/fleet, which the "fleet" sweep walks; this
-    # pin fails if the module moves out of the swept tree.
-    assert os.path.exists(os.path.join(FLEET_ROOT, "placement.py"))
+    # This pin fails if the module moves out of the swept package.
+    assert corpus.file("routest_tpu/serve/fleet/placement.py") is not None
 
 
-def test_sweep_sees_the_telemetry_layer():
+def test_sweep_sees_the_telemetry_layer(corpus):
     # ISSUE-13: the timeline ticker, fleet scraper, and triggered
     # profiler all run on daemon threads during incidents — a silently
     # swallowed failure there erases exactly the evidence the incident
-    # needs. They live under obs/, which the "obs" sweep walks; this
-    # pin fails if they move out of the swept tree.
+    # needs.
     for module in ("timeline.py", "profiler.py", "export.py"):
-        assert os.path.exists(os.path.join(OBS_ROOT, module))
+        assert corpus.file(f"routest_tpu/obs/{module}") is not None
