@@ -28,7 +28,7 @@ Registered fault points (see docs/ROBUSTNESS.md for the full table):
   replacement builds): an injected fault degrades exactly like a
   corrupt file — load_error set, the old model keeps serving
 
-Three fault kinds per point, each with its own probability:
+Four fault kinds per point, each with its own probability:
 
 - ``latency`` — sleep ``arg`` milliseconds, then continue (the call
   still happens; stacks with error/drop)
@@ -37,6 +37,14 @@ Three fault kinds per point, each with its own probability:
 - ``drop``    — raise :class:`ChaosConnectionDrop` (a
   ``ConnectionError`` subclass, so existing transport-failure handling
   — gateway retry, breaker charging, store journaling — takes over)
+- ``skew``    — return ``arg`` as a perturbation magnitude the CALL
+  SITE applies to its own result (``inject`` returns the summed fired
+  magnitudes; sites that ignore the return are unaffected). This is
+  the silent-wrongness fault: at ``device.compute`` the batcher adds
+  the magnitude (output minutes) to every scored row, so the replica
+  keeps answering 200s — confidently, and wrong. Nothing inside the
+  serving path can see it; only the blackbox prober's oracle
+  comparison (docs/OBSERVABILITY.md "Synthetic probing") does.
 
 Spec grammar (``RTPU_CHAOS_SPEC``)::
 
@@ -70,7 +78,7 @@ from routest_tpu.utils.logging import get_logger
 
 _log = get_logger("routest_tpu.chaos")
 
-KINDS = ("latency", "error", "drop")
+KINDS = ("latency", "error", "drop", "skew")
 
 
 class ChaosError(RuntimeError):
@@ -176,12 +184,15 @@ class FaultPoint:
         self._rng = random.Random((seed << 32) ^ zlib.crc32(name.encode()))
         self._lock = threading.Lock()
 
-    def fire(self) -> None:
-        """One injection decision: may sleep, may raise. Decisions are
-        made under the lock (one RNG draw per rule per call, in rule
-        order) so the outcome SEQUENCE is deterministic; the sleep and
-        raise happen outside it."""
+    def fire(self) -> float:
+        """One injection decision: may sleep, may raise; returns the
+        summed ``skew`` magnitudes that fired (0.0 normally) for the
+        call site to apply to its own result. Decisions are made under
+        the lock (one RNG draw per rule per call, in rule order) so
+        the outcome SEQUENCE is deterministic; the sleep and raise
+        happen outside it."""
         delay_ms = 0.0
+        skew = 0.0
         exc: Optional[ChaosError] = None
         fired = []
         with self._lock:
@@ -195,6 +206,8 @@ class FaultPoint:
                 fired.append(rule.kind)
                 if rule.kind == "latency":
                     delay_ms += rule.arg_ms
+                elif rule.kind == "skew":
+                    skew += rule.arg_ms
                 elif exc is None:
                     exc = (ChaosError(f"injected error at {self.name}")
                            if rule.kind == "error" else
@@ -206,6 +219,7 @@ class FaultPoint:
             time.sleep(delay_ms / 1000.0)
         if exc is not None:
             raise exc
+        return skew
 
 
 _INJECTIONS = get_registry().counter(
@@ -229,12 +243,17 @@ class ChaosEngine:
             _log.warning("chaos_enabled", seed=seed,
                          points=sorted(self._points))
 
-    def inject(self, name: str) -> None:
+    def inject(self, name: str) -> float:
+        """→ the summed ``skew`` magnitudes that fired (0.0 when the
+        point is unconfigured or nothing fired); may sleep or raise
+        for the other kinds. Call sites that ignore the return keep
+        their historical latency/error/drop semantics untouched."""
         if not self.enabled:
-            return
+            return 0.0
         point = self._points.get(name)
-        if point is not None:
-            point.fire()
+        if point is None:
+            return 0.0
+        return point.fire()
 
     def record(self, name: str, kind: str) -> None:
         """Ledger entry for a fault actuated OUTSIDE the engine (e.g.
@@ -283,9 +302,11 @@ def configure(engine: Optional[ChaosEngine]) -> None:
         _engine = engine
 
 
-def inject(name: str) -> None:
-    """Module-level convenience: ``chaos.inject("store.http")``."""
-    get_chaos().inject(name)
+def inject(name: str) -> float:
+    """Module-level convenience: ``chaos.inject("store.http")``.
+    Returns the fired ``skew`` magnitude (0.0 normally) — only sites
+    that can meaningfully perturb their result read it."""
+    return get_chaos().inject(name)
 
 
 def current_engine() -> Optional[ChaosEngine]:

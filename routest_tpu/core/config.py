@@ -360,6 +360,47 @@ class ProfileConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ProberConfig:
+    """In-fleet blackbox prober (``routest_tpu/obs/prober.py``): low-rate
+    synthetic requests through the real gateway→replica path — the
+    golden ETA batch against pinned expected bands, pinned route/matrix
+    probes against a scipy oracle re-derived per metric epoch, and a
+    fan-out consistency probe comparing every replica's answer, model
+    identity, and metric epoch directly. All knobs are ``RTPU_PROBER_*``
+    env vars; disabled by default (armed with ``RTPU_PROBER=1`` on the
+    gateway tier).
+
+    ``eta_tolerance`` is the golden-probe divergence bound in output
+    minutes; 0 derives it from the swap gate's own margin
+    (``RTPU_SWAP_MAX_DIV``), so a model the verified-swap gate would
+    accept never trips the prober, and one past the gate's tolerance
+    always does. ``skew_after`` consecutive fan-out mismatches are
+    required before a skew verdict — a metric flip or verified swap
+    propagating across replicas is a transient, not an incident —
+    and ``epoch_gap`` is the stale-epoch distance (fleet max − replica)
+    that counts as a mismatch at all (staggered customize timers sit
+    at gap ≤ 1 forever in a healthy fleet)."""
+
+    enabled: bool = False
+    interval_s: float = 5.0
+    timeout_s: float = 10.0
+    eta_tolerance: float = 0.0     # minutes; 0 = the swap-gate margin
+    route_tolerance_rel: float = 2e-3
+    routes: str = ""               # "lat,lon|lat,lon;…" pinned OD pairs
+    skew_after: int = 3
+    epoch_gap: int = 2
+    backoff_cap_s: float = 60.0
+    failures_kept: int = 16
+    subgraph_max_edges: int = 100_000
+    # The correctness SLO over probe verdicts: target fraction of
+    # passing probes, evaluated by a dedicated burn-rate engine with
+    # probe-scale windows (probes run at ~0.2/s, not ~100/s).
+    slo_target: float = 0.99
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+
+
+@dataclasses.dataclass(frozen=True)
 class SloConfig:
     """SLO engine (``routest_tpu/obs/slo.py``): per-route objectives
     evaluated over rolling multi-window burn rates (Google SRE workbook
@@ -738,6 +779,32 @@ def load_slo_config(env: Optional[Mapping[str, str]] = None) -> SloConfig:
         page_burn=_env_num(env, "RTPU_SLO_PAGE_BURN", 14.4, float),
         warn_burn=_env_num(env, "RTPU_SLO_WARN_BURN", 6.0, float),
         objectives=env.get("RTPU_SLO_OBJECTIVES", ""),
+    )
+
+
+def load_prober_config(
+        env: Optional[Mapping[str, str]] = None) -> ProberConfig:
+    """Just the blackbox-prober knobs (read lazily by the gateway's
+    serve() and ``routest_tpu/obs/prober.py``)."""
+    env = dict(env if env is not None else os.environ)
+    return ProberConfig(
+        enabled=env.get("RTPU_PROBER", "0") == "1",
+        interval_s=_env_num(env, "RTPU_PROBER_INTERVAL_S", 5.0, float),
+        timeout_s=_env_num(env, "RTPU_PROBER_TIMEOUT_S", 10.0, float),
+        eta_tolerance=_env_num(env, "RTPU_PROBER_ETA_TOL_MIN", 0.0, float),
+        route_tolerance_rel=_env_num(env, "RTPU_PROBER_ROUTE_TOL_REL",
+                                     2e-3, float),
+        routes=env.get("RTPU_PROBER_ROUTES", ""),
+        skew_after=_env_num(env, "RTPU_PROBER_SKEW_AFTER", 3, int),
+        epoch_gap=_env_num(env, "RTPU_PROBER_EPOCH_GAP", 2, int),
+        backoff_cap_s=_env_num(env, "RTPU_PROBER_BACKOFF_CAP_S",
+                               60.0, float),
+        failures_kept=_env_num(env, "RTPU_PROBER_FAILURES_KEPT", 16, int),
+        subgraph_max_edges=_env_num(env, "RTPU_PROBER_SUBGRAPH_MAX_EDGES",
+                                    100_000, int),
+        slo_target=_env_num(env, "RTPU_PROBER_SLO_TARGET", 0.99, float),
+        fast_window_s=_env_num(env, "RTPU_PROBER_FAST_S", 60.0, float),
+        slow_window_s=_env_num(env, "RTPU_PROBER_SLOW_S", 600.0, float),
     )
 
 

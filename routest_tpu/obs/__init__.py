@@ -22,9 +22,14 @@ workbook ch. 5, the Monarch in-process-TSDB lineage):
   rings that dump self-contained postmortem bundles (now embedding the
   timeline slice) on trigger;
 - ``profiler`` — triggered on-path stack-sample captures, armed by the
-  SLO warn/page edge or ``POST /api/debug/profile``.
+  SLO warn/page edge or ``POST /api/debug/profile``;
+- ``prober``  — the in-fleet blackbox prober: low-rate synthetic
+  requests through the real gateway→replica path judged against
+  pinned/oracle expectations, rolled into a correctness SLO whose
+  page ships the offending probe/oracle pair as evidence.
 
-``slo``, ``timeline``, ``profiler``, and ``recorder`` import lazily
+``slo``, ``timeline``, ``profiler``, ``prober``, and ``recorder``
+import lazily
 (``from routest_tpu.obs.slo import …``) — they pull ``core.config``,
 which the spine itself must not. Everything here is stdlib-only (the
 fleet gateway imports it) and safe to call on hot paths: an unsampled

@@ -85,6 +85,9 @@ class TailSampler:
       the burn-rate engine alerts on; ``default_slow_ms`` covers routes
       with no objective);
     - **error** — any span in the trace finished with status ``error``;
+    - **probe** — the root carries the blackbox prober's ``probe``
+      attr (tagged ``X-RTPU-Probe`` traffic): always kept, so a
+      correctness page can point at the offending probe's trace;
     - **reservoir** — a small random fraction of normal traces is kept
       anyway, so the buffer stays representative of healthy traffic;
     - otherwise the whole trace is dropped.
@@ -196,6 +199,11 @@ class TailSampler:
             reason = "error"
         elif duration_ms >= self.slow_threshold_ms(path):
             reason = "slow"
+        elif (rec.get("attrs") or {}).get("probe"):
+            # Blackbox-probe traces are always retained: probes run at
+            # a bounded low rate, and a correctness-page bundle must be
+            # able to point at the offending probe's kept trace.
+            reason = "probe"
         elif self._rng.random() < self.reservoir:
             reason = "reservoir"
         else:

@@ -532,3 +532,56 @@ def build_gateway_engine(config: Optional[SloConfig] = None) -> SloEngine:
         "rtpu_gateway_request_errors_total",
         defaults=GATEWAY_DEFAULT_OBJECTIVES)
     return engine
+
+
+# ── correctness SLOs over blackbox-probe verdicts ────────────────────
+
+def probe_verdict_source(registry: MetricsRegistry, probe: str) -> Source:
+    """(total, bad) over ``rtpu_probe_checks_total`` for one probe
+    kind: total = every verdict, bad = every non-``pass`` verdict
+    (divergent, skew, unreachable — to the correctness objective they
+    are one thing: the system could not prove its answer right)."""
+
+    def read() -> Tuple[float, float]:
+        m = registry.get("rtpu_probe_checks_total")
+        if m is None:
+            return 0.0, 0.0
+        pi = m.labelnames.index("probe")
+        vi = m.labelnames.index("verdict")
+        total = bad = 0.0
+        for key, child in m.items():
+            if key[pi] != probe:
+                continue
+            total += child.value
+            if key[vi] != "pass":
+                bad += child.value
+        return total, bad
+
+    return read
+
+
+def build_prober_engine(prober_config, kinds: Sequence[str],
+                        registry: Optional[MetricsRegistry] = None
+                        ) -> SloEngine:
+    """The blackbox prober's dedicated engine (component ``prober``):
+    one ``correctness:<kind>`` objective per armed probe kind, over
+    probe-scale windows (probes run at ~0.2/s; judging them on the
+    user-traffic windows would take an hour of evidence to page). The
+    engine is ticked by the probe loop itself — no second ticker —
+    and its page edges ship the ``correctness_page`` evidence bundle.
+    Kept here so every burn-rate objective in the system is declared
+    through one module, whatever it measures."""
+    reg = registry if registry is not None else get_registry()
+    cfg = SloConfig(
+        enabled=True, tick_s=0.0,
+        fast_window_s=prober_config.fast_window_s,
+        slow_window_s=prober_config.slow_window_s,
+        page_burn=SloConfig.page_burn, warn_burn=SloConfig.warn_burn)
+    engine = SloEngine(config=cfg, component="prober")
+    for kind in kinds:
+        engine.add_objective(SloObjective(
+            f"correctness:{kind}", "correctness",
+            prober_config.slo_target,
+            probe_verdict_source(reg, kind),
+            detail={"probe": kind}))
+    return engine

@@ -866,6 +866,60 @@ def create_app(config: Optional[Config] = None,
         return ({"armed": armed, "profiler": app.profiler.snapshot()},
                 202 if armed else 409)
 
+    @app.route("/api/debug/probe_subgraph", methods=("GET",))
+    def probe_subgraph(request):
+        # The blackbox prober's oracle feed (docs/OBSERVABILITY.md
+        # "Synthetic probing & correctness SLOs"): the road graph's
+        # edge topology in graph edge order — the SAME order
+        # /api/live?metric=1 exports its per-edge seconds in — plus
+        # the probe waypoints' snapped node indices and snap
+        # distances, so an external scipy Dijkstra can re-derive the
+        # served answers exactly. Fetched once at prober arm time;
+        # bounded by RTPU_PROBER_SUBGRAPH_MAX_EDGES (a metro-scale
+        # graph is armed out-of-band, not shipped per request).
+        from routest_tpu.core.config import load_prober_config
+        from routest_tpu.optimize import road_router as _rr
+
+        router = _rr._default_router
+        if router is None:
+            return {"error": "no road router built"}, 503
+        n_edges = int(len(router.senders))
+        max_edges = load_prober_config().subgraph_max_edges
+        if n_edges > max_edges:
+            return {"error": f"graph too large to export ({n_edges} "
+                             f"edges > RTPU_PROBER_SUBGRAPH_MAX_EDGES="
+                             f"{max_edges})"}, 413
+        latlon = []
+        for raw in request.args.getlist("wp"):
+            lat, sep, lon = raw.partition(",")
+            try:
+                if not sep:
+                    raise ValueError(raw)
+                latlon.append((float(lat), float(lon)))
+            except ValueError:
+                return {"error": f"malformed wp {raw!r}: want "
+                                 "lat,lon"}, 400
+        out = {
+            "nodes": int(router.n_nodes),
+            "edges": n_edges,
+            "senders": np.asarray(router.senders).tolist(),
+            "receivers": np.asarray(router.receivers).tolist(),
+            "snapped": [],
+            "snap_m": [],
+        }
+        if latlon:
+            from routest_tpu.data.road_graph import haversine_np
+
+            pts = np.asarray(latlon, np.float32)
+            snapped = np.asarray(router.snap(pts), np.int64)
+            snap_m = haversine_np(
+                pts[:, 0].astype(np.float64),
+                pts[:, 1].astype(np.float64),
+                router.coords[snapped, 0], router.coords[snapped, 1])
+            out["snapped"] = snapped.tolist()
+            out["snap_m"] = [round(float(v), 3) for v in snap_m]
+        return out, 200
+
     @app.route("/api/debug/snapshot", methods=("POST",))
     def debug_snapshot(request):
         # Manual postmortem trigger (same bundle the automatic

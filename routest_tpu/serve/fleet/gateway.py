@@ -248,6 +248,16 @@ class Gateway:
             "rtpu_gateway_version_request_errors_total",
             "Gateway responses with status >= 500, by route and "
             "serving version.", ("route", "version"))
+        # Probe traffic (X-RTPU-Probe) is diverted HERE instead of the
+        # per-route request families above — the exclusion happens
+        # before any SLO rollup, so synthetic probe load can never
+        # burn user error budget (docs/OBSERVABILITY.md "Synthetic
+        # probing & correctness SLOs").
+        self._m_probe_requests = reg.counter(
+            "rtpu_probe_gateway_requests_total",
+            "Probe-tagged requests handled by the gateway (excluded "
+            "from the user per-route request families), by route.",
+            ("route",))
         self._m_replicas = reg.gauge(
             "rtpu_fleet_replicas",
             "Replicas registered with the gateway (draining excluded).")
@@ -306,6 +316,10 @@ class Gateway:
         self.timeline = None
         self.fleet_timeline = None
         self.watcher = None
+        # Blackbox prober (docs/OBSERVABILITY.md "Synthetic probing &
+        # correctness SLOs"): armed in serve() when RTPU_PROBER=1 —
+        # it needs the gateway's own listen address to probe through.
+        self.prober = None
 
     # ── admission control ─────────────────────────────────────────────
 
@@ -654,9 +668,17 @@ class Gateway:
                                               headers, deadline_ms)
         seconds = time.perf_counter() - t0
         route = _route_label(path.split("?", 1)[0])
-        self._m_requests.labels(route=route).observe(seconds)
-        if status >= 500:
-            self._m_request_errors.labels(route=route).inc()
+        probe = next((v for k, v in headers.items()
+                      if k.lower() == "x-rtpu-probe"), None)
+        if probe:
+            # Tag-and-exclude: probe traffic lands in its own family,
+            # BEFORE the per-route user families the SLO engine rolls
+            # up — a probe-only error storm leaves user SLO state ok.
+            self._m_probe_requests.labels(route=route).inc()
+        else:
+            self._m_requests.labels(route=route).observe(seconds)
+            if status >= 500:
+                self._m_request_errors.labels(route=route).inc()
         rid = trace_id = replica_id = None
         for k, v in rh:
             lk = k.lower()
@@ -666,6 +688,8 @@ class Gateway:
                 trace_id = v
             elif lk == "x-rtpu-replica":
                 replica_id = v
+        if probe:
+            replica_id = None      # version families are user-facing too
         if replica_id is not None:
             # Version-labeled mirror of the per-route families: which
             # serving VERSION answered (the replica tag is stamped by
@@ -682,7 +706,8 @@ class Gateway:
         self._recorder.record_request(
             tier="gateway", method=method, path=path.split("?", 1)[0],
             status=status, duration_ms=seconds * 1000.0,
-            request_id=rid, trace_id=trace_id, deadline_ms=deadline_ms)
+            request_id=rid, trace_id=trace_id, deadline_ms=deadline_ms,
+            extra={"probe": probe} if probe else None)
         return status, rh, data
 
     def _handle_inner(self, method: str, path: str, body: Optional[bytes],
@@ -717,6 +742,11 @@ class Gateway:
         with trace_span("gateway.request", parent=client_ctx,
                         method=method, path=path.split("?", 1)[0],
                         request_id=rid) as root:
+            if low.get("x-rtpu-probe"):
+                # Probe provenance on the root span: tail sampling
+                # retains probe traces (``tail: probe``) so a failing
+                # probe's evidence bundle can point at a kept trace.
+                root.set_attr("probe", low["x-rtpu-probe"])
             t_admit = time.perf_counter()
             admitted, status = self._admit(deadline)
             self._m_admit_wait.observe(time.perf_counter() - t_admit)
@@ -942,6 +972,14 @@ class Gateway:
         instead of failing the whole endpoint."""
         return self._fetch_replica_json("/api/metrics")
 
+    def _probe_targets(self) -> List[Tuple[str, str]]:
+        """The fan-out probe's target set: every non-draining replica
+        (sick replicas included — an ejected replica is exactly what
+        the prober must keep interrogating)."""
+        with self._lock:
+            return [(r.id, r.base) for r in self.replicas
+                    if not r.draining]
+
     def _fetch_replica_json(self, path: str) -> dict:
         """GET ``path`` from every replica → {replica_id: parsed JSON};
         unreachable replicas report the error in place."""
@@ -999,6 +1037,8 @@ class Gateway:
                     return self._timeline()
                 if bare == "/api/slo":
                     return self._slo()
+                if bare == "/api/probes":
+                    return self._probes()
                 if bare == "/api/autoscale":
                     return self._autoscale()
                 if bare == "/api/rollout":
@@ -1051,6 +1091,18 @@ class Gateway:
                 if "replicas=1" in self.path:
                     payload["replica_slo"] = gw._fetch_replica_json(
                         "/api/slo")
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
+
+            def _probes(self):
+                """Blackbox-prober state (docs/OBSERVABILITY.md
+                "Synthetic probing & correctness SLOs"): armed probe
+                kinds, last verdict per kind, oracle arm state, recent
+                failure count, and the dedicated correctness SLO
+                engine's burn-rate snapshot."""
+                payload = {"enabled": False} if gw.prober is None \
+                    else gw.prober.snapshot()
                 self._respond(200,
                               [("Content-Type", "application/json")],
                               json.dumps(payload, default=str).encode())
@@ -1283,6 +1335,24 @@ class Gateway:
                     rid: v or "unversioned"
                     for rid, v in self._version_by_rid.items()})
             self.fleet_timeline.start()
+        # Blackbox prober: synthetic correctness checks through this
+        # gateway's OWN listen address (the real client path) plus
+        # direct per-replica fan-out (docs/OBSERVABILITY.md
+        # "Synthetic probing & correctness SLOs"). RTPU_PROBER=1 arms.
+        from routest_tpu.core.config import load_prober_config
+
+        prober_cfg = load_prober_config()
+        if prober_cfg.enabled and self.prober is None:
+            from routest_tpu.obs.prober import BlackboxProber
+
+            probe_host = "127.0.0.1" if host in ("", "0.0.0.0") else host
+            self.prober = BlackboxProber(
+                prober_cfg,
+                gateway_base=(f"http://{probe_host}:"
+                              f"{httpd.server_address[1]}"),
+                targets_fn=self._probe_targets,
+                recorder=self._recorder)
+            self.prober.start()
         thread = threading.Thread(target=httpd.serve_forever, daemon=True,
                                   name="fleet-gateway")
         thread.start()
@@ -1304,6 +1374,8 @@ class Gateway:
             time.sleep(0.05)
         if self.slo is not None:
             self.slo.stop()
+        if self.prober is not None:
+            self.prober.stop()
         if self.timeline is not None:
             self.timeline.stop()
         if self.fleet_timeline is not None:

@@ -601,13 +601,19 @@ class DynamicBatcher:
                                     bucket=bucket) as ds:
                         # Chaos fault point: an injected error here is
                         # indistinguishable from a dead device — every
-                        # waiter in this batch must surface it.
-                        chaos_inject("device.compute")
+                        # waiter in this batch must surface it. A
+                        # ``skew`` fault returns a magnitude applied to
+                        # the scored outputs below: a silently-wrong
+                        # device, which nothing in-process can notice
+                        # (the blackbox prober's target fault).
+                        skew = chaos_inject("device.compute")
                         # xplane capture budget permitting, a sampled
                         # flush also records the device trace that
                         # explains it (one trace id across both).
                         with maybe_device_trace(ds):
                             preds = np.asarray(self._score(padded))[:n]
+                        if skew:
+                            preds = preds + skew
                     if batch_slab is not None and \
                             np.shares_memory(preds, batch_slab):
                         # A host score_fn may hand back a view of its

@@ -86,6 +86,17 @@ class App:
             "rtpu_replica_expired_total",
             "Requests rejected with 504: deadline already expired at "
             "the replica edge.")
+        # Probe traffic (X-RTPU-Probe header) counts HERE instead of
+        # the per-route request-stat families the SLO engine rolls up:
+        # synthetic probe load must never burn user error budget
+        # (docs/OBSERVABILITY.md "Synthetic probing"). The exclusion
+        # happens at record time — BEFORE any rollup — so no window of
+        # any burn-rate objective ever contains a probe.
+        self._m_probe = get_registry().counter(
+            "rtpu_probe_replica_requests_total",
+            "Probe-tagged requests served by this replica (excluded "
+            "from the user request-stat families), by route.",
+            ("route",))
 
     @property
     def inflight(self) -> int:
@@ -149,6 +160,12 @@ class App:
         # whole budget chain exists to prevent.
         raw_deadline = request.headers.get(DEADLINE_HEADER)
         deadline_ms = parse_deadline_ms(raw_deadline) if raw_deadline else None
+        # Synthetic-probe tag: stamped on the request object so the
+        # route-stats record sites below (edge 504 and handler finally)
+        # divert to the probe family, and onto the root span so tail
+        # sampling can retain the probe's trace (``tail: probe``).
+        probe_kind = request.headers.get("X-RTPU-Probe") or None
+        request._rtpu_probe = probe_kind
         with self._inflight_lock:
             self._inflight += 1
         t0 = time.perf_counter()
@@ -156,6 +173,8 @@ class App:
             with trace_span("replica.request", parent=remote_ctx,
                             method=request.method, path=request.path,
                             request_id=rid) as span:
+                if probe_kind:
+                    span.set_attr("probe", probe_kind)
                 dl_token = None
                 try:
                     if deadline_ms is not None and deadline_ms <= 0:
@@ -167,9 +186,11 @@ class App:
                         # burn-rate window.
                         _fn, template, _kw, _al = self._match(
                             request.method, request.path)
-                        self.request_stats.add(
-                            f"{request.method} {template or request.path}",
-                            0.0, error=True)
+                        route = f"{request.method} {template or request.path}"
+                        if probe_kind:
+                            self._m_probe.labels(route=route).inc()
+                        else:
+                            self.request_stats.add(route, 0.0, error=True)
                         response = json_response(
                             {"error": "deadline exceeded",
                              "deadline_ms": deadline_ms}, 504)
@@ -199,7 +220,8 @@ class App:
                 status=response.status_code,
                 duration_ms=(time.perf_counter() - t0) * 1000.0,
                 request_id=rid, trace_id=span.trace_id,
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms,
+                extra={"probe": probe_kind} if probe_kind else None)
             return response(environ, start_response)
         finally:
             with self._inflight_lock:
@@ -255,8 +277,15 @@ class App:
             # connection time, not handler latency — skip them.
             if response is None or not response.is_streamed:
                 error = response is None or response.status_code >= 500
-                self.request_stats.add(f"{request.method} {template}",
-                                       time.perf_counter() - t0, error=error)
+                route = f"{request.method} {template}"
+                if getattr(request, "_rtpu_probe", None):
+                    # Probe traffic: its own family, never the user
+                    # request stats the SLO engine rolls up.
+                    self._m_probe.labels(route=route).inc()
+                else:
+                    self.request_stats.add(route,
+                                           time.perf_counter() - t0,
+                                           error=error)
 
     @staticmethod
     def _apply_cors(request: Request, response: Response) -> None:

@@ -80,6 +80,14 @@ run_step fleet_chips timeout 2400 python scripts/bench_fleet_chips.py
 # a slow trace with provenance, and captured in a bundle embedding the
 # timeline slice (artifacts/telemetry.json).
 run_step telemetry timeout 1500 python scripts/bench_telemetry.py
+# Blackbox probing end-to-end (ISSUE 15): three injected correctness
+# faults (compute skew, stale metric epoch, divergent model) must each
+# page the prober's correctness SLO with a bundle naming the faulty
+# replica; the clean run stays green across a metric flip and a
+# verified swap (artifacts/probing.json). The probe-subgraph extract +
+# overlay + XLA caches persist under artifacts/bench_cache/probing so
+# later battery rounds skip the cold hierarchy build.
+run_step probing timeout 2400 python scripts/bench_probing.py
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
 run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
   --osm-nodes 250000 --verify --flat-compare
