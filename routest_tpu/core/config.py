@@ -492,6 +492,33 @@ class LiveConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DispatchConfig:
+    """Dispatch workload (``routest_tpu/dispatch``): batched VRP serving
+    over ``POST /api/dispatch`` with live re-optimization. All knobs are
+    ``RTPU_DISPATCH_*`` env vars.
+
+    ``max_rows`` bounds one merged batcher drain; ``window_s`` adds a
+    fixed pre-drain wait (0 = natural batching only); ``max_stops``
+    bounds stops per problem (fixed-shape padding ceiling).
+    ``reopt``/``reopt_poll_s``/``degrade_ratio`` drive the
+    re-optimization loop: every ``reopt_poll_s`` the loop checks the
+    live metric epoch, and on a flip re-solves exactly the active
+    dispatches whose corridor cost degraded past ``degrade_ratio`` ×
+    baseline. ``speed_mps > 0`` overrides the vehicle-profile speed
+    when pricing geographic corridors into travel seconds."""
+
+    enabled: bool = True
+    max_rows: int = 64
+    window_s: float = 0.0
+    max_stops: int = 32
+    reopt: bool = True
+    reopt_poll_s: float = 1.0
+    degrade_ratio: float = 1.2
+    max_active: int = 256
+    speed_mps: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ChaosConfig:
     """Fault injection (``routest_tpu/chaos``): a seeded, deterministic
     chaos layer wrapping every IO boundary. Disabled unless
@@ -517,6 +544,8 @@ class Config:
         default_factory=RolloutConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     live: LiveConfig = dataclasses.field(default_factory=LiveConfig)
+    dispatch: DispatchConfig = dataclasses.field(
+        default_factory=DispatchConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     recorder: RecorderConfig = dataclasses.field(
@@ -645,6 +674,7 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
                   fleet=fleet, autoscale=load_autoscale_config(env),
                   rollout=load_rollout_config(env),
                   obs=obs, live=load_live_config(env),
+                  dispatch=load_dispatch_config(env),
                   chaos=load_chaos_config(env),
                   slo=load_slo_config(env),
                   recorder=load_recorder_config(env),
@@ -670,6 +700,26 @@ def load_live_config(env: Optional[Mapping[str, str]] = None) -> LiveConfig:
         retrain_steps=_env_num(env, "RTPU_LIVE_RETRAIN_STEPS", 40, int),
         retrain_min_obs=_env_num(env, "RTPU_LIVE_RETRAIN_MIN_OBS",
                                  256, int),
+    )
+
+
+def load_dispatch_config(
+        env: Optional[Mapping[str, str]] = None) -> DispatchConfig:
+    """Just the dispatch knobs (read by ``serve/app.py`` bring-up and
+    the dispatch bench without paying for a full Config build)."""
+    env = dict(env if env is not None else os.environ)
+    return DispatchConfig(
+        enabled=env.get("RTPU_DISPATCH", "1") != "0",
+        max_rows=_env_num(env, "RTPU_DISPATCH_MAX_ROWS", 64, int),
+        window_s=_env_num(env, "RTPU_DISPATCH_WINDOW_S", 0.0, float),
+        max_stops=_env_num(env, "RTPU_DISPATCH_MAX_STOPS", 32, int),
+        reopt=env.get("RTPU_DISPATCH_REOPT", "1") != "0",
+        reopt_poll_s=_env_num(env, "RTPU_DISPATCH_REOPT_POLL_S",
+                              1.0, float),
+        degrade_ratio=_env_num(env, "RTPU_DISPATCH_DEGRADE_RATIO",
+                               1.2, float),
+        max_active=_env_num(env, "RTPU_DISPATCH_MAX_ACTIVE", 256, int),
+        speed_mps=_env_num(env, "RTPU_DISPATCH_SPEED_MPS", 0.0, float),
     )
 
 

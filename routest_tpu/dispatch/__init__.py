@@ -1,0 +1,34 @@
+"""Dispatch as a first-class workload (ISSUE 16).
+
+The source paper's actual product — capacity-constrained multi-stop
+dispatch streamed to a driver simulation — served the way this repo
+serves everything else: batched onto the device, watched by the live
+metric, probed for correctness.
+
+- ``batcher.py``  — concurrent ``POST /api/dispatch`` requests merge
+  into one padded batch through the vmapped dispatch solver
+  (``optimize/vrp.py`` time-window / demand-spillover variants);
+- ``registry.py`` — confirmed dispatches register their corridor,
+  plan, baseline cost, SSE channel and replay seed;
+- ``reopt.py``    — on every live-metric epoch flip, corridors
+  re-price; plans degraded past the threshold re-solve in one batched
+  pass and the update streams out as a ``plan_update`` SSE event.
+
+Serving wiring lives in ``serve/app.py`` (``/api/dispatch``); knobs are
+``RTPU_DISPATCH_*`` (``core/config.py``); chaos points are
+``dispatch.solve`` and ``dispatch.resolve`` (docs/ROBUSTNESS.md).
+"""
+
+from routest_tpu.dispatch.batcher import DispatchBatcher, DispatchProblem
+from routest_tpu.dispatch.registry import (ActiveDispatch,
+                                           DispatchRegistry)
+from routest_tpu.dispatch.reopt import ReoptLoop, plan_cost
+
+__all__ = [
+    "ActiveDispatch",
+    "DispatchBatcher",
+    "DispatchProblem",
+    "DispatchRegistry",
+    "ReoptLoop",
+    "plan_cost",
+]

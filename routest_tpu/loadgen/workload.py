@@ -140,6 +140,29 @@ class ZipfODWorkload:
             body["road_graph"] = True
         return body
 
+    def dispatch_body_for_pair(self, pair_id: int,
+                               stops: int = 4) -> dict:
+        """A ``/api/dispatch``-shaped body over the same pair
+        vocabulary: the depot is the pair's Zipf-sampled origin, the
+        stop set walks the location list from the pair's target, and
+        payloads hash off ``(pair_id, k)`` — so a hot depot repeats as
+        a byte-identical dispatch problem (the batcher merges them
+        into one device batch)."""
+        i, j = self.pairs[int(pair_id)]
+        _, lat1, lon1 = self._locations[i]
+        dests = []
+        for k in range(stops):
+            _, lat, lon = self._locations[(j + k) % len(self._locations)]
+            dests.append({"lat": lat, "lon": lon,
+                          "payload": 1 + (pair_id + k) % 3})
+        return {
+            "source_point": {"lat": lat1, "lon": lon1},
+            "destination_points": dests,
+            "driver_details": {"vehicle_type": "car",
+                               "vehicle_capacity": 6,
+                               "maximum_distance": 300_000},
+        }
+
 
 DEFAULT_MIX: Dict[str, float] = {
     "predict_eta": 0.85,
@@ -155,11 +178,13 @@ class MixedWorkload:
     ``predict_eta`` (Zipf OD single rows), ``request_route`` (the
     routing path over the same OD vocabulary), ``history`` (GET reads),
     ``predict_eta_batch`` (columnar batches of ``batch_rows`` Zipf
-    rows). SSE streams are long-lived connections, not arrivals — the
-    engine holds those separately (``engine.SseClients``)."""
+    rows), ``dispatch`` (VRP dispatch problems with Zipf depots and
+    byte-stable stop sets). SSE streams are long-lived connections,
+    not arrivals — the engine holds those separately
+    (``engine.SseClients``)."""
 
     KINDS = ("predict_eta", "request_route", "history",
-             "predict_eta_batch", "update_tracker", "probe")
+             "predict_eta_batch", "update_tracker", "probe", "dispatch")
 
     def __init__(self, mix: Optional[Dict[str, float]] = None,
                  s: float = 1.1, seed: int = 0,
@@ -169,7 +194,8 @@ class MixedWorkload:
                  probe_edges: int = 0,
                  probe_obs: int = 4,
                  route_zipf_s: Optional[float] = None,
-                 route_stops: int = 2) -> None:
+                 route_stops: int = 2,
+                 dispatch_stops: int = 4) -> None:
         mix = dict(mix if mix is not None else DEFAULT_MIX)
         unknown = set(mix) - set(self.KINDS)
         if unknown:
@@ -199,6 +225,10 @@ class MixedWorkload:
         self.route_stops = int(route_stops)
         self.route_od = ZipfODWorkload(
             s=s if route_zipf_s is None else route_zipf_s, seed=seed)
+        # Dispatch traffic draws its depots from the route stream's
+        # Zipf pair vocabulary (same skew: hot depots repeat as
+        # byte-identical problems, which the dispatch batcher merges).
+        self.dispatch_stops = int(dispatch_stops)
 
     def sequence(self, n: int) -> List[PlannedRequest]:
         rng = np.random.default_rng((self.seed, 2))
@@ -243,6 +273,13 @@ class MixedWorkload:
                         "duration": 600, "distance": 5000, "trips": 1,
                         "pickup_time": "2026-08-04T18:00:00",
                     }, "/api/update_tracker"))
+            elif kind == "dispatch":
+                out.append(PlannedRequest(
+                    "POST", "/api/dispatch",
+                    self.route_od.dispatch_body_for_pair(
+                        int(route_pair_ids[idx]),
+                        stops=self.dispatch_stops),
+                    "/api/dispatch"))
             elif kind == "probe":
                 # Live-update traffic: one driver's per-edge speed
                 # observations, POSTed to /api/probe (which publishes
@@ -279,4 +316,6 @@ class MixedWorkload:
         if self.mix.get("probe"):
             out["probe_edges"] = self.probe_edges
             out["probe_obs"] = self.probe_obs
+        if self.mix.get("dispatch"):
+            out["dispatch_stops"] = self.dispatch_stops
         return out

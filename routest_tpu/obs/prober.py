@@ -364,7 +364,7 @@ class BlackboxProber:
         if self.oracle is None and len(self.route_waypoints) >= 2:
             self.oracle = SubgraphOracle(self.route_waypoints,
                                          timeout_s=self.config.timeout_s)
-        self.kinds = ["golden", "fanout"]
+        self.kinds = ["golden", "fanout", "dispatch"]
         if len(self.route_waypoints) >= 2:
             self.kinds += ["route", "matrix"]
         # Pinned expectations (None = arming). golden: {col: vec};
@@ -466,6 +466,8 @@ class BlackboxProber:
         if "matrix" in self.kinds:
             verdicts["matrix"] = self._checked(
                 "matrix", lambda: self._probe_matrix(targets))
+        verdicts["dispatch"] = self._checked("dispatch",
+                                             self._probe_dispatch)
         verdicts["fanout"] = self._checked(
             "fanout", lambda: self._probe_fanout(targets))
         self._rounds += 1
@@ -674,6 +676,68 @@ class BlackboxProber:
         return self._judge_scalar(
             "matrix", np.where(mask, served, 0.0), expect, targets,
             headers, body)
+
+    # ── dispatch (host-oracle plan parity) ────────────────────────────
+
+    def dispatch_probe_body(self) -> dict:
+        """Seeded matrix-mode ``/api/dispatch`` body: the probe BRINGS
+        the cost matrix, so the served plan must hold up against a host
+        re-solve of the SAME matrix regardless of live metric state —
+        the only check that catches a device solving over silently
+        perturbed costs (chaos ``dispatch.solve``). Byte-stable across
+        rounds (fixed seed): any divergence is the server's."""
+        rng = np.random.default_rng(20260)
+        n = 8
+        pts = rng.random((n + 1, 2)) * 60.0
+        m = np.round(np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1)), 3)
+        demands = rng.integers(1, 4, n)
+        return {"matrix": m.tolist(),
+                "demands": [float(d) for d in demands],
+                "capacity": 6.0, "max_distance": 400.0}
+
+    def _probe_dispatch(self) -> Tuple[str, Optional[dict]]:
+        from routest_tpu.dispatch import plan_cost
+        from routest_tpu.optimize.vrp import solve_host_dispatch
+
+        body = self.dispatch_probe_body()
+        try:
+            payload, headers = _http_json(
+                "POST", f"{self.gateway_base}/api/dispatch", body,
+                self.config.timeout_s, probe="dispatch")
+        except ProbeUnreachable as e:
+            return UNREACHABLE, {"error": str(e)}
+        plan = payload.get("plan")
+        if not isinstance(plan, dict):
+            return UNREACHABLE, {"error": "no plan in answer"}
+        m = np.asarray(body["matrix"], np.float32)
+        oracle = solve_host_dispatch(
+            m, np.asarray(body["demands"], np.float32),
+            body["capacity"], body["max_distance"])
+        expected = float(plan_cost(m, oracle))
+        try:
+            served = float(plan_cost(m, plan))
+            served_stops = sorted(
+                [int(i) for i in (plan.get("optimized_order") or [])]
+                + [int(i) for i in (plan.get("spill_lane") or [])])
+        except (TypeError, ValueError, IndexError):
+            return UNREACHABLE, {"error": "malformed plan in answer"}
+        oracle_stops = sorted(oracle["optimized_order"]
+                              + oracle["spill_lane"])
+        # Judged on COST under the true matrix, not on order bytes: a
+        # different order at equal cost is an equally good plan, while
+        # a skewed solve prices its plan over the wrong world and lands
+        # measurably worse here.
+        div = abs(served - expected) / max(abs(expected), 1e-9)
+        tol = max(self.config.route_tolerance_rel, 1e-6)
+        evidence = {"divergence": round(div, 6), "tolerance": tol,
+                    "served_cost": round(served, 3),
+                    "expected_cost": round(expected, 3),
+                    "trace_id": headers.get("x-trace-id")}
+        if served_stops != oracle_stops or div > tol:
+            evidence["served_plan"] = plan.get("trips")
+            evidence["expected_plan"] = oracle["trips"]
+            return DIVERGENT, evidence
+        return PASS, evidence
 
     # ── fan-out consistency ───────────────────────────────────────────
 

@@ -496,6 +496,8 @@ REPLICA_DEFAULT_OBJECTIVES = [
      "latency_ms": 1000.0, "latency_target": 0.95},
     {"route": "/api/optimize_route", "availability": 0.99,
      "latency_ms": 5000.0, "latency_target": 0.95},
+    {"route": "/api/dispatch", "availability": 0.99,
+     "latency_ms": 5000.0, "latency_target": 0.95},
 ]
 
 GATEWAY_DEFAULT_OBJECTIVES = [

@@ -27,7 +27,9 @@ from werkzeug.wrappers import Response
 
 from routest_tpu.core.config import Config, load_config
 from routest_tpu.data.locations import locations_table
-from routest_tpu.optimize.engine import (MAX_BATCH_PROBLEMS, optimize_route,
+from routest_tpu.obs import get_registry
+from routest_tpu.optimize.engine import (MAX_BATCH_PROBLEMS, _parse_problem,
+                                         optimize_route,
                                          optimize_route_batch, travel_matrix)
 from routest_tpu.serve import sim
 from routest_tpu.serve import auth as auth_mod
@@ -40,6 +42,10 @@ from routest_tpu.serve.wsgi import App, get_json
 from routest_tpu.utils.logging import get_logger
 
 _log = get_logger("routest_tpu.serve")
+
+_m_dispatch_requests = get_registry().counter(
+    "rtpu_dispatch_requests_total",
+    "POST /api/dispatch solves accepted, by problem mode.", ("mode",))
 
 
 def _obj(value) -> dict:
@@ -171,6 +177,101 @@ def create_app(config: Optional[Config] = None,
         app.live = LiveTrafficService(state.bus, live_cfg)
         state.live = app.live
         app.live.start()
+
+    # Dispatch workload (docs/ARCHITECTURE.md "Dispatch dataflow"):
+    # concurrent POST /api/dispatch VRP problems merge into one padded
+    # device batch (dispatch/batcher.py); confirmed dispatches register
+    # their corridor (dispatch/registry.py); on live metric flips the
+    # re-optimization loop re-solves exactly the degraded plans and
+    # pushes plan_update events over the SSE bus (dispatch/reopt.py).
+    app.dispatch = None
+    state.dispatch = None
+    dispatch_cfg = getattr(config, "dispatch", None)
+    if dispatch_cfg is not None and dispatch_cfg.enabled:
+        from types import SimpleNamespace
+
+        from routest_tpu.dispatch import (DispatchBatcher, DispatchRegistry,
+                                          ReoptLoop)
+        from routest_tpu.data import geo as _geo
+
+        def _live_epoch() -> int:
+            live = state.live
+            if live is not None and live.router is not None:
+                return int(live.router.live_epoch)
+            return 0
+
+        def _corridor_matrix(latlon, speed_mps=None):
+            """(N+1, 2) lat/lon → (N+1, N+1) float32 travel SECONDS
+            under the CURRENT metric: road-router shortest paths priced
+            by the live leg models when the live router is armed,
+            great-circle × car road factor otherwise. One unit
+            everywhere, so a dispatch's baseline cost and its re-priced
+            corridor cost stay comparable across metric flips."""
+            latlon = np.asarray(latlon, np.float32)
+            car = _geo.profile_for_vehicle("car")
+            speed = float(speed_mps or dispatch_cfg.speed_mps
+                          or _geo.PROFILE_SPEED_MPS[car])
+            live = state.live
+            if live is not None and live.ready and live.router is not None:
+                legs = live.router.route_legs(latlon)
+                return np.asarray(legs.duration_matrix(), np.float32)
+            import jax.numpy as _jnp
+
+            dist_m = np.asarray(_geo.distance_matrix_m(
+                _jnp.asarray(latlon), _geo.PROFILE_ROAD_FACTOR[car]))
+            return (dist_m / speed).astype(np.float32)
+
+        def _sim_restart(rec) -> None:
+            """plan_update → re-target the driver sim at the NEW stop
+            order, replaying deterministically under the dispatch's
+            stored sim_seed (None keeps the reference's random gait)."""
+            if rec.latlon is None \
+                    or not rec.driver_details.get("driver_name") \
+                    or not rec.driver_details.get("vehicle_type"):
+                return
+            order = list(rec.plan.get("optimized_order") or []) \
+                + list(rec.plan.get("spill_lane") or [])
+            coords = [[float(rec.latlon[0][1]), float(rec.latlon[0][0])]]
+            coords += [[float(rec.latlon[j + 1][1]),
+                        float(rec.latlon[j + 1][0])] for j in order]
+            coords.append(list(coords[0]))
+            speed = float(rec.driver_details.get("speed_mps") or 1.0)
+            data = {
+                "route_details": {
+                    "geometry": {"coordinates": coords},
+                    "properties": {
+                        "summary": {
+                            "duration": round(rec.baseline_cost, 1),
+                            "distance": round(rec.baseline_cost * speed, 1),
+                            "trips": rec.plan.get("n_trips", 1),
+                        },
+                        "destinations": rec.destinations or [],
+                    },
+                },
+                "driver_details": rec.driver_details,
+            }
+            sim.start_simulation(data, state.bus.publish,
+                                 state.sim_tick_range, seed=rec.sim_seed)
+
+        _d_registry = DispatchRegistry(max_active=dispatch_cfg.max_active)
+        _d_batcher = DispatchBatcher(max_rows=dispatch_cfg.max_rows,
+                                     window_s=dispatch_cfg.window_s,
+                                     epoch_fn=_live_epoch)
+        _d_reopt = None
+        if dispatch_cfg.reopt:
+            _d_reopt = ReoptLoop(
+                _d_registry, _d_batcher, state.bus.publish,
+                _live_epoch, _corridor_matrix,
+                degrade_ratio=dispatch_cfg.degrade_ratio,
+                poll_s=dispatch_cfg.reopt_poll_s,
+                sim_restart=_sim_restart)
+            if dispatch_cfg.reopt_poll_s > 0:
+                _d_reopt.start()
+        state.dispatch = SimpleNamespace(
+            cfg=dispatch_cfg, registry=_d_registry, batcher=_d_batcher,
+            reopt=_d_reopt, matrix_fn=_corridor_matrix,
+            epoch_fn=_live_epoch, sim_restart=_sim_restart)
+        app.dispatch = state.dispatch
 
     # ── optimization ────────────────────────────────────────────────────
 
@@ -465,6 +566,112 @@ def create_app(config: Optional[Config] = None,
             return predict_eta_batch(request)
         return predict_eta(request)
 
+    # ── dispatch ───────────────────────────────────────────────────────
+
+    @app.route("/api/dispatch", methods=("POST",))
+    def dispatch_endpoint(request):
+        """Batched VRP dispatch — the paper's workload as a first-class
+        API (docs/API.md "Dispatch").
+
+        Geographic mode (reference-shaped body): ``{"source_point",
+        "destination_points": [{lat, lon, payload}, …],
+        "driver_details", "time_windows": [[open_s, close_s|null],
+        …]?, "confirm": bool?, "sim_seed": int?}`` — stops price into
+        travel seconds under the current metric and solve through the
+        shared dispatch batcher (time-window + demand-spillover VRP).
+
+        Matrix mode (prober/bench surface): ``{"matrix": (N+1)×(N+1),
+        "demands": [N], "capacity", "max_distance",
+        "time_windows"?}`` — the caller brings the cost matrix, so the
+        served plan is directly comparable against a host re-solve of
+        the SAME matrix (the dispatch probe's oracle check).
+
+        ``{"complete": "<dispatch_id>"}`` retires an active dispatch.
+
+        Concurrent requests merge into ONE padded device batch; with
+        ``confirm`` the plan registers for live re-optimization
+        (``plan_update`` over SSE on corridor degradation) and — when
+        the body carries a driver — starts the driver simulation.
+        """
+        svc = state.dispatch
+        if svc is None:
+            return {"error": "dispatch disabled (RTPU_DISPATCH=0)"}, 503
+        body = get_json(request) or {}
+
+        done = body.get("complete")
+        if done is not None:
+            if not isinstance(done, str):
+                return {"error": "complete must be a dispatch id"}, 400
+            if not svc.registry.complete(done):
+                return {"error": "not found"}, 404
+            return {"status": "completed", "dispatch_id": done}, 200
+
+        seed = body.get("sim_seed")
+        if seed is not None and not isinstance(seed, int):
+            return {"error": "sim_seed must be an integer"}, 400
+
+        if "matrix" in body:
+            parsed = _parse_matrix_dispatch(body, svc.cfg.max_stops)
+        else:
+            parsed = _parse_geo_dispatch(body, svc.cfg.max_stops)
+        if "error" in parsed:
+            return parsed, 400
+
+        from routest_tpu.dispatch import DispatchProblem, plan_cost
+
+        mode = parsed["mode"]
+        if mode == "geographic":
+            speed = parsed["speed"]
+            matrix = svc.matrix_fn(parsed["latlon"], speed_mps=speed)
+            max_cost = parsed["max_dist"] / speed  # meters → seconds
+        else:
+            matrix = parsed["matrix"]
+            max_cost = parsed["max_cost"]
+        problem = DispatchProblem(matrix, parsed["demands"],
+                                  parsed["capacity"], max_cost,
+                                  parsed["tw_open"], parsed["tw_close"])
+        try:
+            plan = svc.batcher.solve([problem])[0]
+        except TimeoutError:
+            return {"error": "dispatch solver saturated; retry"}, 503
+        _m_dispatch_requests.labels(mode=mode).inc()
+        cost = plan_cost(matrix, plan)
+        out = {"mode": mode, "plan": plan,
+               "cost": round(float(cost), 3), "epoch": svc.epoch_fn()}
+
+        if body.get("confirm"):
+            driver = dict(parsed.get("driver_details") or {})
+            if mode == "geographic":
+                driver.setdefault("speed_mps", round(speed, 3))
+            rec = svc.registry.register(
+                channel=driver.get("driver_name"),
+                latlon=parsed.get("latlon"),
+                demands=parsed["demands"],
+                capacity=parsed["capacity"], max_cost=max_cost,
+                plan=plan, baseline_cost=cost, epoch=out["epoch"],
+                tw_open=parsed["tw_open"], tw_close=parsed["tw_close"],
+                sim_seed=seed, driver_details=driver,
+                destinations=parsed.get("destinations"))
+            out["dispatch_id"] = rec.id
+            out["channel"] = rec.channel
+            svc.sim_restart(rec)  # no-op without a named driver
+        return out, 200
+
+    @app.route("/api/dispatch", methods=("GET",))
+    def dispatch_state(request):
+        # Dispatch surface state: active registry, batcher merge
+        # stats, re-optimization loop snapshot — the bench's and an
+        # operator's one-stop coherency view.
+        svc = state.dispatch
+        if svc is None:
+            return {"enabled": False}, 200
+        out = {"enabled": True, "epoch": svc.epoch_fn(),
+               "registry": svc.registry.snapshot(),
+               "batcher": svc.batcher.stats()}
+        if svc.reopt is not None:
+            out["reopt"] = svc.reopt.snapshot()
+        return out, 200
+
     # ── live tracking ──────────────────────────────────────────────────
 
     @app.route("/api/confirm_route", methods=("POST",))
@@ -493,7 +700,24 @@ def create_app(config: Optional[Config] = None,
             return {"error": "sim_seed must be an integer"}, 400
         sim.start_simulation(data, state.bus.publish, state.sim_tick_range,
                              seed=seed)
-        return {"status": "route simulation initialized."}, 200
+        # Dispatch citizenship: a confirmed reference-shaped route also
+        # registers for live re-optimization when the body carries
+        # enough of the problem to re-solve (lat/lon stops + finite
+        # constraints); the optional sim_seed rides along so a
+        # re-dispatch sim restart replays deterministically. Bodies
+        # without re-solvable structure keep the reference behavior.
+        out = {"status": "route simulation initialized."}
+        svc = state.dispatch
+        if svc is not None:
+            try:
+                rec = _register_confirmed_route(svc, data, seed)
+            except Exception as e:  # best-effort: never fail the confirm
+                rec = None
+                _log.debug("dispatch_register_skipped",
+                           error=f"{type(e).__name__}: {e}")
+            if rec is not None:
+                out["dispatch_id"] = rec.id
+        return out, 200
 
     @app.route("/api/update_tracker", methods=("POST",))
     def update_tracker(request):
@@ -1239,3 +1463,141 @@ def _persist(state: ServerState, payload: dict, feature: dict) -> Optional[str]:
         "eta_completion_time_ml": props.get("eta_completion_time_ml"),
     })
     return request_id
+
+
+def _parse_windows(body: dict, n: int):
+    """``time_windows``: list of N ``[open_s, close_s|null]`` pairs →
+    (tw_open, tw_close) float32 arrays, (None, None) when absent, or
+    ``{"error"}``. A null/absent close means "no deadline" (the solver's
+    NO_WINDOW sentinel); non-finite values are client errors — a NaN
+    window would poison the on-device feasibility mask."""
+    raw = body.get("time_windows")
+    if raw is None:
+        return None, None
+    from routest_tpu.optimize.vrp import NO_WINDOW
+
+    if not isinstance(raw, list) or len(raw) != n:
+        return {"error": f"time_windows must be a list of {n} "
+                         "[open_s, close_s] pairs"}, None
+    opens, closes = [], []
+    for tw in raw:
+        if not isinstance(tw, (list, tuple)) or len(tw) != 2:
+            return {"error": "each time window must be "
+                             "[open_s, close_s]"}, None
+        o, c = tw
+        try:
+            o = float(o or 0)
+            c = NO_WINDOW if c is None else float(c)
+        except (TypeError, ValueError):
+            return {"error": "time window bounds must be numeric"}, None
+        if not (math.isfinite(o) and (c == NO_WINDOW or math.isfinite(c))):
+            return {"error": "time window bounds must be finite"}, None
+        opens.append(o)
+        closes.append(min(c, NO_WINDOW))
+    return (np.asarray(opens, np.float32), np.asarray(closes, np.float32))
+
+
+def _parse_matrix_dispatch(body: dict, max_stops: int) -> dict:
+    """Matrix-mode dispatch body → problem fields or ``{"error"}``."""
+    matrix = body.get("matrix")
+    if not isinstance(matrix, list) or len(matrix) < 2:
+        return {"error": "matrix must be a square cost matrix "
+                         "(row/col 0 = depot) with at least one stop"}
+    n = len(matrix) - 1
+    if n > max_stops:
+        return {"error": f"too many stops (max {max_stops})"}
+    try:
+        m = np.asarray(matrix, np.float32)
+    except ValueError:
+        return {"error": "matrix must be numeric and square"}
+    if m.shape != (n + 1, n + 1) or not np.isfinite(m).all():
+        return {"error": "matrix must be numeric, square and finite"}
+    demands = body.get("demands")
+    if not isinstance(demands, list) or len(demands) != n:
+        return {"error": f"demands must be a list of {n} numbers"}
+    try:
+        dem = np.asarray([float(d or 0) for d in demands], np.float32)
+        capacity = float(body.get("capacity", 9e12))
+        max_cost = float(body.get("max_distance", 9e12))
+    except (TypeError, ValueError):
+        return {"error": "demands/capacity/max_distance must be numeric"}
+    if not (np.isfinite(dem).all() and math.isfinite(capacity)
+            and math.isfinite(max_cost)):
+        return {"error": "demands/capacity/max_distance must be finite"}
+    tw_open, tw_close = _parse_windows(body, n)
+    if isinstance(tw_open, dict):
+        return tw_open
+    return {"mode": "matrix", "matrix": m, "demands": dem,
+            "capacity": capacity, "max_cost": max_cost,
+            "tw_open": tw_open, "tw_close": tw_close, "latlon": None,
+            "driver_details": _obj(body.get("driver_details")),
+            "destinations": None}
+
+
+def _parse_geo_dispatch(body: dict, max_stops: int) -> dict:
+    """Geographic dispatch body → problem fields or ``{"error"}``.
+    Shares the optimizer's reference-body validation, so a malformed
+    dispatch fails exactly like a malformed optimize_route."""
+    p = _parse_problem(body)
+    if "error" in p:
+        return p
+    if len(p["destinations"]) > max_stops:
+        return {"error": f"too many stops (max {max_stops})"}
+    tw_open, tw_close = _parse_windows(body, len(p["destinations"]))
+    if isinstance(tw_open, dict):
+        return tw_open
+    return {"mode": "geographic", "latlon": p["latlon"],
+            "demands": p["demands"], "capacity": p["cap"],
+            "max_dist": p["max_dist"], "speed": p["speed"],
+            "tw_open": tw_open, "tw_close": tw_close,
+            "driver_details": p["driver_details"],
+            "destinations": p["destinations"]}
+
+
+def _register_confirmed_route(svc, data: dict, seed):
+    """Best-effort: register a confirm_route body's route as an active
+    dispatch so the re-optimization loop watches its corridor. Needs
+    lat/lon on every destination and finite constraints; returns None
+    (caller keeps reference behavior) when the body can't support a
+    re-solve. The confirmed stop ORDER is the baseline plan."""
+    from routest_tpu.dispatch import plan_cost
+
+    route = _obj(data["route_details"])
+    driver = dict(_obj(data["driver_details"]))
+    props = _obj(route.get("properties"))
+    dests = props.get("destinations")
+    if not isinstance(dests, list) or not dests:
+        return None
+    coords = _obj(route.get("geometry")).get("coordinates")
+    try:
+        origin = [float(coords[0][1]), float(coords[0][0])]  # lonlat row
+        latlon = np.asarray(
+            [origin] + [[float(d["lat"]), float(d["lon"])] for d in dests],
+            np.float32)
+        demands = np.asarray(
+            [float(_obj(d).get("payload", 0) or 0) for d in dests],
+            np.float32)
+        capacity = float(driver.get("vehicle_capacity", 9e12))
+        max_dist = float(driver.get("maximum_distance", 9e12))
+    except (KeyError, TypeError, ValueError, IndexError):
+        return None
+    if not (np.isfinite(latlon).all() and np.isfinite(demands).all()
+            and math.isfinite(capacity) and math.isfinite(max_dist)):
+        return None
+    from routest_tpu.data import geo as _geo
+
+    profile = _geo.profile_for_vehicle(
+        str(driver.get("vehicle_type") or "car").lower().strip())
+    speed = float(svc.cfg.speed_mps or _geo.PROFILE_SPEED_MPS[profile])
+    driver.setdefault("speed_mps", round(speed, 3))
+    matrix = svc.matrix_fn(latlon, speed_mps=speed)
+    plan = {"trips": [list(range(len(dests)))],
+            "optimized_order": list(range(len(dests))),
+            "n_trips": 1, "spill_lane": [], "spilled": [],
+            "penalty": 0.0, "unroutable": []}
+    return svc.registry.register(
+        channel=driver.get("driver_name"), latlon=latlon,
+        demands=demands, capacity=capacity, max_cost=max_dist / speed,
+        plan=plan, baseline_cost=plan_cost(matrix, plan),
+        epoch=svc.epoch_fn(), sim_seed=seed, driver_details=driver,
+        destinations=dests, source="confirm_route")

@@ -69,8 +69,8 @@ _HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
 # cannot be driven from the wire.
 _ROUTE_LABELS = _IDEMPOTENT_POST | {
     "/api/optimize_route", "/api/optimize_route_batch", "/api/history",
-    "/api/update_tracker", "/api/confirm_route", "/api/health",
-    "/api/locations", "/api/ping", "/api/version", "/up",
+    "/api/update_tracker", "/api/confirm_route", "/api/dispatch",
+    "/api/health", "/api/locations", "/api/ping", "/api/version", "/up",
 }
 
 

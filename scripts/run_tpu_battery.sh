@@ -88,6 +88,14 @@ run_step telemetry timeout 1500 python scripts/bench_telemetry.py
 # overlay + XLA caches persist under artifacts/bench_cache/probing so
 # later battery rounds skip the cold hierarchy build.
 run_step probing timeout 2400 python scripts/bench_probing.py
+# Dispatch workload end to end (ISSUE 16): batched VRP solves/s must
+# scale with batch size at host-oracle parity; a corridor jam on a live
+# 2-replica fleet must re-dispatch exactly the affected routes within a
+# bounded window (plan_update over SSE, user SLO green); an injected
+# dispatch.solve skew must page the prober's dispatch kind
+# (artifacts/dispatch.json). Extract + hierarchy + XLA caches persist
+# under artifacts/bench_cache/dispatch across battery rounds.
+run_step dispatch timeout 2400 python scripts/bench_dispatch.py
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
 run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
   --osm-nodes 250000 --verify --flat-compare

@@ -100,6 +100,24 @@ class _StubHandler(http.server.BaseHTTPRequestHandler):
         if path.startswith("/api/matrix"):
             d = _route_s(srv)
             return self._send(200, {"durations_s": [[0.0, d], [d, 0.0]]})
+        if path.startswith("/api/dispatch"):
+            # Correct-by-construction: solve the probe's own matrix
+            # with the host oracle (srv.dispatch_skew perturbs the
+            # costs the solve sees — the wrong-plan fault).
+            from routest_tpu.dispatch import plan_cost
+            from routest_tpu.optimize.vrp import solve_host_dispatch
+            m = np.asarray(body["matrix"], np.float32)
+            solved = m
+            if srv.dispatch_skew:
+                rng = np.random.default_rng(0)
+                solved = m * (1.0 + srv.dispatch_skew
+                              * rng.random(m.shape).astype(np.float32))
+            plan = solve_host_dispatch(
+                solved, np.asarray(body["demands"], np.float32),
+                body["capacity"], body["max_distance"])
+            return self._send(200, {
+                "mode": "matrix", "plan": plan,
+                "cost": round(float(plan_cost(m, plan)), 3)})
         return self._send(200, {"ok": True})
 
 
@@ -111,6 +129,7 @@ def _start_stub():
     srv.route_bias = 0.0
     srv.fingerprint = "fp-a"
     srv.generation = 1
+    srv.dispatch_skew = 0.0
     srv.epoch = 1
     srv.live_enabled = True
     threading.Thread(target=srv.serve_forever, daemon=True).start()
@@ -150,7 +169,8 @@ def _counter(probe, verdict):
 def test_golden_and_fanout_pass_and_repin(tmp_path):
     stubs = [_start_stub(), _start_stub()]
     prober, _rec = _mk_prober(tmp_path, stubs)
-    assert prober.probe_round() == {"golden": PASS, "fanout": PASS}
+    assert prober.probe_round() == {"golden": PASS, "fanout": PASS,
+                                    "dispatch": PASS}
     # Within-tolerance movement (a verified swap's shift) re-pins:
     for s in stubs:
         s.skew = 2.0
@@ -536,7 +556,7 @@ def test_snapshot_shape(tmp_path):
     prober, _rec = _mk_prober(tmp_path, [stub])
     prober.probe_round()
     snap = prober.snapshot()
-    assert snap["kinds"] == ["golden", "fanout"]
+    assert snap["kinds"] == ["golden", "fanout", "dispatch"]
     assert snap["rounds"] == 1
     assert snap["probes"]["golden"]["verdict"] == PASS
     assert "served" not in snap["probes"]["golden"]
