@@ -164,9 +164,16 @@ class DispatchBatcher:
                     rest: List[_Entry] = []
                     rows = 0
                     for it in self._queue:
-                        if (it.key == k0
-                                and rows + len(it.problems)
+                        if it.key != k0:
+                            rest.append(it)
+                        elif (not batch
+                                or rows + len(it.problems)
                                 <= self.max_rows):
+                            # The head entry rides even when it alone
+                            # exceeds max_rows (the solver pads to any
+                            # batch size): refusing it would requeue it
+                            # every round — the leader spinning on
+                            # empty drains while its caller hangs.
                             batch.append(it)
                             rows += len(it.problems)
                         else:

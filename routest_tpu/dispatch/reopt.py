@@ -22,9 +22,10 @@ Coherency rules (docs/ARCHITECTURE.md "Dispatch"):
 - exactly the degraded re-solve: plans whose corridor cost stayed
   within the ratio keep serving untouched (no churn on healthy plans);
 - chaos point ``dispatch.resolve`` guards the re-solve pass: a dropped
-  pass leaves every previous plan serving and the epoch unconsumed, so
-  the next tick retries — degrade-don't-fail, same contract as the
-  live customizer's flip.
+  pass leaves every previous plan serving and the epoch unconsumed —
+  healthy records included, so no record advertises the new epoch until
+  the whole pass lands — and the next tick retries: degrade-don't-fail,
+  same contract as the live customizer's flip.
 """
 
 from __future__ import annotations
@@ -139,6 +140,7 @@ class ReoptLoop:
 
         active = self.registry.active()
         degraded: List[ActiveDispatch] = []
+        healthy: List[ActiveDispatch] = []
         matrices = {}
         skipped = 0
         for rec in active:
@@ -152,12 +154,14 @@ class ReoptLoop:
             if ratio > self.degrade_ratio:
                 degraded.append(rec)
             else:
-                rec.epoch = epoch   # healthy under the new metric
+                healthy.append(rec)
 
         out = {"epoch": epoch, "checked": len(active),
                "skipped": skipped,
                "degraded": [r.id for r in degraded], "resolved": []}
         if not degraded:
+            for rec in healthy:
+                rec.epoch = epoch   # healthy under the new metric
             self._last_epoch = epoch
             with self._lock:
                 self._ticks += 1
@@ -168,12 +172,20 @@ class ReoptLoop:
         try:
             # The whole re-solve pass is one fault point: a dropped
             # pass leaves every previous plan serving (epoch stays
-            # unconsumed → retried next tick).
+            # unconsumed → retried next tick; healthy records keep the
+            # old epoch too, so the per-record epoch view never splits
+            # mid-retry). Chunked to the batcher's drain size — a mass
+            # degradation (max_active can exceed max_rows) must not
+            # submit one oversized entry.
             chaos.inject("dispatch.resolve")
-            results = self.batcher.solve([
-                DispatchProblem(matrices[r.id], r.demands, r.capacity,
-                                r.max_cost, r.tw_open, r.tw_close)
-                for r in degraded])
+            results: List[dict] = []
+            step = max(1, self.batcher.max_rows)
+            for i in range(0, len(degraded), step):
+                results.extend(self.batcher.solve([
+                    DispatchProblem(matrices[r.id], r.demands,
+                                    r.capacity, r.max_cost,
+                                    r.tw_open, r.tw_close)
+                    for r in degraded[i:i + step]]))
         except chaos.ChaosError:
             _m_reopt.labels(result="chaos").inc()
             with self._lock:
@@ -181,6 +193,8 @@ class ReoptLoop:
                 self._last_result = dict(out, result="chaos")
             return dict(out, result="chaos")
 
+        for rec in healthy:
+            rec.epoch = epoch       # healthy under the new metric
         for rec, plan in zip(degraded, results):
             matrix = matrices[rec.id]
             old_cost = plan_cost(matrix, rec.plan)

@@ -466,8 +466,9 @@ class BlackboxProber:
         if "matrix" in self.kinds:
             verdicts["matrix"] = self._checked(
                 "matrix", lambda: self._probe_matrix(targets))
-        verdicts["dispatch"] = self._checked("dispatch",
-                                             self._probe_dispatch)
+        if self._dispatch_armed():
+            verdicts["dispatch"] = self._checked("dispatch",
+                                                 self._probe_dispatch)
         verdicts["fanout"] = self._checked(
             "fanout", lambda: self._probe_fanout(targets))
         self._rounds += 1
@@ -678,6 +679,22 @@ class BlackboxProber:
             headers, body)
 
     # ── dispatch (host-oracle plan parity) ────────────────────────────
+
+    def _dispatch_armed(self) -> bool:
+        """The dispatch probe only runs where dispatch serving is on:
+        ``RTPU_DISPATCH=0`` is a deliberate deployment choice (the POST
+        answers 503), and probing it anyway would feed sustained
+        UNREACHABLE verdicts into the correctness SLO — paging on a
+        disabled feature. A fleet that doesn't answer the state GET at
+        all is a different story (it may simply be down), so the probe
+        still runs and records what it sees."""
+        try:
+            state, _ = _http_json(
+                "GET", f"{self.gateway_base}/api/dispatch", None,
+                self.config.timeout_s, probe="dispatch")
+        except ProbeUnreachable:
+            return True
+        return state.get("enabled") is not False
 
     def dispatch_probe_body(self) -> dict:
         """Seeded matrix-mode ``/api/dispatch`` body: the probe BRINGS

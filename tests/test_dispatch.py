@@ -218,6 +218,29 @@ def test_batcher_epoch_groups_never_share_a_drain():
     assert st["dispatches"] >= 2
 
 
+def test_batcher_oversized_entry_dispatches_alone():
+    """An entry carrying more problems than max_rows rides as its own
+    oversized batch (the solver pads to any batch size). It used to be
+    requeued on every round — the leader spinning on empty drains
+    forever while its caller hung."""
+    batcher = DispatchBatcher(max_rows=2)
+    m = _matrix(3)
+    dem = np.ones(3, np.float32)
+    probs = [DispatchProblem(m, dem, 5.0, 1e6) for _ in range(5)]
+    out = {}
+    t = threading.Thread(target=lambda: out.update(r=batcher.solve(probs)),
+                         daemon=True)
+    t.start()
+    t.join(30.0)
+    assert "r" in out, "oversized entry wedged the batcher"
+    expect = solve_host_dispatch(m, dem, 5.0, 1e6)
+    assert len(out["r"]) == 5
+    assert all(r == expect for r in out["r"])
+    st = batcher.stats()
+    assert st["dispatches"] == 1 and st["rows"] == 5
+    assert st["max_occupancy"] == 5
+
+
 # ── serving surface ──────────────────────────────────────────────────
 
 
@@ -450,6 +473,41 @@ def test_reopt_resolves_exactly_the_degraded():
     assert loop.tick()["result"] == "idle"
 
 
+def test_reopt_mass_degradation_chunks_to_batcher_drains():
+    """More degraded dispatches than the batcher's max_rows: the tick
+    chunks its re-solve into drain-sized solve() calls (one oversized
+    entry used to wedge the batcher fleet-wide) and still resolves
+    every degraded plan."""
+    base = _matrix(3, seed=6)
+    registry = DispatchRegistry()
+    epoch = {"v": 0}
+    published = []
+    jam = {"on": False}
+    plan = solve_host_dispatch(base, np.ones(3, np.float32), 5.0, 1e6)
+    recs = [registry.register(
+        channel=f"veh-{i}", latlon=np.full((4, 2), 0.1, np.float32),
+        demands=np.ones(3, np.float32), capacity=5.0, max_cost=1e6,
+        plan=plan, baseline_cost=plan_cost(base, plan), epoch=0)
+        for i in range(5)]
+    batcher = DispatchBatcher(max_rows=2)
+    loop = ReoptLoop(
+        registry, batcher,
+        lambda ch, ev: published.append((ch, ev)),
+        lambda: epoch["v"],
+        lambda latlon: base * 3.0 if jam["on"] else base,
+        poll_s=0.0)
+    loop.tick()          # arm
+    jam["on"] = True
+    epoch["v"] = 1
+    out = loop.tick()
+    assert out["result"] == "resolved"
+    assert sorted(out["resolved"]) == sorted(r.id for r in recs)
+    assert len(published) == 5
+    st = batcher.stats()
+    assert st["dispatches"] >= 3            # ceil(5 / max_rows=2)
+    assert st["max_occupancy"] <= 2
+
+
 def test_reopt_skips_matrix_mode_dispatches():
     loop, recs, epoch, published, _ = _mk_reopt(jam_ids=set())
     m = _matrix(3, seed=9)
@@ -482,12 +540,16 @@ def test_reopt_chaos_drop_leaves_previous_plan_serving():
         assert recs[1].baseline_cost == old_baseline
         assert recs[1].updates == 0
         assert published == [] and restarted == []
+        # Per-record epoch coherency: the healthy record must not
+        # advertise the new epoch while the degraded one stays behind.
+        assert recs[1].epoch == 0 and recs[2].epoch == 0
         # The epoch stays unconsumed → the next tick retries (the
         # single-fire rule is exhausted) and resolves.
         out = loop.tick()
         assert out["result"] == "resolved"
         assert out["resolved"] == [recs[1].id]
         assert recs[1].updates == 1
+        assert recs[1].epoch == 1 and recs[2].epoch == 1
         assert len(published) == 1
     finally:
         chaos.configure(None)
@@ -536,6 +598,8 @@ def test_prober_dispatch_kind_pass_and_divergent(client, tmp_path,
         gateway_base="http://gw", targets_fn=lambda: [],
         recorder=FlightRecorder(RecorderConfig(
             dir=str(tmp_path / "rec"), min_interval_s=0.0)))
+    # Dispatch serving is on here, so the kind is armed.
+    assert prober._dispatch_armed() is True
     verdict, ev = prober._probe_dispatch()
     assert verdict == PASS, ev
     assert ev["divergence"] <= ev["tolerance"]
@@ -552,6 +616,42 @@ def test_prober_dispatch_kind_pass_and_divergent(client, tmp_path,
     assert verdict == DIVERGENT, ev
     assert ev["served_plan"] is not None
     assert ev["expected_plan"] is not None
+
+
+def test_prober_dispatch_kind_stands_down_when_disabled(
+        model_artifact, tmp_path, monkeypatch):
+    """RTPU_DISPATCH=0 answers the state GET with enabled:false: the
+    probe round must skip the dispatch kind entirely — probing a
+    deliberately disabled feature would feed sustained UNREACHABLE
+    verdicts into the correctness SLO and page on a config knob."""
+    from routest_tpu.core.config import ProberConfig, RecorderConfig
+    from routest_tpu.obs import prober as prober_mod
+    from routest_tpu.obs.prober import BlackboxProber
+    from routest_tpu.obs.recorder import FlightRecorder
+
+    cfg = dataclasses.replace(
+        Config(), dispatch=DispatchConfig(enabled=False))
+    eta = EtaService(ServeConfig(), model_path=model_artifact)
+    disabled = Client(create_app(cfg, eta_service=eta,
+                                 bus=InMemoryBus()))
+    assert disabled.get("/api/dispatch").get_json() == {"enabled": False}
+    assert disabled.post("/api/dispatch", json={}).status_code == 503
+
+    def fake_http(method, url, body, timeout, probe=None):
+        path = url.split("http://gw", 1)[1]
+        r = disabled.post(path, json=body) if method == "POST" \
+            else disabled.get(path)
+        return r.get_json(), {}
+
+    monkeypatch.setattr(prober_mod, "_http_json", fake_http)
+    prober = BlackboxProber(
+        ProberConfig(enabled=True, timeout_s=5.0),
+        gateway_base="http://gw", targets_fn=lambda: [],
+        recorder=FlightRecorder(RecorderConfig(
+            dir=str(tmp_path / "rec"), min_interval_s=0.0)))
+    assert prober._dispatch_armed() is False
+    verdicts = prober.probe_round()
+    assert "dispatch" not in verdicts
 
 
 # ── config & loadgen citizenship ─────────────────────────────────────
