@@ -425,7 +425,10 @@ def main() -> None:
     probes = []
     # TPU-path skips, recorded structurally: a CPU record must carry WHY
     # the accelerator window was not spent without the probe's timeout
-    # leaking into ``note`` (which is for measurement anomalies).
+    # leaking into ``note`` (which is for measurement anomalies). Each
+    # entry names the ladder stage that was skipped and the probe's
+    # verbatim reason, so downstream tooling (the battery, the driver's
+    # round parser) can branch on the stage instead of grepping prose.
     skipped = []
     rec = None
 
@@ -439,7 +442,7 @@ def main() -> None:
             diags.append(f"accel: {diag}")
     else:
         reason = probe.get("error") or f"backend is {probe.get('backend')}"
-        skipped.append(f"tpu probe: {reason}")
+        skipped.append({"stage": "tpu_probe", "reason": reason})
 
     if rec is None:
         # CPU fallback keeps the record non-empty whatever the tunnel does.
@@ -465,21 +468,29 @@ def main() -> None:
             else:
                 reason = (probe2.get("error")
                           or f"backend is {probe2.get('backend')}")
-                skipped.append(f"tpu retry probe: {reason}")
+                skipped.append({"stage": "tpu_retry_probe",
+                                "reason": reason})
 
+    skip_prose = [f"{s['stage']}: {s['reason']}" for s in skipped]
     if rec is None:
         # Total failure: still emit a parseable record with diagnostics.
         print(json.dumps({
             "metric": "od_eta_preds_per_sec", "value": 0.0,
             "unit": "preds/s", "vs_baseline": 0.0,
-            "error": "; ".join(diags + skipped), "probes": probes,
+            "error": "; ".join(diags + skip_prose),
+            "skipped": skipped, "probes": probes,
         }))
         return
 
     if diags:
         rec["note"] = "; ".join(diags)
     if skipped:
-        rec["skipped"] = "; ".join(skipped)
+        rec["skipped"] = skipped
+        # Same caveat contract as every battery artifact: a fallback
+        # record says on its face what host actually measured it.
+        rec["host_caveat"] = (
+            f"cpu fallback record: {'; '.join(skip_prose)} — "
+            "re-record when a TPU answers the probe")
     rec["probes"] = probes
     if rec.get("backend") == "tpu":
         try:

@@ -401,6 +401,42 @@ class ProberConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class EfficiencyConfig:
+    """Device goodput ledger + throughput-regression watchdog
+    (``routest_tpu/obs/efficiency.py``). All knobs are ``RTPU_EFF_*``
+    env vars. The ledger (``enabled``) is always-on accounting — every
+    device-program call site records real vs padded rows and the
+    queue/compute wall split. The watchdog pins the measured per-bucket
+    throughput curve from the committed battery artifacts
+    (``kernel_artifact`` × the ``chips_artifact`` scaling factor,
+    backend-matched exactly like the placement planner) and pages when
+    live goodput falls under ``min_ratio`` × pinned, or when windowed
+    padding waste exceeds ``max_waste`` — each debounced over ``after``
+    consecutive bad ticks, the PR-15 skew-verdict convention.
+
+    ``min_rows`` is the evidence floor: a (program, bucket) window with
+    fewer rows than this is not judged at all, so an idle replica can
+    never page on noise. ``slo_target``/``fast_window_s``/
+    ``slow_window_s`` shape the dedicated ``efficiency`` burn-rate
+    engine over watchdog verdicts (watchdog-scale windows, mirroring
+    the prober's)."""
+
+    enabled: bool = True
+    watchdog: bool = True
+    min_ratio: float = 0.25
+    max_waste: float = 0.7
+    after: int = 3
+    tick_s: float = 5.0
+    window_s: float = 60.0
+    min_rows: int = 256
+    kernel_artifact: str = "artifacts/serving_kernel.json"
+    chips_artifact: str = "artifacts/fleet_chips.json"
+    slo_target: float = 0.99
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+
+
+@dataclasses.dataclass(frozen=True)
 class SloConfig:
     """SLO engine (``routest_tpu/obs/slo.py``): per-route objectives
     evaluated over rolling multi-window burn rates (Google SRE workbook
@@ -855,6 +891,31 @@ def load_prober_config(
         slo_target=_env_num(env, "RTPU_PROBER_SLO_TARGET", 0.99, float),
         fast_window_s=_env_num(env, "RTPU_PROBER_FAST_S", 60.0, float),
         slow_window_s=_env_num(env, "RTPU_PROBER_SLOW_S", 600.0, float),
+    )
+
+
+def load_efficiency_config(
+        env: Optional[Mapping[str, str]] = None) -> EfficiencyConfig:
+    """Just the goodput-ledger/watchdog knobs (read lazily by
+    ``routest_tpu/obs/efficiency.py`` at first ``get_ledger()`` and by
+    serving bring-up)."""
+    env = dict(env if env is not None else os.environ)
+    return EfficiencyConfig(
+        enabled=env.get("RTPU_EFF", "1") != "0",
+        watchdog=env.get("RTPU_EFF_WATCHDOG", "1") != "0",
+        min_ratio=_env_num(env, "RTPU_EFF_MIN_RATIO", 0.25, float),
+        max_waste=_env_num(env, "RTPU_EFF_MAX_WASTE", 0.7, float),
+        after=_env_num(env, "RTPU_EFF_AFTER", 3, int),
+        tick_s=_env_num(env, "RTPU_EFF_TICK_S", 5.0, float),
+        window_s=_env_num(env, "RTPU_EFF_WINDOW_S", 60.0, float),
+        min_rows=_env_num(env, "RTPU_EFF_MIN_ROWS", 256, int),
+        kernel_artifact=env.get("RTPU_EFF_KERNEL_ARTIFACT")
+        or "artifacts/serving_kernel.json",
+        chips_artifact=env.get("RTPU_EFF_CHIPS_ARTIFACT")
+        or "artifacts/fleet_chips.json",
+        slo_target=_env_num(env, "RTPU_EFF_SLO_TARGET", 0.99, float),
+        fast_window_s=_env_num(env, "RTPU_EFF_FAST_S", 60.0, float),
+        slow_window_s=_env_num(env, "RTPU_EFF_SLOW_S", 600.0, float),
     )
 
 

@@ -37,6 +37,7 @@ import numpy as np
 
 from routest_tpu import chaos
 from routest_tpu.obs import get_registry
+from routest_tpu.obs.efficiency import get_ledger
 from routest_tpu.obs.trace import trace_span
 from routest_tpu.optimize.vrp import solve_host_dispatch_batch
 
@@ -78,7 +79,7 @@ class DispatchProblem:
 
 class _Entry:
     __slots__ = ("problems", "key", "event", "results", "error",
-                 "dispatch_rows", "dispatch_requests")
+                 "dispatch_rows", "dispatch_requests", "t_q")
 
     def __init__(self, problems: Sequence[DispatchProblem], key) -> None:
         self.problems = list(problems)
@@ -88,6 +89,8 @@ class _Entry:
         self.error: Optional[BaseException] = None
         self.dispatch_rows = 0
         self.dispatch_requests = 0
+        # Enqueue stamp for the goodput ledger's queue/compute split.
+        self.t_q = time.monotonic()
 
 
 class DispatchBatcher:
@@ -108,6 +111,7 @@ class DispatchBatcher:
         self._requests = 0
         self._merged_requests = 0
         self._max_occupancy = 0
+        self._oversized = 0
 
     def stats(self) -> Dict:
         with self._lock:
@@ -119,6 +123,12 @@ class DispatchBatcher:
                     "requests": self._requests,
                     "merged_requests": self._merged_requests,
                     "max_occupancy": self._max_occupancy,
+                    # The drain that was previously invisible: entries
+                    # waiting behind the in-flight solve, and how often
+                    # an oversized head entry rode a drain alone past
+                    # max_rows (the ride-alone admission above).
+                    "queue_depth": len(self._queue),
+                    "oversized_batches": self._oversized,
                     "mean_rows_per_dispatch": round(self._rows / d, 3)}
 
     def solve(self, problems: Sequence[DispatchProblem]) -> List[dict]:
@@ -212,6 +222,11 @@ class DispatchBatcher:
         merged: List[DispatchProblem] = []
         for it in batch:
             merged.extend(it.problems)
+        oversized = len(merged) > self.max_rows
+        if oversized:
+            with self._lock:
+                self._oversized += 1
+        queue_s = max(0.0, time.monotonic() - min(it.t_q for it in batch))
         t0 = time.perf_counter()
         try:
             dists = [p.dist for p in merged]
@@ -244,7 +259,17 @@ class DispatchBatcher:
                 it.error = e
                 it.event.set()
             return
-        _m_solve.observe(time.perf_counter() - t0)
+        compute_s = time.perf_counter() - t0
+        _m_solve.observe(compute_s)
+        # Goodput ledger: the solver pads the problem axis to the next
+        # pow2 (solve_host_dispatch_batch b_pad) — that is the launched
+        # batch this drain is accounted against.
+        n = len(merged)
+        b_pad = 1 << max(0, n - 1).bit_length()
+        get_ledger().record(
+            "dispatch_solve", real_rows=n, padded_rows=b_pad,
+            bucket=b_pad, queue_s=queue_s, compute_s=compute_s,
+            oversized=oversized)
         pos = 0
         for it in batch:
             m = len(it.problems)

@@ -587,3 +587,58 @@ def build_prober_engine(prober_config, kinds: Sequence[str],
             probe_verdict_source(reg, kind),
             detail={"probe": kind}))
     return engine
+
+
+# ── efficiency SLOs over goodput-watchdog verdicts ───────────────────
+
+def efficiency_verdict_source(registry: MetricsRegistry,
+                              check: str) -> Source:
+    """(total, bad) over ``rtpu_efficiency_checks_total`` for one check
+    family: the bare check name (``throughput``) or any of its
+    per-program children (``padding:<program>``). Bad = every
+    non-``pass`` verdict (shortfall, waste)."""
+
+    def read() -> Tuple[float, float]:
+        m = registry.get("rtpu_efficiency_checks_total")
+        if m is None:
+            return 0.0, 0.0
+        ci = m.labelnames.index("check")
+        vi = m.labelnames.index("verdict")
+        total = bad = 0.0
+        for key, child in m.items():
+            if key[ci] != check and not key[ci].startswith(check + ":"):
+                continue
+            total += child.value
+            if key[vi] != "pass":
+                bad += child.value
+        return total, bad
+
+    return read
+
+
+def build_efficiency_engine(eff_config,
+                            registry: Optional[MetricsRegistry] = None
+                            ) -> SloEngine:
+    """The goodput watchdog's dedicated engine (component
+    ``efficiency``): one objective per check family — sustained
+    throughput shortfall vs the pinned curve, and padding waste past
+    threshold — over watchdog-scale windows (the watchdog ticks at
+    ~0.2/s like the prober; user-traffic windows would take an hour of
+    evidence to page). Ticked by the watchdog loop itself; its page
+    edges ship the ``efficiency_page`` expected-vs-measured bundle.
+    Kept here so every burn-rate objective in the system is declared
+    through one module, whatever it measures."""
+    reg = registry if registry is not None else get_registry()
+    cfg = SloConfig(
+        enabled=True, tick_s=0.0,
+        fast_window_s=eff_config.fast_window_s,
+        slow_window_s=eff_config.slow_window_s,
+        page_burn=SloConfig.page_burn, warn_burn=SloConfig.warn_burn)
+    engine = SloEngine(config=cfg, component="efficiency")
+    for check in ("throughput", "padding"):
+        engine.add_objective(SloObjective(
+            f"efficiency:{check}", "efficiency",
+            eff_config.slo_target,
+            efficiency_verdict_source(reg, check),
+            detail={"check": check}))
+    return engine

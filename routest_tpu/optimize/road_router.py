@@ -49,6 +49,7 @@ from routest_tpu.optimize.hierarchy import (
     relax_from,
     tight_pred,
 )
+from routest_tpu.obs.efficiency import get_ledger
 from routest_tpu.obs.trace import trace_span
 from routest_tpu.utils.logging import get_logger
 
@@ -261,7 +262,7 @@ def _batcher_config() -> Tuple[bool, int, float]:
 
 class _BatchEntry:
     __slots__ = ("sources", "live", "key", "event", "dist", "pred", "error",
-                 "dispatch_rows", "dispatch_requests")
+                 "dispatch_rows", "dispatch_requests", "t_q")
 
     def __init__(self, sources: np.ndarray, live, key) -> None:
         self.sources = sources
@@ -275,6 +276,8 @@ class _BatchEntry:
         # solve span says whether it rode a 1-row or a 32-row merge).
         self.dispatch_rows = 0
         self.dispatch_requests = 0
+        # Enqueue stamp for the goodput ledger's queue/compute split.
+        self.t_q = time.monotonic()
 
 
 class _SolveBatcher:
@@ -411,6 +414,8 @@ class _SolveBatcher:
     def _dispatch(self, batch: List[_BatchEntry]) -> None:
         merged = (batch[0].sources if len(batch) == 1
                   else np.concatenate([it.sources for it in batch]))
+        queue_s = max(0.0, time.monotonic() - min(it.t_q for it in batch))
+        t0 = time.perf_counter()
         try:
             dist, pred = self._router._solve_rows(merged, batch[0].live)
         except BaseException as e:  # propagate to every merged caller
@@ -418,6 +423,13 @@ class _SolveBatcher:
                 it.error = e
                 it.event.set()
             return
+        # _solve_rows pads the source axis to the next pow2 — that IS
+        # the launched batch the goodput ledger accounts against.
+        n = len(merged)
+        bucket = 1 << max(0, n - 1).bit_length()
+        get_ledger().record(
+            "route_solve", real_rows=n, padded_rows=bucket, bucket=bucket,
+            queue_s=queue_s, compute_s=time.perf_counter() - t0)
         pos = 0
         for it in batch:
             m = len(it.sources)
@@ -1100,7 +1112,18 @@ class RoadRouter:
         batcher = self._solve_batcher
         if batcher is not None and 0 < len(source_nodes) <= batcher.max_rows:
             return batcher.solve(source_nodes, live)
-        return self._solve_rows(source_nodes, live)
+        # Direct path (batcher off, or oversized request): still a
+        # padded device launch the goodput ledger must see.
+        n = len(source_nodes)
+        t0 = time.perf_counter()
+        out = self._solve_rows(source_nodes, live)
+        if n > 0:
+            bucket = 1 << max(0, n - 1).bit_length()
+            get_ledger().record(
+                "route_solve", real_rows=n, padded_rows=bucket,
+                bucket=bucket, compute_s=time.perf_counter() - t0,
+                oversized=batcher is not None and n > batcher.max_rows)
+        return out
 
     def _solve_rows(self, source_nodes: np.ndarray,
                     live: Optional[_LiveMetric] = None):

@@ -273,6 +273,22 @@ def create_app(config: Optional[Config] = None,
             epoch_fn=_live_epoch, sim_restart=_sim_restart)
         app.dispatch = state.dispatch
 
+    # Device efficiency (docs/OBSERVABILITY.md "Device efficiency &
+    # goodput"): the goodput ledger is always-on accounting inside the
+    # batchers; here the replica arms the throughput-regression
+    # watchdog against the committed battery curve. A missing or
+    # foreign-backend artifact degrades to ledger-only — surfaced via
+    # /api/health and /api/efficiency, never silently.
+    from routest_tpu.core.config import load_efficiency_config
+    from routest_tpu.obs.efficiency import EfficiencyWatchdog, get_ledger
+
+    app.efficiency = None
+    eff_cfg = load_efficiency_config()
+    if eff_cfg.enabled and eff_cfg.watchdog:
+        app.efficiency = EfficiencyWatchdog(eff_cfg, recorder=recorder)
+        if app.efficiency.arm():
+            app.efficiency.start()
+
     # ── optimization ────────────────────────────────────────────────────
 
     @app.route("/api/request_route", methods=("POST",))
@@ -1046,6 +1062,26 @@ def create_app(config: Optional[Config] = None,
         app.slo.tick()
         return app.slo.snapshot(), 200
 
+    @app.route("/api/efficiency", methods=("GET",))
+    def efficiency_state(request):
+        # Device goodput surface (docs/OBSERVABILITY.md "Device
+        # efficiency & goodput"): per-program real/padded/cached row
+        # totals, live per-bucket goodput windows, and the watchdog's
+        # pin/verdict state. A request forces a fresh watchdog tick so
+        # the verdicts reflect NOW, not the last ticker wakeup.
+        out = {"enabled": get_ledger().enabled,
+               "ledger": get_ledger().snapshot()}
+        wd = app.efficiency
+        if wd is None:
+            out["watchdog"] = {"armed": False,
+                               "status": "disabled"
+                               if not eff_cfg.watchdog else "unarmed"}
+        else:
+            if wd.armed:
+                wd.tick()
+            out["watchdog"] = wd.snapshot()
+        return out, 200
+
     @app.route("/api/timeline", methods=("GET",))
     def timeline_query(request):
         # Metric history (docs/OBSERVABILITY.md "Metric timeline"):
@@ -1203,6 +1239,15 @@ def create_app(config: Optional[Config] = None,
                 "transformer": bool(r.has_transformer),
                 **r.solver_info,
             }
+        # Device-efficiency gauge: the goodput watchdog's armed state.
+        # A degraded watchdog (missing/foreign-backend artifact) is the
+        # LOUD surface the ledger-only fallback promises — it shows up
+        # here, not just behind /api/efficiency.
+        if get_ledger().enabled or app.efficiency is not None:
+            engine_res["efficiency"] = (
+                app.efficiency.health() if app.efficiency is not None
+                else {"ledger": get_ledger().enabled,
+                      "watchdog": "disabled"})
         # Live-traffic gauge: armed/ready state + estimator coverage +
         # serving metric epoch (absent entirely when RTPU_LIVE is off —
         # the frozen-world health shape is unchanged).

@@ -43,6 +43,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from routest_tpu.obs import get_registry
+from routest_tpu.obs.efficiency import get_ledger
 
 
 class _Inflight:
@@ -209,6 +210,10 @@ class FastLane:
             self._m_misses.inc(misses)
         if coalesced:
             self._m_coalesced.inc(coalesced)
+        if hits or coalesced:
+            # Goodput the device never paid for: rows answered from
+            # cache or by riding an in-flight leader's computation.
+            get_ledger().record_cached("eta_score", hits + coalesced)
         if span is not None:
             span.set_attr("cache_hits", hits)
             span.set_attr("cache_misses", misses)

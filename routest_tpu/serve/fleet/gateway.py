@@ -1039,6 +1039,8 @@ class Gateway:
                     return self._slo()
                 if bare == "/api/probes":
                     return self._probes()
+                if bare == "/api/efficiency":
+                    return self._efficiency()
                 if bare == "/api/autoscale":
                     return self._autoscale()
                 if bare == "/api/rollout":
@@ -1103,6 +1105,44 @@ class Gateway:
                 engine's burn-rate snapshot."""
                 payload = {"enabled": False} if gw.prober is None \
                     else gw.prober.snapshot()
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
+
+            def _efficiency(self):
+                """Fleet device-goodput snapshot (docs/OBSERVABILITY.md
+                "Device efficiency & goodput"): every replica's
+                ``/api/efficiency`` (ledger + watchdog) in place, plus
+                a fleet rollup — per-program real/padded/cached row
+                totals summed across replicas and the set of replicas
+                whose watchdog is NOT armed (the loud ledger-only
+                degradation surface at fleet scope)."""
+                replicas = gw._fetch_replica_json("/api/efficiency")
+                fleet: dict = {"programs": {}, "degraded": [],
+                               "pages": 0}
+                for rid, snap in replicas.items():
+                    if not isinstance(snap, dict) or "ledger" not in snap:
+                        fleet["degraded"].append(rid)
+                        continue
+                    wd = snap.get("watchdog") or {}
+                    if not wd.get("armed"):
+                        fleet["degraded"].append(rid)
+                    fleet["pages"] += int(wd.get("pages") or 0)
+                    programs = (snap.get("ledger") or {}).get(
+                        "programs") or {}
+                    for prog, row in programs.items():
+                        agg = fleet["programs"].setdefault(
+                            prog, {"rows": 0, "padded_rows": 0,
+                                   "cached_rows": 0, "calls": 0,
+                                   "oversized": 0, "device_s": 0.0})
+                        for k in agg:
+                            agg[k] = round(
+                                agg[k] + (row.get(k) or 0), 6)
+                for prog, agg in fleet["programs"].items():
+                    pad = agg["padded_rows"]
+                    agg["waste_fraction"] = round(
+                        1.0 - agg["rows"] / pad, 4) if pad > 0 else 0.0
+                payload = {"fleet": fleet, "replicas": replicas}
                 self._respond(200,
                               [("Content-Type", "application/json")],
                               json.dumps(payload, default=str).encode())

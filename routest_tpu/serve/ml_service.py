@@ -31,6 +31,7 @@ from routest_tpu.core.mesh import MeshRuntime, pad_rows
 from routest_tpu.data.features import encode_requests
 from routest_tpu.models.eta_mlp import EtaMLP, Params
 from routest_tpu.obs import get_registry
+from routest_tpu.obs.efficiency import get_ledger
 from routest_tpu.obs.export import maybe_device_trace
 from routest_tpu.obs.trace import trace_span
 from routest_tpu.serve.deadline import DeadlineExceeded
@@ -176,7 +177,7 @@ class _Pending:
     oversized submissions and slab overflow."""
 
     __slots__ = ("rows", "slab", "offset", "n", "event", "result", "error",
-                 "deadline")
+                 "deadline", "t_q")
 
     def __init__(self, rows: Optional[np.ndarray] = None,
                  deadline: Optional[float] = None, *,
@@ -191,6 +192,9 @@ class _Pending:
         # Absolute time.monotonic() deadline captured from the ambient
         # request context at submit; None = no budget.
         self.deadline = deadline
+        # Enqueue stamp: the goodput ledger's queue-vs-compute split
+        # charges each launch the oldest rider's wait.
+        self.t_q = time.monotonic()
 
 
 class _WindowController:
@@ -577,6 +581,8 @@ class DynamicBatcher:
                 return
             try:
                 t_flush = time.perf_counter()
+                queue_s = max(0.0, time.monotonic()
+                              - min(p.t_q for p in batch))
                 with trace_span("batcher.flush", requests=cnt) as fs:
                     n = taken
                     bucket = self._bucket(n)
@@ -620,8 +626,13 @@ class DynamicBatcher:
                         # input; the slab is about to be recycled, so
                         # waiters must own their rows.
                         preds = preds.copy()
+                    compute_s = time.perf_counter() - t_dev
                     self._m_compute.labels(bucket=bucket).observe(
-                        time.perf_counter() - t_dev)
+                        compute_s)
+                get_ledger().record(
+                    "eta_score", real_rows=n, padded_rows=bucket,
+                    bucket=bucket, queue_s=queue_s, compute_s=compute_s,
+                    oversized=n > self._buckets[-1])
                 flush_dur = time.perf_counter() - t_flush
                 self._m_flush.observe(flush_dur)
                 self._flush_ewma_s += 0.3 * (flush_dur - self._flush_ewma_s)

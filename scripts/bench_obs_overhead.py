@@ -96,7 +96,7 @@ def _wait_ready(lt, proc, base: str, timeout: float = 300.0) -> None:
 MODES = (
     ("off", {"RTPU_OBS_TRACE": "0", "RTPU_RECORDER": "0",
              "RTPU_SLO": "0", "RTPU_TIMELINE": "0",
-             "RTPU_TAIL_SAMPLE": "0"}),
+             "RTPU_TAIL_SAMPLE": "0", "RTPU_EFF": "0"}),
     ("sampled", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "0.1",
                  "RTPU_RECORDER": "1", "RTPU_SLO": "1",
                  "RTPU_TIMELINE": "1"}),

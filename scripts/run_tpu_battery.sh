@@ -96,6 +96,16 @@ run_step probing timeout 2400 python scripts/bench_probing.py
 # (artifacts/dispatch.json). Extract + hierarchy + XLA caches persist
 # under artifacts/bench_cache/dispatch across battery rounds.
 run_step dispatch timeout 2400 python scripts/bench_dispatch.py
+# Device efficiency end to end (ISSUE 17): the goodput ledger +
+# throughput-regression watchdog on a live 2-replica fleet — an
+# injected device.compute slowdown and a forced pathological bucket
+# config must each page the efficiency SLO with a bundle naming
+# program/replica/bucket and the expected-vs-measured curve; the clean
+# fleet stays green across a flip and a verified swap; the always-on
+# ledger stays inside the ≤5% p95 budget (artifacts/efficiency.json).
+# Extract + hierarchy + XLA caches persist under
+# artifacts/bench_cache/efficiency across battery rounds.
+run_step efficiency timeout 2400 python scripts/bench_efficiency.py
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
 run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
   --osm-nodes 250000 --verify --flat-compare

@@ -40,6 +40,13 @@ def test_bench_emits_one_record_with_probe_evidence_and_roofline():
     # must carry the reason the accelerator window was not spent.
     assert rec["probes"], rec
     assert all("wall_s" in p for p in rec["probes"])
+    # Probe-failure rows carry the skip STRUCTURALLY (stage + reason
+    # dicts, plus the battery-wide host_caveat contract) — the forced
+    # CPU probe answer is exactly such a row.
+    assert isinstance(rec["skipped"], list) and rec["skipped"]
+    assert all(s["stage"] and s["reason"] for s in rec["skipped"])
+    assert rec["skipped"][0]["stage"] == "tpu_probe"
+    assert "cpu fallback" in rec["host_caveat"]
     # Roofline block (VERDICT r3 #7): auditable FLOPs accounting.
     roof = rec["roofline"]
     assert roof["flops_per_pred"] > 0

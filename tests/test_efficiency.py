@@ -1,0 +1,462 @@
+"""Device goodput ledger + throughput-regression watchdog (ISSUE 17):
+ledger accounting vs a hand-rolled oracle across every device program,
+curve pinning from battery artifacts (backend-matched like the
+placement planner), debounced verdicts, the dedicated efficiency SLO
+engine's page bundles (expected-vs-measured curve embedded), timeline
+visibility of the new families, and the serving surfaces
+(``/api/efficiency``, health, dispatch batcher queue/oversized stats).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from routest_tpu.core.config import (EfficiencyConfig,
+                                     load_efficiency_config,
+                                     load_timeline_config)
+from routest_tpu.dispatch.batcher import DispatchBatcher, DispatchProblem
+from routest_tpu.obs.efficiency import (FILL_BUCKETS, PROGRAMS,
+                                        EfficiencyWatchdog, GoodputLedger,
+                                        expected_rate, get_ledger,
+                                        pin_expected_curve)
+from routest_tpu.obs.registry import MetricsRegistry
+from routest_tpu.obs.slo import (build_efficiency_engine,
+                                 efficiency_verdict_source)
+from routest_tpu.obs.timeline import TimelineStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**env):
+    return load_efficiency_config({k: str(v) for k, v in env.items()})
+
+
+class FakeRecorder:
+    """Captures trigger() calls the way the watchdog drives the real
+    flight recorder."""
+
+    def __init__(self):
+        self.bundles = []
+        self.engines = []
+
+    def trigger(self, reason, detail=None, force=False, extra_files=None):
+        self.bundles.append({"reason": reason, "detail": detail,
+                             "force": force,
+                             "extra_files": extra_files or {}})
+        return f"/tmp/bundle-{len(self.bundles)}"
+
+    def register_slo_engine(self, engine):
+        self.engines.append(engine)
+
+
+def _watchdog(cfg=None, ledger=None, rec=None):
+    cfg = cfg or _cfg(RTPU_EFF_MIN_ROWS=10, RTPU_EFF_AFTER=2)
+    reg = MetricsRegistry()
+    led = ledger or GoodputLedger(cfg, registry=reg)
+    rec = rec or FakeRecorder()
+    wd = EfficiencyWatchdog(cfg, ledger=led, recorder=rec, registry=reg,
+                            replica="testhost:1234")
+    return wd, led, rec
+
+
+# ── ledger accounting vs oracle ──────────────────────────────────────
+
+def test_ledger_accounting_matches_oracle_across_all_programs():
+    cfg = _cfg()
+    reg = MetricsRegistry()
+    led = GoodputLedger(cfg, registry=reg)
+    rng = np.random.default_rng(7)
+    oracle = {}
+    for prog in PROGRAMS:
+        rows = padded = device = queue = calls = 0
+        for _ in range(17):
+            n = int(rng.integers(1, 200))
+            bucket = 1 << max(0, n - 1).bit_length()
+            c_s, q_s = float(rng.random()) * 0.01, float(rng.random()) * 0.002
+            led.record(prog, real_rows=n, padded_rows=bucket,
+                       bucket=bucket, queue_s=q_s, compute_s=c_s)
+            rows += n
+            padded += bucket
+            device += c_s
+            queue += q_s
+            calls += 1
+        oracle[prog] = (rows, padded, device, queue, calls)
+    snap = led.snapshot()
+    for prog in PROGRAMS:
+        rows, padded, device, queue, calls = oracle[prog]
+        got = snap["programs"][prog]
+        assert got["rows"] == pytest.approx(rows)
+        assert got["padded_rows"] == pytest.approx(padded)
+        assert got["device_s"] == pytest.approx(device, abs=1e-5)
+        assert got["queue_s"] == pytest.approx(queue, abs=1e-5)
+        assert got["calls"] == calls
+        # The waste gauge is the window view: same records, same math.
+        assert got["waste_fraction"] == pytest.approx(
+            1.0 - rows / padded, abs=1e-3)
+    # Fill histogram observed exactly one fraction per call.
+    hist = reg.get("rtpu_efficiency_bucket_fill")
+    for prog in PROGRAMS:
+        h = hist.labels(program=prog)
+        assert h.count == oracle[prog][4]
+
+
+def test_ledger_fill_fraction_lands_in_the_right_histogram_bucket():
+    cfg = _cfg()
+    reg = MetricsRegistry()
+    led = GoodputLedger(cfg, registry=reg)
+    # 8 real rows in a 64 bucket → fill 0.125 → first bound ≥ is 0.25.
+    led.record("eta_score", real_rows=8, padded_rows=64, bucket=64,
+               compute_s=0.01)
+    h = reg.get("rtpu_efficiency_bucket_fill").labels(program="eta_score")
+    assert h.buckets == FILL_BUCKETS
+    counts = dict(zip(list(h.buckets) + [float("inf")], h.counts))
+    assert counts[0.25] == 1 and counts[0.1] == 0
+
+
+def test_ledger_clamps_padded_rows_below_real():
+    led = GoodputLedger(_cfg(), registry=MetricsRegistry())
+    led.record("route_solve", real_rows=10, padded_rows=4, bucket=4,
+               compute_s=0.001)
+    got = led.snapshot()["programs"]["route_solve"]
+    assert got["padded_rows"] == pytest.approx(10)  # never < real
+    assert got["waste_fraction"] == pytest.approx(0.0)
+
+
+def test_ledger_cached_rows_and_oversized_are_separate_counters():
+    led = GoodputLedger(_cfg(), registry=MetricsRegistry())
+    led.record_cached("eta_score", 42)
+    led.record("eta_score", real_rows=5000, padded_rows=5000, bucket=4096,
+               compute_s=0.01, oversized=True)
+    got = led.snapshot()["programs"]["eta_score"]
+    assert got["cached_rows"] == pytest.approx(42)
+    assert got["oversized"] == 1
+    assert got["rows"] == pytest.approx(5000)  # cached rows not mixed in
+
+
+def test_ledger_disabled_records_nothing():
+    led = GoodputLedger(_cfg(RTPU_EFF=0), registry=MetricsRegistry())
+    led.record("eta_score", real_rows=100, padded_rows=128, bucket=128,
+               compute_s=0.01)
+    led.record_cached("eta_score", 5)
+    snap = led.snapshot()
+    assert snap["enabled"] is False
+    assert snap["programs"]["eta_score"]["rows"] == 0
+    assert led.window_rates("eta_score") == {}
+
+
+def test_window_rates_per_bucket_rate_and_fill():
+    led = GoodputLedger(_cfg(), registry=MetricsRegistry())
+    for _ in range(4):
+        led.record("eta_score", real_rows=50, padded_rows=64, bucket=64,
+                   compute_s=0.05)
+    led.record("eta_score", real_rows=500, padded_rows=512, bucket=512,
+               compute_s=0.1)
+    rates = led.window_rates("eta_score")
+    assert set(rates) == {64, 512}
+    assert rates[64]["rows"] == 200
+    assert rates[64]["rate"] == pytest.approx(200 / 0.2)
+    assert rates[64]["fill"] == pytest.approx(200 / 256, abs=1e-3)
+    assert rates[512]["rate"] == pytest.approx(5000.0)
+
+
+def test_process_ledger_singleton():
+    a, b = get_ledger(), get_ledger()
+    assert a is b
+
+
+# ── curve pinning (backend-matched, placement-planner style) ─────────
+
+def test_pin_expected_curve_matches_committed_artifact():
+    cfg = _cfg()
+    pin = pin_expected_curve(cfg, "cpu", chips=1)
+    assert pin["status"] == "pinned"
+    with open(os.path.join(REPO, "artifacts/serving_kernel.json")) as f:
+        rec = json.load(f)
+    assert pin["recorded_backend"] == rec["backend"] == "cpu"
+    for row in rec["rows"]:
+        batch = int(row["batch"])
+        # Conservative floor: the slower of the two healthy paths.
+        exp = min(float(row["xla_mpreds_s"]),
+                  float(row["aot_mpreds_s"])) * 1e6
+        assert pin["curve"][batch] == pytest.approx(exp, rel=1e-6)
+
+
+def test_pin_refuses_backend_mismatch(tmp_path):
+    art = tmp_path / "kernel.json"
+    art.write_text(json.dumps({"backend": "tpu", "rows": [
+        {"batch": 8, "xla_mpreds_s": 1.0, "aot_mpreds_s": 1.0}]}))
+    cfg = _cfg(RTPU_EFF_KERNEL_ARTIFACT=str(art))
+    pin = pin_expected_curve(cfg, "cpu")
+    assert pin["status"] == "backend_mismatch"
+    assert pin["recorded_backend"] == "tpu"
+    assert pin["runtime_backend"] == "cpu"
+
+
+def test_pin_missing_and_unreadable_artifacts(tmp_path):
+    cfg = _cfg(RTPU_EFF_KERNEL_ARTIFACT=str(tmp_path / "nope.json"))
+    assert pin_expected_curve(cfg, "cpu")["status"] == "no_artifact"
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    cfg = _cfg(RTPU_EFF_KERNEL_ARTIFACT=str(bad))
+    assert pin_expected_curve(cfg, "cpu")["status"] == "unreadable"
+
+
+def test_expected_rate_picks_nearest_bucket_log_scale():
+    pin = {"status": "pinned", "curve": {8: 100.0, 64: 200.0, 512: 300.0},
+           "chips_factor": 1.0}
+    assert expected_rate(pin, 8) == 100.0
+    assert expected_rate(pin, 16) == 100.0    # log-nearer to 8 than 64
+    assert expected_rate(pin, 128) == 200.0
+    assert expected_rate(pin, 4096) == 300.0  # clamps to the top row
+
+
+# ── watchdog verdicts: pin / compare / debounce / page ───────────────
+
+def test_watchdog_clean_tick_stays_green():
+    wd, led, rec = _watchdog()
+    assert wd.arm() is True
+    exp = expected_rate(wd.pin, 64)
+    # Healthy: measured exactly at the pinned rate.
+    for _ in range(6):
+        led.record("eta_score", real_rows=64, padded_rows=64, bucket=64,
+                   compute_s=64 / exp)
+        out = wd.tick()
+        assert out["throughput"]["verdict"] == "pass"
+    assert wd.pages == 0 and rec.bundles == []
+
+
+def test_watchdog_debounces_then_pages_once_with_curve_bundle():
+    wd, led, rec = _watchdog(_cfg(RTPU_EFF_MIN_ROWS=10, RTPU_EFF_AFTER=3))
+    wd.arm()
+    verdicts = []
+    for _ in range(8):
+        led.record("eta_score", real_rows=16, padded_rows=16, bucket=8,
+                   compute_s=4.0)  # ~4 rows/s, far under the pin
+        verdicts.append(wd.tick()["throughput"]["verdict"])
+    # First two bad rounds are still "pass" (PR-15 debounce convention).
+    assert verdicts[:2] == ["pass", "pass"]
+    assert verdicts[2] == "shortfall" and verdicts[-1] == "shortfall"
+    # The SLO transition pages exactly once for the sustained incident.
+    assert wd.pages == 1 and len(rec.bundles) == 1
+    b = rec.bundles[0]
+    assert b["reason"] == "efficiency_page" and b["force"] is True
+    assert b["detail"]["program"] == "eta_score"
+    assert b["detail"]["replica"] == "testhost:1234"
+    assert b["detail"]["bucket"] == 8
+    ev = json.loads(b["extra_files"]["efficiency_evidence.json"])
+    # Expected-vs-measured curve embedded, the offending bucket live.
+    curve = {row["bucket"]: row for row in ev["expected_vs_measured"]}
+    assert curve[8]["measured_rows_per_s"] == pytest.approx(4.0, rel=0.2)
+    assert curve[8]["expected_rows_per_s"] == pytest.approx(
+        expected_rate(wd.pin, 8), rel=1e-6)
+    assert ev["offender"]["consecutive_bad"] >= 3
+
+
+def test_watchdog_recovery_resets_the_debounce_counter():
+    wd, _, rec = _watchdog(_cfg(RTPU_EFF_MIN_ROWS=10, RTPU_EFF_AFTER=3))
+    wd.arm()
+    # Drive the debounce unit directly: the ledger window is cumulative,
+    # so a live alternating load converges to one blended rate — the
+    # reset semantics are the debouncer's own contract.
+    for i in range(12):
+        bad = i % 2 == 0   # alternate bad / healthy: never 3 consecutive
+        v = wd._debounce("throughput", bad, "shortfall",
+                         {"program": "eta_score", "bucket": 64})
+        assert v == "pass"
+    # Three consecutive bad rounds DO land the verdict.
+    for i in range(3):
+        v = wd._debounce("throughput", True, "shortfall",
+                         {"program": "eta_score", "bucket": 64})
+    assert v == "shortfall"
+    assert rec.bundles == []   # verdicts alone never page; the SLO does
+
+
+def test_watchdog_padding_waste_verdict_names_the_program():
+    wd, led, rec = _watchdog(_cfg(RTPU_EFF_MIN_ROWS=10, RTPU_EFF_AFTER=2,
+                                  RTPU_EFF_MAX_WASTE=0.5))
+    wd.arm()
+    for _ in range(4):
+        # 3 real rows launched as 4096-wide batches: pathological.
+        led.record("dispatch_solve", real_rows=3, padded_rows=4096,
+                   bucket=4096, compute_s=0.01)
+        out = wd.tick()
+    assert out["padding"]["dispatch_solve"]["verdict"] == "waste"
+    assert out["padding"]["dispatch_solve"]["bucket"] == 4096
+    assert wd.pages >= 1
+    b = rec.bundles[0]
+    ev = json.loads(b["extra_files"]["efficiency_evidence.json"])
+    assert ev["offender"]["program"] == "dispatch_solve"
+    assert ev["offender"]["waste_fraction"] > 0.99
+
+
+def test_watchdog_min_rows_floor_keeps_idle_buckets_unjudged():
+    wd, led, _ = _watchdog(_cfg(RTPU_EFF_MIN_ROWS=1000, RTPU_EFF_AFTER=1))
+    wd.arm()
+    # Terrible rate but only 16 rows of evidence: below the floor.
+    led.record("eta_score", real_rows=16, padded_rows=16, bucket=8,
+               compute_s=60.0)
+    out = wd.tick()
+    assert "throughput" not in out      # nothing met the evidence bar
+    assert wd.pages == 0
+
+
+def test_watchdog_degrades_to_ledger_only_without_artifact(tmp_path):
+    cfg = _cfg(RTPU_EFF_KERNEL_ARTIFACT=str(tmp_path / "gone.json"))
+    wd, led, rec = _watchdog(cfg)
+    assert wd.arm() is False
+    assert wd.armed is False
+    assert wd.tick() == {"armed": False, "status": "no_artifact"}
+    # Loudly surfaced: health names the degradation, ledger still on.
+    h = wd.health()
+    assert h == {"ledger": True, "watchdog": "degraded",
+                 "status": "no_artifact", "pages": 0}
+    assert rec.engines == []            # no SLO engine registered
+
+
+def test_watchdog_refuses_backend_mismatched_pin(tmp_path):
+    art = tmp_path / "kernel.json"
+    art.write_text(json.dumps({"backend": "tpu", "rows": [
+        {"batch": 8, "xla_mpreds_s": 1.0, "aot_mpreds_s": 1.0}]}))
+    wd, _, _ = _watchdog(_cfg(RTPU_EFF_KERNEL_ARTIFACT=str(art)))
+    assert wd.arm() is False
+    assert wd.health()["status"] == "backend_mismatch"
+    assert wd.health()["watchdog"] == "degraded"
+
+
+def test_watchdog_disabled_by_env():
+    cfg = _cfg(RTPU_EFF_WATCHDOG=0)
+    assert cfg.watchdog is False
+    cfg2 = _cfg(RTPU_EFF=0)
+    assert cfg2.enabled is False and cfg2.watchdog is True
+
+
+# ── the dedicated SLO engine ─────────────────────────────────────────
+
+def test_efficiency_verdict_source_prefix_matches_padding_programs():
+    reg = MetricsRegistry()
+    c = reg.counter("rtpu_efficiency_checks_total", "", ("check", "verdict"))
+    c.labels(check="throughput", verdict="pass").inc(7)
+    c.labels(check="throughput", verdict="shortfall").inc(3)
+    c.labels(check="padding:eta_score", verdict="pass").inc(5)
+    c.labels(check="padding:dispatch_solve", verdict="waste").inc(2)
+    assert efficiency_verdict_source(reg, "throughput")() == (10, 3)
+    assert efficiency_verdict_source(reg, "padding")() == (7, 2)
+
+
+def test_build_efficiency_engine_has_both_objectives():
+    eng = build_efficiency_engine(_cfg(), registry=MetricsRegistry())
+    snap = eng.snapshot()
+    assert snap["component"] == "efficiency"
+    names = set(snap["objectives"])
+    assert names == {"efficiency:throughput", "efficiency:padding"}
+
+
+# ── timeline visibility ──────────────────────────────────────────────
+
+def test_efficiency_families_flow_through_the_timeline():
+    reg = MetricsRegistry()
+    cfg = _cfg(RTPU_EFF_MIN_ROWS=10, RTPU_EFF_AFTER=1)
+    led = GoodputLedger(cfg, registry=reg)
+    wd = EfficiencyWatchdog(cfg, ledger=led, recorder=FakeRecorder(),
+                            registry=reg, replica="t:1")
+    wd.arm()
+    store = TimelineStore([reg],
+                          load_timeline_config({"RTPU_TIMELINE_RES": "1x4"}),
+                          component="t")
+    store.tick(1000.0)
+    led.record("eta_score", real_rows=50, padded_rows=64, bucket=64,
+               compute_s=0.01)
+    wd.tick()
+    store.tick(1001.0)
+    fams = store.frames()[-1]["families"]
+    assert "rtpu_efficiency_rows_total" in fams
+    assert "rtpu_efficiency_padded_rows_total" in fams
+    assert "rtpu_efficiency_checks_total" in fams
+    (row,) = fams["rtpu_efficiency_rows_total"]["series"]
+    assert row["labels"] == {"program": "eta_score"}
+    assert row["delta"] == pytest.approx(50)
+
+
+# ── serving surfaces ─────────────────────────────────────────────────
+
+@pytest.fixture()
+def app_client():
+    from routest_tpu.serve.app import create_app
+    app = create_app()
+    yield Client(app)
+    shutdown = getattr(app, "shutdown", None)
+    if callable(shutdown):
+        shutdown()
+
+
+def test_api_efficiency_route_and_health_surface(app_client):
+    d = app_client.get("/api/efficiency").get_json()
+    assert d["enabled"] is True
+    assert set(d["ledger"]["programs"]) == set(PROGRAMS)
+    # CPU-backend artifacts are committed, so the watchdog arms even in
+    # the hermetic suite (backend-matched, like the placement planner).
+    assert d["watchdog"]["armed"] is True
+    assert d["watchdog"]["status"] == "pinned"
+    assert d["watchdog"]["pin"]["recorded_backend"] == "cpu"
+    h = app_client.get("/api/health").get_json()
+    eff = h["checks"]["engine"]["efficiency"]
+    assert eff["watchdog"] == "armed" and eff["ledger"] is True
+
+
+def test_dispatch_batcher_stats_expose_queue_depth_and_oversized():
+    batcher = DispatchBatcher(max_rows=4)
+    rng = np.random.default_rng(3)
+
+    def _problem():
+        n = 4
+        d = rng.random((n + 1, n + 1)).astype(np.float32) + 0.1
+        d = (d + d.T) / 2
+        np.fill_diagonal(d, 0.0)
+        return DispatchProblem(d, np.ones(n, np.float32) * 0.1, 10.0, 1e9)
+
+    stats = batcher.stats()
+    assert stats["queue_depth"] == 0 and stats["oversized_batches"] == 0
+    # One caller with more rows than max_rows: the head entry rides a
+    # drain alone past max_rows — previously invisible, now counted.
+    batcher.solve([_problem() for _ in range(6)])
+    stats = batcher.stats()
+    assert stats["oversized_batches"] == 1
+    assert stats["queue_depth"] == 0    # drained
+
+
+def test_dispatch_batcher_reports_into_the_goodput_ledger():
+    led = get_ledger()
+    before = led.snapshot()["programs"]["dispatch_solve"]["rows"]
+    batcher = DispatchBatcher(max_rows=64)
+    rng = np.random.default_rng(5)
+    n = 4
+    d = rng.random((n + 1, n + 1)).astype(np.float32) + 0.1
+    d = (d + d.T) / 2
+    np.fill_diagonal(d, 0.0)
+    batcher.solve([DispatchProblem(d, np.ones(n, np.float32) * 0.1,
+                                   10.0, 1e9)])
+    after = led.snapshot()["programs"]["dispatch_solve"]["rows"]
+    assert after == before + 1          # one VRP problem = one row
+
+
+# ── config knobs ─────────────────────────────────────────────────────
+
+def test_load_efficiency_config_env_knobs():
+    cfg = load_efficiency_config({
+        "RTPU_EFF": "1", "RTPU_EFF_WATCHDOG": "1",
+        "RTPU_EFF_MIN_RATIO": "0.5", "RTPU_EFF_MAX_WASTE": "0.9",
+        "RTPU_EFF_AFTER": "7", "RTPU_EFF_TICK_S": "0.5",
+        "RTPU_EFF_WINDOW_S": "30", "RTPU_EFF_MIN_ROWS": "64",
+        "RTPU_EFF_KERNEL_ARTIFACT": "x.json",
+        "RTPU_EFF_CHIPS_ARTIFACT": "y.json",
+        "RTPU_EFF_SLO_TARGET": "0.95",
+        "RTPU_EFF_FAST_S": "10", "RTPU_EFF_SLOW_S": "100",
+    })
+    assert cfg == EfficiencyConfig(
+        enabled=True, watchdog=True, min_ratio=0.5, max_waste=0.9,
+        after=7, tick_s=0.5, window_s=30.0, min_rows=64,
+        kernel_artifact="x.json", chips_artifact="y.json",
+        slo_target=0.95, fast_window_s=10.0, slow_window_s=100.0)
