@@ -195,7 +195,9 @@ class MixedWorkload:
                  probe_obs: int = 4,
                  route_zipf_s: Optional[float] = None,
                  route_stops: int = 2,
-                 dispatch_stops: int = 4) -> None:
+                 dispatch_stops: int = 4,
+                 regions: Optional[Sequence[str]] = None,
+                 region_zipf_s: float = 1.1) -> None:
         mix = dict(mix if mix is not None else DEFAULT_MIX)
         unknown = set(mix) - set(self.KINDS)
         if unknown:
@@ -229,6 +231,33 @@ class MixedWorkload:
         # Zipf pair vocabulary (same skew: hot depots repeat as
         # byte-identical problems, which the dispatch batcher merges).
         self.dispatch_stops = int(dispatch_stops)
+        # Region affinity (multi-region serving, docs/LOADGEN.md):
+        # each client carries a seeded Zipf-skewed ``region`` hint — a
+        # hot region takes most of the demand, the tail regions see a
+        # trickle — appended as a ``?region=`` query parameter (the
+        # geo-front's routing hint; single-region stacks ignore it).
+        # Skew matters here for the same reason OD skew does: a
+        # survivable-region-loss test is only honest when the DEAD
+        # region was the hot one.
+        self.regions: Tuple[str, ...] = tuple(regions or ())
+        if region_zipf_s < 0:
+            raise ValueError("region zipf exponent must be >= 0")
+        self.region_zipf_s = float(region_zipf_s)
+
+    def _region_draws(self, n: int) -> Optional[np.ndarray]:
+        if not self.regions:
+            return None
+        rng = np.random.default_rng((self.seed, 11))
+        ranks = np.arange(1, len(self.regions) + 1, dtype=np.float64)
+        weights = ranks ** -self.region_zipf_s
+        weights /= weights.sum()
+        return rng.choice(len(self.regions), size=max(n, 1), p=weights)
+
+    @staticmethod
+    def _with_region(req: PlannedRequest, region: str) -> PlannedRequest:
+        sep = "&" if "?" in req.path else "?"
+        return dataclasses.replace(
+            req, path=f"{req.path}{sep}region={region}")
 
     def sequence(self, n: int) -> List[PlannedRequest]:
         rng = np.random.default_rng((self.seed, 2))
@@ -303,6 +332,10 @@ class MixedWorkload:
                     {"items": [self.od.body_for_pair(int(r))
                                for r in rows]},
                     "/api/predict_eta_batch"))
+        region_ids = self._region_draws(n)
+        if region_ids is not None:
+            out = [self._with_region(req, self.regions[int(r)])
+                   for req, r in zip(out, region_ids)]
         return out
 
     def describe(self) -> dict:
@@ -318,4 +351,7 @@ class MixedWorkload:
             out["probe_obs"] = self.probe_obs
         if self.mix.get("dispatch"):
             out["dispatch_stops"] = self.dispatch_stops
+        if self.regions:
+            out["regions"] = list(self.regions)
+            out["region_zipf_s"] = self.region_zipf_s
         return out

@@ -835,6 +835,15 @@ class BlackboxProber:
             }
         # Skew dimensions, each debounced over skew_after rounds.
         offenders: Dict[str, List[str]] = {}
+        if self.config.fanout_reach:
+            # Reachability as a named dimension (cross-region mode): a
+            # target that answered nothing while the rest of the
+            # fan-out set did is an offender — at region scope that is
+            # a dead region, and it must page BY NAME rather than ride
+            # silently in the per-target evidence.
+            unreached = sorted(r for r, e in per.items() if "error" in e)
+            if unreached:
+                offenders["reach"] = unreached
         epochs = {r: e["epoch"] for r, e in per.items() if "epoch" in e}
         if len(epochs) >= 2:
             top = max(epochs.values())
@@ -857,7 +866,9 @@ class BlackboxProber:
             offenders["model"] = off
         verdict = PASS
         evidence: dict = {"per_replica": _thin(per)}
-        for dim in ("epoch", "model"):
+        dims = ("epoch", "model", "reach") if self.config.fanout_reach \
+            else ("epoch", "model")
+        for dim in dims:
             if dim in offenders:
                 self._skew_rounds[dim] = self._skew_rounds.get(dim, 0) + 1
                 self._skew_offenders[dim] = offenders[dim]
@@ -871,11 +882,14 @@ class BlackboxProber:
                     else 0.0)
             if persisted:
                 verdict = SKEW
+                detail = {"epochs": epochs} if dim == "epoch" else \
+                    {"errors": {r: per[r].get("error")
+                                for r in offenders[dim]}} \
+                    if dim == "reach" else {"fingerprints": prints}
                 evidence.setdefault("dimensions", {})[dim] = {
                     "replicas": offenders[dim],
                     "rounds": self._skew_rounds[dim],
-                    **({"epochs": epochs} if dim == "epoch" else
-                       {"fingerprints": prints}),
+                    **detail,
                 }
         if verdict == SKEW:
             evidence["replicas"] = sorted(

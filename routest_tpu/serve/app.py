@@ -780,6 +780,11 @@ def create_app(config: Optional[Config] = None,
         event = {"t": float(data.get("t") or time.time()),
                  "driver": str(data.get("driver") or "http"),
                  "obs": [[int(e), float(s)] for e, s in obs]}
+        # Cross-region replication tag (live/bridge.py): an HTTP-
+        # sourced frame that already crossed a bridge keeps its origin
+        # stamp, so republishing it here cannot re-enter the ring.
+        if data.get("origin_region") is not None:
+            event["origin_region"] = str(data["origin_region"])
         if data.get("hour") is not None:
             try:
                 event["hour"] = int(data["hour"]) % 24

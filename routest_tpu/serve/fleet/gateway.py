@@ -184,6 +184,10 @@ class Gateway:
                  config: Optional[FleetConfig] = None,
                  supervisor=None, version: Optional[str] = None) -> None:
         self.config = config or FleetConfig()
+        # Region label (multi-region deployments, ``RTPU_REGION``):
+        # stamped on every rollup this gateway merges so frames/rows
+        # from two gateways never collide replica names downstream.
+        self.region = self.config.region or ""
         self.supervisor = supervisor
         self.replicas = [_Upstream(f"r{i}", host, port, version=version)
                          for i, (host, port) in enumerate(targets)]
@@ -931,6 +935,8 @@ class Gateway:
                 "draining": self.draining,
                 "canary_fraction": self._canary_fraction,
             }
+            if self.region:
+                fleet["region"] = self.region
         if self.supervisor is not None:
             sup = self.supervisor.snapshot()
             for rid, info in sup.items():
@@ -1143,6 +1149,8 @@ class Gateway:
                     agg["waste_fraction"] = round(
                         1.0 - agg["rows"] / pad, 4) if pad > 0 else 0.0
                 payload = {"fleet": fleet, "replicas": replicas}
+                if gw.region:
+                    payload["region"] = gw.region
                 self._respond(200,
                               [("Content-Type", "application/json")],
                               json.dumps(payload, default=str).encode())
@@ -1269,6 +1277,8 @@ class Gateway:
                         scope=scope, family=family, window_s=window)
                     payload["enabled"] = True
                     payload["scraper"] = gw.fleet_timeline.snapshot()
+                if gw.region:
+                    payload["region"] = gw.region
                 self._respond(200,
                               [("Content-Type", "application/json")],
                               json.dumps(payload, default=str).encode())

@@ -96,6 +96,18 @@ run_step probing timeout 2400 python scripts/bench_probing.py
 # (artifacts/dispatch.json). Extract + hierarchy + XLA caches persist
 # under artifacts/bench_cache/dispatch across battery rounds.
 run_step dispatch timeout 2400 python scripts/bench_dispatch.py
+# Multi-region failover end to end (ISSUE 18): two full fleets behind
+# the geo-front with the probe-bus bridge — a corridor jam in region
+# east must reach region west's served metric within a bounded window;
+# a region.kill on east must page the cross-region fan-out probe by
+# name while the survivor absorbs the redirected traffic (shed
+# bounded, staleness bounded+metered, journal holding every write);
+# the rejoined region must catch up (journal drained, bridge replay)
+# with a quiet clean window (artifacts/region_failover.json, with
+# structural host_caveat/skipped fields). Extract + hierarchy + XLA
+# caches persist under artifacts/bench_cache/region_failover across
+# battery rounds.
+run_step region_failover timeout 2400 python scripts/bench_region_failover.py
 # Device efficiency end to end (ISSUE 17): the goodput ledger +
 # throughput-regression watchdog on a live 2-replica fleet — an
 # injected device.compute slowdown and a forced pathological bucket

@@ -149,6 +149,84 @@ def test_broker_slow_subscriber_dropped_not_blocking(broker):
     slow.close()
 
 
+def test_netbus_publish_replay_across_broker_restart_many_channels():
+    """Degraded-mode publish replay (the RTPU_NETBUS_RECONNECT_S path)
+    across a FULL broker restart at bridge-scale channel counts: one
+    frame per channel buffered while the broker is down must land in
+    the restarted broker — per channel, in order — once the reconnect
+    loop drains. This is the 'bridge replay' a rejoining region's live
+    state catches up from."""
+    broker, _ = start_broker()
+    port = broker.port
+    bus = NetBus(f"tcp://127.0.0.1:{port}", reconnect_s=0.2)
+    n_ch = 64
+    assert bus.ping()
+    broker.shutdown()
+    broker.server_close()
+    # drop the cached keep-alive conn: its zombie handler thread would
+    # otherwise keep ACKing publishes into the dead broker's memory
+    bus._reset()
+    buffered = 0
+    for i in range(n_ch):
+        # receivers=0 is the honest degraded answer; nothing raised
+        assert bus.publish(f"br-{i}", {"i": i, "phase": "down"}) == 0
+        buffered += 1
+    assert bus.replay_depth == buffered == n_ch
+    broker2, _ = start_broker(port=port)
+    try:
+        deadline = time.monotonic() + 30.0
+        while bus.replay_depth > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert bus.replay_depth == 0, "replay buffer never drained"
+        # every channel's frame is in the NEW broker's replay ring: a
+        # late subscriber (a bridge re-subscribing after region loss)
+        # resumes it from id 0
+        for i in (0, 31, n_ch - 1):
+            sub = bus.subscribe(f"br-{i}", last_event_id=0)
+            assert sub.get(timeout=2.0) == {"i": i, "phase": "down"}
+            sub.close()
+    finally:
+        broker2.shutdown()
+        broker2.server_close()
+
+
+def test_netbus_reconnecting_subscription_survives_broker_restart():
+    """A reconnect_s subscription (what the cross-region bridge rides)
+    re-establishes itself against a restarted broker at the same
+    address and keeps delivering frames published afterwards."""
+    broker, _ = start_broker()
+    port = broker.port
+    bus = NetBus(f"tcp://127.0.0.1:{port}", reconnect_s=0.1)
+    sub = bus.subscribe("probes")
+    bus.publish("probes", {"phase": "before"})
+    assert sub.get(timeout=2.0) == {"phase": "before"}
+    # kill the broker AND its live handler sockets (a SIGKILLed region
+    # takes both down at once)
+    with broker._subs_lock:
+        handlers = {h for hs in broker._subs.values() for h in hs}
+    broker.shutdown()
+    broker.server_close()
+    for h in handlers:
+        try:
+            h.connection.close()
+        except OSError:
+            pass
+    broker2, _ = start_broker(port=port)
+    try:
+        deadline = time.monotonic() + 30.0
+        got = None
+        while got is None and time.monotonic() < deadline:
+            # publish until the resubscribed stream delivers (the
+            # reconnect happens inside sub.get)
+            bus.publish("probes", {"phase": "after"})
+            got = sub.get(timeout=0.5)
+        assert got == {"phase": "after"}
+    finally:
+        sub.close()
+        broker2.shutdown()
+        broker2.server_close()
+
+
 def test_broker_replay_rings_bounded_per_channel(broker):
     bus = NetBus(f"tcp://127.0.0.1:{broker.port}")
     for i in range(Broker.HISTORY * 3):
