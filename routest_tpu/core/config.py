@@ -413,6 +413,33 @@ class ProberConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class WireConfig:
+    """Binary wire serving path (``routest_tpu/serve/wirecodec.py`` +
+    ``serve/wirechannel.py``): the length-prefixed columnar format
+    negotiated by content-type on ``/api/predict_eta_batch`` and
+    ``/api/matrix``, and the persistent multiplexed gateway→replica
+    channel that carries it without a per-request HTTP exchange. All
+    knobs are ``RTPU_WIRE*`` env vars; **off by default** — when
+    disabled the replica rejects the wire content-type with 415 and no
+    channel sockets exist anywhere.
+
+    The channel listen port is ``port`` when set explicitly, else
+    ``PORT + port_offset`` derived per replica (the fleet supervisor
+    sets ``PORT`` per worker, so one shared env yields distinct wire
+    ports); the gateway derives each replica's channel address the same
+    way and falls back to plain HTTP (wire frames as the request body)
+    whenever a channel connect fails — e.g. autoscaler-grown replicas
+    on arbitrary free ports. ``max_frame_mb`` bounds a single frame in
+    BOTH directions, decode-side before any per-row work."""
+
+    enabled: bool = False
+    channel: bool = True           # persistent mux channel (vs HTTP only)
+    port: int = 0                  # explicit channel port (0 = derive)
+    port_offset: int = 1000        # derived channel port = PORT + offset
+    max_frame_mb: float = 64.0
+
+
+@dataclasses.dataclass(frozen=True)
 class EfficiencyConfig:
     """Device goodput ledger + throughput-regression watchdog
     (``routest_tpu/obs/efficiency.py``). All knobs are ``RTPU_EFF_*``
@@ -992,6 +1019,20 @@ def load_prober_config(
     )
 
 
+def load_wire_config(env: Optional[Mapping[str, str]] = None) -> WireConfig:
+    """Just the binary-wire knobs (read lazily by the replica app, the
+    worker boot, the gateway, and the prober — none of which should pay
+    a full Config build for them)."""
+    env = dict(env if env is not None else os.environ)
+    return WireConfig(
+        enabled=env.get("RTPU_WIRE", "0") == "1",
+        channel=env.get("RTPU_WIRE_CHANNEL", "1") != "0",
+        port=_env_num(env, "RTPU_WIRE_PORT", 0, int),
+        port_offset=_env_num(env, "RTPU_WIRE_PORT_OFFSET", 1000, int),
+        max_frame_mb=_env_num(env, "RTPU_WIRE_MAX_FRAME_MB", 64.0, float),
+    )
+
+
 def load_efficiency_config(
         env: Optional[Mapping[str, str]] = None) -> EfficiencyConfig:
     """Just the goodput-ledger/watchdog knobs (read lazily by
@@ -1210,6 +1251,14 @@ KNOWN_KNOBS: Mapping[str, str] = {
     "ROUTEST_MAIL_FILE": "mbox-JSONL mail transport path",
     "ROUTEST_TILE_URL": "external tile server probed by /api/health",
     "RTPU_MAX_BODY_MB": "request body size limit (413 beyond)",
+    # Binary wire serving path (WireConfig/load_wire_config above —
+    # declared here too so the drift gate's registry stays one list).
+    "RTPU_WIRE": "binary wire serving path opt-in (codec + channel)",
+    "RTPU_WIRE_CHANNEL": "persistent gateway→replica wire channel on/off",
+    "RTPU_WIRE_PORT": "explicit wire-channel listen port (0 = derive)",
+    "RTPU_WIRE_PORT_OFFSET": "derived wire-channel port = PORT + offset",
+    "RTPU_WIRE_MAX_FRAME_MB": "single wire frame size bound, both "
+                              "directions",
     # Native helpers / data ingest.
     "ROUTEST_NATIVE": "C accelerators opt-in/out",
     "ROUTEST_NATIVE_CACHE": "native build cache directory",

@@ -75,9 +75,13 @@ class KeepAliveClient:
 
     def send(self, req: PlannedRequest):
         """→ (status, body bytes); raises on double transport failure."""
-        body = json.dumps(req.body).encode() if req.body is not None \
-            else None
-        headers = {"Content-Type": "application/json"} if body else {}
+        if isinstance(req.body, (bytes, bytearray, memoryview)):
+            body = bytes(req.body)   # pre-encoded (binary wire frames)
+        elif req.body is not None:
+            body = json.dumps(req.body).encode()
+        else:
+            body = None
+        headers = {"Content-Type": req.content_type} if body else {}
         try:
             self._conn.request(req.method, req.path, body=body,
                                headers=headers)

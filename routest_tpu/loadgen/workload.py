@@ -42,12 +42,17 @@ _TRAFFIC = ("Low", "Medium", "High", "Jam")
 @dataclasses.dataclass(frozen=True)
 class PlannedRequest:
     """One unit of offered work: enough to send it and to label the
-    result in the report."""
+    result in the report.
+
+    ``body`` is either a JSON-able dict (sent as application/json) or
+    raw ``bytes`` (sent verbatim under ``content_type`` — the binary
+    wire path, docs/API.md "Binary wire format")."""
 
     method: str
     path: str
-    body: Optional[dict]
+    body: Optional[object]      # dict (JSON) | bytes (pre-encoded)
     route: str                  # report label (path sans query/params)
+    content_type: str = "application/json"
 
 
 def _haversine_m(lat1: float, lon1: float, lat2: float, lon2: float) -> float:
@@ -197,7 +202,8 @@ class MixedWorkload:
                  route_stops: int = 2,
                  dispatch_stops: int = 4,
                  regions: Optional[Sequence[str]] = None,
-                 region_zipf_s: float = 1.1) -> None:
+                 region_zipf_s: float = 1.1,
+                 wire_format: str = "json") -> None:
         mix = dict(mix if mix is not None else DEFAULT_MIX)
         unknown = set(mix) - set(self.KINDS)
         if unknown:
@@ -243,6 +249,16 @@ class MixedWorkload:
         if region_zipf_s < 0:
             raise ValueError("region zipf exponent must be >= 0")
         self.region_zipf_s = float(region_zipf_s)
+        # Batch-ETA transport (docs/LOADGEN.md "Wire format"): "json"
+        # sends the row-shaped items body; "binary" pre-encodes the
+        # SAME seeded rows into a wire frame (client-side
+        # ``encode_requests`` featurization + RTW1 framing), so open-
+        # loop benches measure the zero-copy path with identical
+        # offered traffic. Bodies stay byte-stable per (params, seed)
+        # in both modes.
+        if wire_format not in ("json", "binary"):
+            raise ValueError("wire_format must be 'json' or 'binary'")
+        self.wire_format = wire_format
 
     def _region_draws(self, n: int) -> Optional[np.ndarray]:
         if not self.regions:
@@ -258,6 +274,34 @@ class MixedWorkload:
         sep = "&" if "?" in req.path else "?"
         return dataclasses.replace(
             req, path=f"{req.path}{sep}region={region}")
+
+    def _wire_batch(self, row_pair_ids: np.ndarray) -> bytes:
+        """The binary twin of the items-shaped batch body: featurize
+        the same Zipf rows client-side with the server's own
+        ``encode_requests`` and frame them (RTW1). Deterministic in the
+        pair ids, so a (params, seed) pair maps to one exact byte
+        string — same contract as the JSON bodies."""
+        import datetime as _dt
+
+        from routest_tpu.data.features import encode_requests
+        from routest_tpu.serve.wirecodec import encode_eta_request
+
+        bodies = [self.od.body_for_pair(int(r)) for r in row_pair_ids]
+        pickups = [_dt.datetime.fromisoformat(b["pickup_time"])
+                   for b in bodies]
+        features = encode_requests(
+            weather=[b["weather"] for b in bodies],
+            traffic=[b["traffic"] for b in bodies],
+            weekday=[p.weekday() for p in pickups],
+            hour=[p.hour for p in pickups],
+            distance_km=[b["summary"]["distance"] / 1000.0
+                         for b in bodies],
+            driver_age=[b["driver_age"] for b in bodies])
+        pickup_ms = np.asarray(
+            [np.datetime64(b["pickup_time"], "ms") for b in bodies],
+            "datetime64[ms]").astype(np.int64)
+        return encode_eta_request(np.asarray(features, np.float32),
+                                  pickup_ms)
 
     def sequence(self, n: int) -> List[PlannedRequest]:
         rng = np.random.default_rng((self.seed, 2))
@@ -327,11 +371,18 @@ class MixedWorkload:
             else:  # predict_eta_batch
                 rows = self.od.pair_indices(self.batch_rows,
                                             seed_offset=1000 + pair)
-                out.append(PlannedRequest(
-                    "POST", "/api/predict_eta_batch",
-                    {"items": [self.od.body_for_pair(int(r))
-                               for r in rows]},
-                    "/api/predict_eta_batch"))
+                if self.wire_format == "binary":
+                    out.append(PlannedRequest(
+                        "POST", "/api/predict_eta_batch",
+                        self._wire_batch(rows),
+                        "/api/predict_eta_batch",
+                        content_type="application/x-rtpu-wire"))
+                else:
+                    out.append(PlannedRequest(
+                        "POST", "/api/predict_eta_batch",
+                        {"items": [self.od.body_for_pair(int(r))
+                                   for r in rows]},
+                        "/api/predict_eta_batch"))
         region_ids = self._region_draws(n)
         if region_ids is not None:
             out = [self._with_region(req, self.regions[int(r)])
@@ -345,7 +396,8 @@ class MixedWorkload:
                "sse_channel": self.sse_channel,
                "road_graph": self.road_graph,
                "route_zipf_s": self.route_od.s,
-               "route_stops": self.route_stops}
+               "route_stops": self.route_stops,
+               "wire_format": self.wire_format}
         if self.mix.get("probe"):
             out["probe_edges"] = self.probe_edges
             out["probe_obs"] = self.probe_obs

@@ -118,6 +118,29 @@ def golden_probe_body() -> dict:
     }
 
 
+def golden_wire_frame() -> bytes:
+    """The golden batch as a binary wire frame (docs/API.md "Binary
+    wire format"): the SAME rows as :func:`golden_probe_body`,
+    featurized client-side with the server's own ``encode_requests`` —
+    so the wire parity probe offers bit-identical model inputs over
+    both content-types."""
+    from routest_tpu.data.features import encode_requests
+    from routest_tpu.serve.wirecodec import encode_eta_request
+
+    body = golden_probe_body()
+    pickups = [dt.datetime.fromisoformat(p) for p in body["pickup_time"]]
+    features = encode_requests(
+        weather=body["weather"], traffic=body["traffic"],
+        weekday=[p.weekday() for p in pickups],
+        hour=[p.hour for p in pickups],
+        distance_km=[d / 1000.0 for d in body["distance_m"]],
+        driver_age=body["driver_age"])
+    pickup_ms = np.asarray(
+        [np.datetime64(p, "ms") for p in body["pickup_time"]],
+        "datetime64[ms]").astype(np.int64)
+    return encode_eta_request(np.asarray(features, np.float32), pickup_ms)
+
+
 def eta_columns(payload: dict) -> Dict[str, np.ndarray]:
     """The comparable numeric columns of a batch-predict answer: the
     median plus every quantile band, as float arrays (nulls → NaN, so
@@ -195,6 +218,25 @@ def _http_json(method: str, url: str, body: Optional[dict],
     if not isinstance(payload, dict):
         raise ProbeUnreachable("non-object response body")
     return payload, headers
+
+
+def _http_wire(url: str, frame: bytes, timeout: float,
+               probe: str) -> Tuple[bytes, Dict[str, str]]:
+    """One tagged binary-wire probe exchange → (raw frame bytes,
+    response headers). Same one-verdict rule as :func:`_http_json`:
+    transport errors and non-2xx (including 415 from a wire-disabled
+    replica) are :class:`ProbeUnreachable`."""
+    req = urllib.request.Request(
+        url, data=frame, method="POST",
+        headers={"Content-Type": "application/x-rtpu-wire",
+                 PROBE_HEADER: probe})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            headers = {k.lower(): v for k, v in resp.getheaders()}
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise ProbeUnreachable(f"{type(e).__name__}: {e}") from e
+    return raw, headers
 
 
 class SubgraphOracle:
@@ -367,6 +409,15 @@ class BlackboxProber:
         self.kinds = ["golden", "fanout", "dispatch"]
         if len(self.route_waypoints) >= 2:
             self.kinds += ["route", "matrix"]
+        # Wire parity probe (docs/API.md "Binary wire format"): armed
+        # only when the fleet actually serves the binary format. Its
+        # ``correctness:wire`` SLO lives in the prober's dedicated
+        # engine like every other kind — never in the user SLO
+        # families.
+        from routest_tpu.core.config import load_wire_config
+
+        if load_wire_config().enabled:
+            self.kinds.append("wire")
         # Pinned expectations (None = arming). golden: {col: vec};
         # route: float seconds; matrix: ndarray. Pinned-mode route
         # answers re-arm on metric-epoch flips (_pin_epoch tracks the
@@ -469,6 +520,8 @@ class BlackboxProber:
         if self._dispatch_armed():
             verdicts["dispatch"] = self._checked("dispatch",
                                                  self._probe_dispatch)
+        if "wire" in self.kinds:
+            verdicts["wire"] = self._checked("wire", self._probe_wire)
         verdicts["fanout"] = self._checked(
             "fanout", lambda: self._probe_fanout(targets))
         self._rounds += 1
@@ -558,6 +611,94 @@ class BlackboxProber:
                     return DIVERGENT, evidence
             # else: structural shape change (point↔quantile) — re-arm.
         self._pins["golden"] = got
+        return PASS, evidence
+
+    # ── wire parity (both content-types, compared bitwise) ────────────
+
+    def _probe_wire(self) -> Tuple[str, Optional[dict]]:
+        """Send the golden batch over BOTH content-types through the
+        gateway and compare the answers bitwise (tolerance 0.0 — the
+        wire format's contract is exact parity with JSON, not
+        closeness). Columns compared: rounded minutes, every quantile
+        band, and the completion timestamps. Any divergence pages
+        ``correctness:wire``."""
+        from routest_tpu.serve.wirecodec import WireError, \
+            decode_eta_response
+
+        url = f"{self.gateway_base}/api/predict_eta_batch"
+        try:
+            json_payload, headers = _http_json(
+                "POST", url, golden_probe_body(),
+                self.config.timeout_s, probe="wire")
+            raw, wire_headers = _http_wire(
+                url, golden_wire_frame(), self.config.timeout_s,
+                probe="wire")
+        except ProbeUnreachable as e:
+            return UNREACHABLE, {"error": str(e)}
+        evidence: dict = {
+            "trace_id": headers.get("x-trace-id"),
+            "request": "golden_probe_body() over both content-types",
+        }
+        replicas = sorted({r for r in (headers.get("x-rtpu-replica"),
+                                       wire_headers.get("x-rtpu-replica"))
+                           if r})
+        if replicas:
+            evidence["replicas"] = replicas
+        try:
+            wire = decode_eta_response(raw)
+        except WireError as e:
+            # A 200 carrying an undecodable frame is a correctness
+            # defect of the wire path itself, not a transport blip.
+            evidence["error"] = f"undecodable wire response: {e}"
+            return DIVERGENT, evidence
+        minutes = np.asarray(wire["minutes"], np.float64)
+        finite = np.isfinite(minutes)
+        wire_cols = {"eta_minutes_ml":
+                     np.where(finite, np.round(minutes, 4), np.nan)}
+        for level, vals in wire["bands"].items():
+            vals = np.asarray(vals, np.float64)
+            ok = finite & np.isfinite(vals)
+            wire_cols[f"eta_minutes_ml_{level}"] = \
+                np.where(ok, np.round(vals, 4), np.nan)
+        json_cols = eta_columns(json_payload)
+        mismatched: List[str] = []
+        worst = 0.0
+        for key in sorted(set(json_cols) | set(wire_cols)):
+            a, b = json_cols.get(key), wire_cols.get(key)
+            if a is None or b is None or a.shape != b.shape:
+                mismatched.append(key)
+                worst = float("inf")
+                continue
+            same = (a == b) | (np.isnan(a) & np.isnan(b))
+            if not bool(same.all()):
+                mismatched.append(key)
+                diffs = np.abs(a - b)[~same]
+                gap = float(np.max(diffs)) if np.isfinite(diffs).all() \
+                    else float("inf")
+                worst = max(worst, gap)
+        # Completion instants: the wire epoch-ms column rendered at
+        # second precision must match the JSON ISO strings exactly
+        # (same float64 truncation by construction).
+        iso_all = np.datetime_as_string(
+            np.asarray(wire["completion_ms"],
+                       np.int64).astype("datetime64[ms]"), unit="s")
+        wire_iso = [str(s) if ok else None
+                    for s, ok in zip(iso_all, finite)]
+        json_iso = json_payload.get("eta_completion_time_ml")
+        if wire_iso != json_iso:
+            mismatched.append("eta_completion_time_ml")
+            worst = max(worst, float("inf"))
+        if mismatched:
+            evidence.update({
+                "divergence": worst,
+                "tolerance": 0.0,
+                "columns": mismatched,
+                "served_json": {k: v.tolist()
+                                for k, v in json_cols.items()},
+                "served_wire": {k: np.asarray(v).tolist()
+                                for k, v in wire_cols.items()},
+            })
+            return DIVERGENT, evidence
         return PASS, evidence
 
     # ── route / matrix (oracle or pinned) ─────────────────────────────

@@ -90,6 +90,32 @@ def main() -> None:
         # just surface it on the boot line.
         _log.info("hot_reload_watcher", interval_s=config.serve.reload_sec)
     app = create_app(config, eta_service=eta)
+    # Binary wire channel: when RTPU_WIRE=1 armed the app's wire
+    # handlers, expose them on a raw multiplexed TCP socket too (the
+    # gateway's preferred transport; HTTP negotiation stays available
+    # either way). Derived port keeps autoscaled replicas on random
+    # HTTP ports addressable: channel = http_port + offset.
+    from routest_tpu.core.config import load_wire_config
+
+    wire_cfg = load_wire_config()
+    wire_server = None
+    if wire_cfg.enabled and wire_cfg.channel and app.wire_handlers:
+        from routest_tpu.serve.wirechannel import WireChannelServer
+
+        wire_port = wire_cfg.port or (config.serve.port
+                                      + wire_cfg.port_offset)
+        wire_server = WireChannelServer(
+            app.wire_handlers, config.serve.host, wire_port,
+            max_frame_bytes=int(wire_cfg.max_frame_mb * 1024 * 1024))
+        try:
+            wire_server.start()   # logs wire_channel_listening itself
+        except OSError as e:
+            # A derived-port collision must not kill the worker: the
+            # HTTP negotiation path still serves wire frames, and the
+            # gateway falls back to it per request.
+            _log.warning("wire_channel_bind_failed", port=wire_port,
+                         error=str(e))
+            wire_server = None
     # HTTP/1.1 keep-alive: werkzeug defaults to 1.0 (connection-per-
     # request), which taxes every call with TCP setup + a fresh handler
     # thread. Persistent connections cut the serving tail roughly in half
@@ -105,6 +131,8 @@ def main() -> None:
     # then exit — the single-replica analog of the fleet's drain path
     # (a supervisor TERM must not kill a worker mid-request).
     run_with_graceful_shutdown(app, config.serve.host, config.serve.port)
+    if wire_server is not None:
+        wire_server.stop()
     _log.info("serve_stopped")
 
 

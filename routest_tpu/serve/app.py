@@ -25,7 +25,7 @@ import numpy as np
 
 from werkzeug.wrappers import Response
 
-from routest_tpu.core.config import Config, load_config
+from routest_tpu.core.config import Config, load_config, load_wire_config
 from routest_tpu.data.locations import locations_table
 from routest_tpu.obs import get_registry
 from routest_tpu.optimize.engine import (MAX_BATCH_PROBLEMS, _parse_problem,
@@ -37,8 +37,9 @@ from routest_tpu.serve.auth import AuthService, mount_auth
 from routest_tpu.serve.bus import make_bus, sse_stream
 from routest_tpu.serve.deadline import DeadlineExceeded
 from routest_tpu.serve.ml_service import EtaService
+from routest_tpu.serve import wirecodec
 from routest_tpu.serve.store import StoreUnavailable, make_store
-from routest_tpu.serve.wsgi import App, get_json
+from routest_tpu.serve.wsgi import App, get_json, json_response
 from routest_tpu.utils.logging import get_logger
 
 _log = get_logger("routest_tpu.serve")
@@ -411,6 +412,77 @@ def create_app(config: Optional[Config] = None,
                             r["properties"]["eta_completion_time_ml"] = str(ts)
         return {"count": len(items), "items": results}, 200
 
+    # ── binary wire path (docs/API.md "Binary wire format") ───────────
+    # Content-type-negotiated alternative representation of the two hot
+    # endpoints: ``application/x-rtpu-wire`` frames in, frames out, the
+    # SAME answers as JSON bit-for-bit (the prober's ``wire`` parity
+    # kind holds the two to that continuously). ONE implementation per
+    # endpoint serves both transports — the HTTP negotiation branch
+    # below and the persistent gateway channel (serve/wirechannel.py)
+    # call these handlers, which speak raw frame bytes →
+    # (status, frame bytes). Transport-level failures (413/429/504,
+    # gateway sheds) remain JSON; only request-level outcomes use
+    # error frames.
+    wire_cfg = load_wire_config()
+    app.wire_config = wire_cfg
+    _wire_max = int(wire_cfg.max_frame_mb * 1024 * 1024)
+
+    def _wire_eta(payload):
+        try:
+            frame = wirecodec.decode_eta_request(
+                payload, max_bytes=_wire_max, max_rows=131_072)
+        except wirecodec.WireError as e:
+            return 400, wirecodec.encode_error_frame(
+                400, f"malformed batch: {e}")
+        try:
+            result = state.eta.predict_eta_wire(
+                frame.columns["features"], frame.columns["pickup_ms"],
+                blob=frame.payload("features"))
+        except DeadlineExceeded:
+            raise  # → 504 via the transport layer, not a 503
+        except Exception as e:
+            _log.error("predict_wire_failed", error=str(e))
+            result = None
+        if result is None:
+            return 503, wirecodec.encode_error_frame(
+                503, "model unavailable")
+        minutes, completion_ms, bands = result
+        return 200, wirecodec.encode_eta_response(minutes, completion_ms,
+                                                  bands)
+
+    def _wire_matrix(payload):
+        try:
+            body = wirecodec.decode_matrix_request(payload,
+                                                   max_bytes=_wire_max)
+        except wirecodec.WireError as e:
+            return 400, wirecodec.encode_error_frame(400, str(e))
+        result = travel_matrix(body)
+        if "error" in result:
+            return 400, wirecodec.encode_error_frame(400, result["error"])
+        return 200, wirecodec.encode_matrix_response(result)
+
+    # Path → wire handler; the worker boot hands this dict to the
+    # channel server. Empty while the path is disabled: HTTP
+    # negotiation answers 415 and no channel listener ever starts.
+    app.wire_handlers = (
+        {"/api/predict_eta_batch": _wire_eta, "/api/matrix": _wire_matrix}
+        if wire_cfg.enabled else {})
+
+    def _wire_negotiated(request, path):
+        """None when the request is not wire content-type, else the
+        finished binary (or 415) Response."""
+        ct = (request.content_type or "").split(";", 1)[0].strip().lower()
+        if ct != wirecodec.WIRE_CONTENT_TYPE:
+            return None
+        fn = app.wire_handlers.get(path)
+        if fn is None:
+            return json_response(
+                {"error": "binary wire format disabled on this replica "
+                          "(RTPU_WIRE=1 enables it)"}, 415)
+        status, frame = fn(request.get_data())
+        return Response(frame, status=status,
+                        content_type=wirecodec.WIRE_CONTENT_TYPE)
+
     @app.route("/api/matrix", methods=("POST",))
     def matrix_endpoint(request):
         """Travel matrix — additive ABI (the ORS capability the
@@ -419,7 +491,11 @@ def create_app(config: Optional[Config] = None,
         "road_graph": bool, "sources"/"destinations": [idx], ...}`` →
         ``{"distances_m": S×D, "durations_s": S×D}``; road matrices are
         street-network shortest paths priced by the live leg models,
-        with unreachable pairs null."""
+        with unreachable pairs null. Also speaks the binary wire format
+        by content-type (docs/API.md "Binary wire format")."""
+        wired = _wire_negotiated(request, "/api/matrix")
+        if wired is not None:
+            return wired
         result = travel_matrix(get_json(request) or {})
         if "error" in result:
             return result, 400
@@ -471,7 +547,12 @@ def create_app(config: Optional[Config] = None,
 
         Response: ``{"count": N, "eta_minutes_ml": [..],
         "eta_completion_time_ml": [..]}`` / 503 when no model serves.
+        Also speaks the binary wire format by content-type
+        (docs/API.md "Binary wire format").
         """
+        wired = _wire_negotiated(request, "/api/predict_eta_batch")
+        if wired is not None:
+            return wired
         body = get_json(request) or {}
         try:
             if "items" in body:

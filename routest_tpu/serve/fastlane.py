@@ -100,6 +100,10 @@ class FastLane:
             "Requests that skipped the fast lane (over max_rows).")
         self._m_size = reg.gauge(
             "rtpu_cache_entries", "Live prediction-cache entries.")
+        self._m_wire_blob = reg.counter(
+            "rtpu_wire_copies_avoided_total",
+            "Prediction rows whose key bytes came straight from a wire "
+            "frame's buffer (no tobytes re-serialization of the batch).")
 
     # ── bookkeeping ───────────────────────────────────────────────────
 
@@ -148,11 +152,18 @@ class FastLane:
 
     def predict(self, rows: np.ndarray, generation,
                 compute: Callable[[np.ndarray], np.ndarray],
-                span=None) -> np.ndarray:
+                span=None, blob=None) -> np.ndarray:
         """``span`` (optional): a trace span to stamp with THIS
         request's cache provenance (hits/misses/coalesced) — a
         tail-sampled slow trace then says whether the fast lane helped
-        or the rows paid full device price."""
+        or the rows paid full device price.
+
+        ``blob`` (optional): a bytes-like holding exactly ``rows``'s
+        contiguous float32 bytes — the wire path passes the request
+        frame's feature payload (a zero-copy view of the socket read)
+        so key extraction below reuses it instead of re-serializing
+        the batch with ``tobytes()``. Ignored unless its length
+        matches, so a caller can pass it unconditionally."""
         rows = np.ascontiguousarray(rows, np.float32)
         n = len(rows)
         if not self.accepts(n):
@@ -165,10 +176,18 @@ class FastLane:
         # at the 1024-row request size (docs/PERFORMANCE.md "Scoring
         # artifact" — the fast lane sits on the decomposition's fixed-
         # cost side, so per-row python here is paid by every request).
-        buf = rows.tobytes()
         width = rows.shape[1] * rows.itemsize
-        keys = [(generation, buf[i * width:(i + 1) * width])
-                for i in range(n)]
+        if blob is not None and len(blob) == n * width:
+            self._m_wire_blob.inc(n)
+            mv = memoryview(blob)
+            # bytes() per slice: keys must OWN their 48 B, not pin the
+            # whole request buffer for the cache entry's lifetime.
+            keys = [(generation, bytes(mv[i * width:(i + 1) * width]))
+                    for i in range(n)]
+        else:
+            buf = rows.tobytes()
+            keys = [(generation, buf[i * width:(i + 1) * width])
+                    for i in range(n)]
         out: List[Optional[np.ndarray]] = [None] * n
         # Classification under ONE lock pass: cache hit, join an
         # in-flight computation, or become the leader for a novel key.

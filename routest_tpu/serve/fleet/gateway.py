@@ -62,6 +62,13 @@ _IDEMPOTENT_POST = {
 _HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
                 "proxy-authorization", "te", "trailer",
                 "transfer-encoding", "upgrade"}
+# Paths that may ride the persistent binary wire channel when the
+# request body is a wire frame (content-type negotiated; docs/API.md
+# "Binary wire format"). A channel failure falls back to a normal HTTP
+# forward of the same frame — the replica negotiates by content-type
+# either way.
+_WIRE_PATHS = {"/api/predict_eta_batch", "/api/matrix"}
+_WIRE_CONTENT_TYPE = "application/x-rtpu-wire"
 
 # Bounded route-label vocabulary for the gateway's per-route metric
 # families (the SLO engine's rollup source). Anything else — including
@@ -324,6 +331,18 @@ class Gateway:
         # correctness SLOs"): armed in serve() when RTPU_PROBER=1 —
         # it needs the gateway's own listen address to probe through.
         self.prober = None
+        # Binary wire channel (docs/API.md "Binary wire format"): when
+        # RTPU_WIRE=1 + RTPU_WIRE_CHANNEL, wire-content-type requests
+        # to the wire paths ride a persistent multiplexed channel per
+        # replica instead of an HTTP exchange. Clients are created
+        # lazily per replica and dropped on deregistration; every
+        # channel failure falls back to the HTTP path above, so the
+        # channel can only ever make things faster, not less available.
+        from routest_tpu.core.config import load_wire_config
+
+        self._wire_cfg = load_wire_config()
+        self._wire_clients: Dict[str, object] = {}
+        self._wire_lock = threading.Lock()
 
     # ── admission control ─────────────────────────────────────────────
 
@@ -480,6 +499,10 @@ class Gateway:
             drained = up.outstanding <= 0
             self.replicas = [r for r in self.replicas if r.id != rid]
         up.drop_conns()
+        with self._wire_lock:
+            wire_client = self._wire_clients.pop(rid, None)
+        if wire_client is not None:
+            wire_client.close()
         _log.info("replica_deregistered", replica=rid, drained=drained)
         return True
 
@@ -655,6 +678,62 @@ class Gateway:
             fspan.set_attr("status", status)
             return status, resp_headers, data
 
+    # ── binary wire channel ───────────────────────────────────────────
+
+    def _wire_channel_for(self, r: _Upstream):
+        """The persistent channel client for one replica, created
+        lazily. The channel address is derived the same way the worker
+        derives its own listen port: explicit ``RTPU_WIRE_PORT``
+        (single-replica deployments), else replica HTTP port +
+        ``RTPU_WIRE_PORT_OFFSET``. Returns None when the channel
+        transport is off."""
+        cfg = self._wire_cfg
+        if not (cfg.enabled and cfg.channel):
+            return None
+        from routest_tpu.serve.wirechannel import WireChannelClient
+
+        with self._wire_lock:
+            client = self._wire_clients.get(r.id)
+            if client is None:
+                port = cfg.port or (r.port + cfg.port_offset)
+                client = WireChannelClient(
+                    r.host, port,
+                    max_frame_bytes=int(cfg.max_frame_mb * 1024 * 1024))
+                self._wire_clients[r.id] = client
+            return client
+
+    def _forward_wire(self, r: _Upstream, path: str, body: bytes,
+                      deadline: float, probe=None):
+        """One exchange over the replica's wire channel → (status,
+        headers, body), or None when the channel is unavailable (the
+        caller falls back to an HTTP forward of the same frame —
+        counted, so the reuse ratio is honest). Transport failures
+        never charge the breaker: the HTTP fallback that follows is
+        the authoritative health evidence."""
+        client = self._wire_channel_for(r)
+        if client is None:
+            return None
+        from routest_tpu.serve.wirechannel import (WireChannelError,
+                                                   fallback_http_count)
+
+        t0 = time.perf_counter()
+        remaining_ms = max(1.0, (deadline - time.time()) * 1000.0)
+        with trace_span("gateway.wire", replica=r.id, path=path) as wspan:
+            try:
+                status, frame = client.request(
+                    path, body, timeout=max(0.2, remaining_ms / 1000.0),
+                    deadline_ms=remaining_ms, probe=probe)
+            except WireChannelError as e:
+                wspan.set_attr("fallback", str(e))
+                fallback_http_count()
+                return None
+            wspan.set_attr("status", status)
+        self._complete(r, ok=status < 500,
+                       seconds=time.perf_counter() - t0)
+        rh: List = [("Content-Type", _WIRE_CONTENT_TYPE)]
+        _tag_replica(rh, r.id)
+        return status, rh, frame
+
     def _hedge_delay_s(self) -> float:
         """p95 of recent proxied latencies, floored at hedge_min_ms."""
         floor = self.config.hedge_min_ms / 1000.0
@@ -797,6 +876,23 @@ class Gateway:
         primary = self._pick()
         if primary is None:
             return 503, [_CT_JSON], _BODY_NO_REPLICA
+
+        # Wire-frame requests try the persistent channel first (never
+        # hedged — the channel is itself the low-latency path); a
+        # channel miss falls through to the ordinary HTTP machinery
+        # below with the frame as the request body, where the replica
+        # still negotiates by content-type.
+        if (bare in _WIRE_PATHS and body is not None
+                and self._wire_cfg.enabled and self._wire_cfg.channel):
+            ct = next((v for k, v in fwd_headers.items()
+                       if k.lower() == "content-type"), "")
+            if ct.split(";", 1)[0].strip().lower() == _WIRE_CONTENT_TYPE:
+                probe = next((v for k, v in fwd_headers.items()
+                              if k.lower() == "x-rtpu-probe"), None)
+                result = self._forward_wire(primary, bare, body, deadline,
+                                            probe=probe)
+                if result is not None:
+                    return result
 
         hedgeable = (self.config.hedge and idempotent
                      and len(self.replicas) > 1
@@ -1430,6 +1526,11 @@ class Gateway:
             self.timeline.stop()
         if self.fleet_timeline is not None:
             self.fleet_timeline.stop()
+        with self._wire_lock:
+            wire_clients, self._wire_clients = \
+                list(self._wire_clients.values()), {}
+        for client in wire_clients:
+            client.close()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -1392,7 +1392,8 @@ class EtaService:
         return self._predict_rows(self._serving, rows)
 
     def _predict_rows(self, serving: _ServingState,
-                      rows: np.ndarray) -> Optional[np.ndarray]:
+                      rows: np.ndarray,
+                      blob=None) -> Optional[np.ndarray]:
         """Score rows against ONE serving snapshot (hot-reload-safe:
         callers must pair the result with the SAME snapshot's quantile
         metadata). The fast lane is consulted first: cached rows never
@@ -1411,6 +1412,7 @@ class EtaService:
         bad = ~np.isfinite(rows).all(axis=1)
         if bad.any():
             rows = np.where(bad[:, None], np.float32(0.0), rows)
+            blob = None  # rewritten rows no longer match the wire bytes
         fl = self._fastlane
         if fl is not None and fl.accepts(len(rows)):
             from routest_tpu.live import metric_epoch
@@ -1430,7 +1432,7 @@ class EtaService:
                 preds = fl.predict(
                     rows, (serving.generation, epoch),
                     lambda miss: self._submit_chunked(batcher, miss),
-                    span=fspan)
+                    span=fspan, blob=blob)
         else:
             preds = self._submit_chunked(batcher, rows)
         if bad.any() and preds is not None:
@@ -1591,6 +1593,51 @@ class EtaService:
         completion = base + (minutes * 60_000.0).astype("timedelta64[ms]")
         iso = np.datetime_as_string(completion, unit="s")
         return (minutes, iso, bands) if return_quantiles else (minutes, iso)
+
+    def predict_eta_wire(self, features: np.ndarray,
+                         pickup_ms: np.ndarray, blob=None):
+        """Binary-wire batched scoring: pre-encoded (N, 12) float32
+        features + (N,) int64 pickup epoch-ms → ``(minutes (N,) f64,
+        completion_ms (N,) i64, bands {label: (N,) f64})``, or None
+        when no model is serving.
+
+        Zero per-row Python: the client featurized with the same
+        ``encode_requests`` the JSON path uses, so scoring feeds the
+        model bit-identical rows, and the completion math below is the
+        SAME float64 expression as the JSON path's datetime64
+        arithmetic (``ms + int64(minutes * 60_000.0)``) — the two
+        content-types answer bitwise-identically by construction.
+        NaN-minute rows stamp the datetime64 NaT sentinel
+        (``wirecodec.COMPLETION_NAT``). ``blob`` is the request
+        frame's raw feature bytes, threaded to the fast lane so cache
+        keys slice from the socket buffer instead of re-serializing."""
+        serving = self._serving  # one snapshot: scoring + metadata
+        if serving.batcher is None:
+            return None
+        preds = self._predict_rows(serving, features, blob=blob)
+        if preds is None:
+            return None
+        preds = np.asarray(preds, np.float64)
+        q = serving.quantiles
+        bands: dict = {}
+        if q:
+            minutes = preds[:, q.index(0.5)]
+            bands = {_band_label(level): preds[:, i]
+                     for i, level in enumerate(q) if level != 0.5}
+        else:
+            minutes = preds
+        pickup_ms = np.asarray(pickup_ms, np.int64)
+        from routest_tpu.serve.wirecodec import COMPLETION_NAT
+
+        finite = np.isfinite(minutes)
+        completion_ms = np.full(minutes.shape, COMPLETION_NAT, np.int64)
+        if finite.any():
+            # float→int truncation toward zero, exactly what the JSON
+            # path's float64→timedelta64[ms] astype performs.
+            completion_ms[finite] = (
+                pickup_ms[finite]
+                + (minutes[finite] * 60_000.0).astype(np.int64))
+        return minutes, completion_ms, bands
 
     @property
     def stats(self) -> dict:

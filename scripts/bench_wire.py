@@ -1,0 +1,564 @@
+"""Binary wire serving end to end → artifacts/wire.json.
+
+The ISSUE-19 acceptance scenario, measured on a real fleet:
+
+- ``micro`` — one real worker (``python -m routest_tpu.serve``,
+  wire channel armed) behind the in-process gateway. Gates: exact
+  (bitwise) wire↔JSON parity through the gateway; ≥2× throughput
+  over the JSON path on small (≤64-row) batches; gateway-added
+  overhead (via-gateway wire p95 minus direct-channel p95) under
+  1 ms; sustained ≥100k ETA rows/s through one gateway on 1024-row
+  open-loop frames; and the channel actually carried the traffic
+  (connection reuse ratio, not per-request HTTP).
+- ``probe_parity`` — the bench_probing live fleet with the wire
+  format armed: open-loop binary load while ≥1 legitimate metric
+  flip and ≥1 verified model swap land, with the blackbox prober's
+  ``wire`` kind watching. Gates: the wire parity probe stays green
+  (``correctness:wire`` never pages) across both transitions.
+
+Caches (street extract, hierarchy overlay, XLA compiles) are shared
+across scenarios AND battery rounds via ``--cache-dir`` (default
+``artifacts/bench_cache/wire``).
+
+Usage: python scripts/bench_wire.py [--quick]
+       [--out artifacts/wire.json] [--cache-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODEL = os.path.join(REPO, "artifacts", "eta_mlp.msgpack")
+WIRE_CT = "application/x-rtpu-wire"
+
+# Acceptance gates (ISSUE-19).
+SPEEDUP_MIN = 2.0            # wire vs JSON rows/s, small batches
+GW_OVERHEAD_P95_MS = 1.0     # via-gateway minus direct-channel
+SUSTAINED_ROWS_PER_S = 100_000.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _bench_probing():
+    spec = importlib.util.spec_from_file_location(
+        "bench_probing", os.path.join(REPO, "scripts",
+                                      "bench_probing.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _family_total(name: str, where=None) -> float:
+    from routest_tpu.obs.registry import get_registry
+
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for key, child in fam.items():
+        if where is None or where(key):
+            total += child.value
+    return total
+
+
+def _jsonable(o):
+    import numpy as np
+
+    if isinstance(o, (np.bool_,)):
+        return bool(o)
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
+
+
+def _p95_ms(lat_s) -> float:
+    ok = sorted(lat_s)
+    if not ok:
+        return float("nan")
+    return ok[min(len(ok) - 1, int(0.95 * len(ok)))] * 1000.0
+
+
+# ── micro scenario ───────────────────────────────────────────────────
+
+
+def _closed_loop(base: str, requests, duration_s: float,
+                 workers: int = 4):
+    """→ (ok_count, err_count, elapsed_s): keep-alive closed loop over
+    a fixed request cycle — both formats pay the same client."""
+    from routest_tpu.loadgen.engine import KeepAliveClient
+
+    t0 = time.monotonic()
+    stop_at = t0 + duration_s
+    ok = [0] * workers
+    err = [0] * workers
+
+    def run(w: int) -> None:
+        client = KeepAliveClient(base, timeout=30.0)
+        i = w
+        while time.monotonic() < stop_at:
+            try:
+                status, _ = client.send(requests[i % len(requests)])
+            except Exception:
+                status = -1
+            if status == 200:
+                ok[w] += 1
+            else:
+                err[w] += 1
+            i += workers
+        client.close()
+
+    threads = [threading.Thread(target=run, args=(w,))
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(ok), sum(err), time.monotonic() - t0
+
+
+def _parity_check(base: str) -> dict:
+    """Golden body over both content-types through ``base`` — the
+    prober's own bitwise compare, run once as a hard gate."""
+    import numpy as np
+
+    from routest_tpu.obs.prober import (eta_columns, golden_probe_body,
+                                        golden_wire_frame, _http_json,
+                                        _http_wire)
+    from routest_tpu.serve import wirecodec as wc
+
+    url = f"{base}/api/predict_eta_batch"
+    payload, _ = _http_json("POST", url, golden_probe_body(), 60.0,
+                            probe="")
+    raw, _ = _http_wire(url, golden_wire_frame(), 60.0, probe="")
+    wire = wc.decode_eta_response(raw)
+    minutes = np.asarray(wire["minutes"], np.float64)
+    finite = np.isfinite(minutes)
+    got = {"eta_minutes_ml": np.where(finite, np.round(minutes, 4),
+                                      np.nan)}
+    for lvl, vals in wire["bands"].items():
+        ok = finite & np.isfinite(np.asarray(vals))
+        got[f"eta_minutes_ml_{lvl}"] = np.where(
+            ok, np.round(vals, 4), np.nan)
+    jcols = eta_columns(payload)
+    cols_equal = sorted(got) == sorted(jcols) and all(
+        got[k].tobytes() == jcols[k].tobytes() for k in jcols)
+    iso = np.datetime_as_string(
+        np.asarray(wire["completion_ms"],
+                   np.int64).astype("datetime64[ms]"), unit="s")
+    wire_iso = [str(s) if f else None for s, f in zip(iso, finite)]
+    iso_equal = wire_iso == payload.get("eta_completion_time_ml")
+    return {"rows": int(len(minutes)),
+            "columns": sorted(got),
+            "columns_bitwise_equal": bool(cols_equal),
+            "completion_equal": bool(iso_equal),
+            "ok": bool(cols_equal and iso_equal)}
+
+
+def scenario_micro(cache_dir: str, quick: bool) -> dict:
+    from routest_tpu.core.config import FleetConfig
+    from routest_tpu.loadgen.arrivals import RateCurve, paced_schedule
+    from routest_tpu.loadgen.engine import KeepAliveClient, run_open_loop
+    from routest_tpu.loadgen.workload import MixedWorkload
+    from routest_tpu.serve.fleet.gateway import Gateway
+    from routest_tpu.serve.fleet.supervisor import ReplicaSupervisor
+    from routest_tpu.serve.wirechannel import WireChannelClient
+
+    out: dict = {"scenario": "micro"}
+    window_s = 3.0 if quick else 8.0
+    port = _free_port()
+    chan_port = _free_port()
+    env = dict(os.environ)
+    env.update({
+        "ROUTEST_FORCE_CPU": "1",
+        "ROUTEST_MESH": "0",
+        "ETA_MODEL_PATH": MODEL,
+        "RTPU_WIRE": "1",
+        "RTPU_WIRE_PORT": str(chan_port),
+        "RTPU_COMPILE_CACHE": os.path.join(cache_dir, "xla"),
+    })
+    os.environ["RTPU_WIRE"] = "1"
+    os.environ["RTPU_WIRE_PORT"] = str(chan_port)
+    sup = ReplicaSupervisor([port], env=env, cwd=REPO,
+                            probe_interval_s=0.5, backoff_base_s=0.2,
+                            backoff_cap_s=2.0)
+    sup.start()
+    gw = None
+    try:
+        if not sup.ready(timeout=600):
+            raise RuntimeError("worker never became ready")
+        frames0 = _family_total(
+            "rtpu_wire_frames_total",
+            lambda key: "sent" in key)
+        gw = Gateway([("127.0.0.1", port)], FleetConfig(hedge=False),
+                     supervisor=sup)
+        httpd = gw.serve("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+        # (1) exact parity through the gateway — hard gate.
+        out["parity"] = _parity_check(base)
+
+        # (2) small-batch throughput, wire vs JSON, same seeded rows.
+        thr: dict = {}
+        for rows in (8, 64):
+            per_mode = {}
+            for mode in ("json", "binary"):
+                wl = MixedWorkload(mix={"predict_eta_batch": 1.0},
+                                   seed=11, batch_rows=rows,
+                                   wire_format=mode)
+                reqs = wl.sequence(64)
+                n_ok, n_err, elapsed = _closed_loop(
+                    base, reqs, window_s)
+                per_mode[mode] = {
+                    "ok": n_ok, "err": n_err,
+                    "req_per_s": round(n_ok / elapsed, 1),
+                    "rows_per_s": round(n_ok * rows / elapsed, 1)}
+            ratio = (per_mode["binary"]["rows_per_s"]
+                     / max(per_mode["json"]["rows_per_s"], 1e-9))
+            thr[str(rows)] = {**per_mode,
+                              "speedup": round(ratio, 2)}
+        out["throughput"] = thr
+        speedup_small = min(thr[k]["speedup"] for k in thr)
+        out["speedup_small_batches"] = round(speedup_small, 2)
+
+        # (3) gateway-added overhead: via-gateway wire p95 minus
+        # direct-channel p95 on the same 64-row frame.
+        wl = MixedWorkload(mix={"predict_eta_batch": 1.0}, seed=13,
+                           batch_rows=64, wire_format="binary")
+        frame = wl.sequence(1)[0].body
+        n = 150 if quick else 400
+        from routest_tpu.loadgen.workload import PlannedRequest
+
+        preq = PlannedRequest(method="POST",
+                              path="/api/predict_eta_batch",
+                              body=frame, route="predict_eta_batch",
+                              content_type=WIRE_CT)
+        direct = WireChannelClient("127.0.0.1", chan_port)
+        gw_client = KeepAliveClient(base, timeout=30.0)
+
+        def one_direct() -> float:
+            t0 = time.perf_counter()
+            status, _body = direct.request("/api/predict_eta_batch",
+                                           frame, timeout=30.0)
+            assert status == 200
+            return time.perf_counter() - t0
+
+        def one_gw() -> float:
+            t0 = time.perf_counter()
+            status, _body = gw_client.send(preq)
+            assert status == 200
+            return time.perf_counter() - t0
+
+        # Interleaved sampling: host drift (GC, scheduler) lands on
+        # both legs equally instead of biasing whichever ran second.
+        for _ in range(30):   # steady-state both paths first
+            one_direct(), one_gw()
+        lat_direct, lat_gw = [], []
+        for _ in range(n):
+            lat_direct.append(one_direct())
+            lat_gw.append(one_gw())
+        direct.close()
+        gw_client.close()
+        p95_direct = _p95_ms(lat_direct)
+        p95_gw = _p95_ms(lat_gw)
+        out["gateway_overhead"] = {
+            "p95_direct_ms": round(p95_direct, 3),
+            "p95_via_gateway_ms": round(p95_gw, 3),
+            "added_p95_ms": round(p95_gw - p95_direct, 3),
+            "budget_ms": GW_OVERHEAD_P95_MS,
+            "samples": n}
+
+        # (4) sustained rows/s through ONE gateway: open-loop
+        # 1024-row binary frames (CO-correct pacing).
+        rows = 1024
+        rate = 130.0
+        duration = 6.0 if quick else 15.0
+        wl = MixedWorkload(mix={"predict_eta_batch": 1.0}, seed=17,
+                           batch_rows=rows, wire_format="binary")
+        offsets = paced_schedule(RateCurve.constant(rate), duration)
+        reqs = wl.sequence(min(len(offsets), 64))
+        reqs = [reqs[i % len(reqs)] for i in range(len(offsets))]
+        records = run_open_loop([base], offsets, reqs, workers=16,
+                                timeout=60.0)
+        ok = [r for r in records if r.status == 200]
+        span = max((r.offset_s + r.latency_s for r in ok),
+                   default=duration)
+        sustained = len(ok) * rows / max(span, 1e-9)
+        out["sustained"] = {
+            "rows_per_frame": rows,
+            "offered_rps": rate,
+            "duration_s": duration,
+            "ok": len(ok), "errors": len(records) - len(ok),
+            "p95_ms": round(_p95_ms([r.latency_s for r in ok]), 2),
+            "rows_per_s": round(sustained, 0),
+            "floor_rows_per_s": SUSTAINED_ROWS_PER_S}
+
+        # (5) the channel carried it: frames sent over the persistent
+        # channel, and connection reuse ≈ total (not one conn per req).
+        frames = _family_total("rtpu_wire_frames_total",
+                               lambda key: "sent" in key) - frames0
+        reused = _family_total("rtpu_wire_conns_total",
+                               lambda key: "reused" in key)
+        fresh = _family_total("rtpu_wire_conns_total",
+                              lambda key: "fresh" in key)
+        out["channel"] = {
+            "frames_sent": int(frames),
+            "conns_reused": int(reused),
+            "conns_fresh": int(fresh),
+            "reuse_ratio": round(reused / max(reused + fresh, 1), 4)}
+
+        checks = {
+            "parity_exact": out["parity"]["ok"],
+            "speedup_small_batches_ge_2x":
+                speedup_small >= SPEEDUP_MIN,
+            "gateway_overhead_p95_lt_1ms":
+                (p95_gw - p95_direct) < GW_OVERHEAD_P95_MS,
+            "sustained_ge_100k_rows_per_s":
+                sustained >= SUSTAINED_ROWS_PER_S,
+            "channel_carried_traffic": frames > 0,
+            "connections_reused": out["channel"]["reuse_ratio"] > 0.9,
+        }
+        out["checks"] = checks
+        out["pass"] = all(checks.values())
+    finally:
+        os.environ.pop("RTPU_WIRE_PORT", None)
+        if gw is not None:
+            gw.drain(timeout=5)
+        sup.drain(timeout=15)
+    return out
+
+
+# ── probe parity across flip + swap ──────────────────────────────────
+
+
+def scenario_probe_parity(bp, extract: str, cache_dir: str,
+                          quick: bool) -> dict:
+    import jax  # noqa: F401  (forces backend init before the fleet)
+
+    from routest_tpu.loadgen.arrivals import RateCurve, paced_schedule
+    from routest_tpu.loadgen.engine import run_open_loop
+    from routest_tpu.loadgen.workload import MixedWorkload
+    from routest_tpu.train.checkpoint import load_model, save_model
+
+    out: dict = {"scenario": "probe_parity"}
+    os.environ["RTPU_WIRE"] = "1"
+    work = tempfile.mkdtemp(prefix="wire-probe-")
+    fleet = bp.Fleet(live=True, extract=extract, cache_dir=cache_dir,
+                     work_dir=work, probe_interval=1.0)
+    try:
+        prober = fleet.arm_prober()
+        out["wire_kind_armed"] = "wire" in prober.kinds
+
+        # Open-loop binary load for the whole transition window.
+        stop = threading.Event()
+        duration = 90.0 if quick else 180.0
+        wl = MixedWorkload(mix={"predict_eta_batch": 1.0}, seed=23,
+                           batch_rows=64, wire_format="binary")
+        offsets = paced_schedule(RateCurve.constant(4.0), duration)
+        base_reqs = wl.sequence(64)
+        reqs = [base_reqs[i % len(base_reqs)]
+                for i in range(len(offsets))]
+        records: list = []
+
+        def load_thread() -> None:
+            records.extend(run_open_loop(
+                [fleet.base], offsets, reqs, workers=4, timeout=60.0,
+                stop=stop))
+
+        loader = threading.Thread(target=load_thread)
+        loader.start()
+
+        # A verified model swap: within-gate perturbation, both
+        # replicas' reload watchers land it through the golden gate.
+        import jax as _jax
+
+        model, params = load_model(fleet.model_path)
+        close = _jax.tree_util.tree_map(lambda x: x * (1.0 + 1e-4),
+                                        params)
+        save_model(fleet.model_path, model, close)
+        st = os.stat(fleet.model_path)
+        os.utime(fleet.model_path,
+                 ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+
+        def swaps_accepted() -> int:
+            total = 0
+            for p in fleet.ports:
+                reg = bp._fetch(f"http://127.0.0.1:{p}/api/metrics",
+                                timeout=30).get("registry", {})
+                for s in reg.get("rtpu_model_swaps_total",
+                                 {}).get("series", ()):
+                    if s.get("labels", {}).get("result") == "accepted":
+                        total += int(s.get("value", 0))
+            return total
+
+        # ≥1 legitimate metric flip: probe drivers stream real
+        # observations, the live pipeline customizes a new epoch.
+        epoch0 = max(bp._fetch(f"http://127.0.0.1:{p}/api/live",
+                               timeout=30).get("epoch", 0)
+                     for p in fleet.ports)
+        fleet.start_probe_drivers()
+        deadline = time.time() + (90 if quick else 150)
+        swaps = flips = 0
+        while time.time() < deadline and (swaps < 1 or flips < 1):
+            swaps = swaps_accepted()
+            flips = max(bp._fetch(f"http://127.0.0.1:{p}/api/live",
+                                  timeout=30).get("epoch", 0)
+                        for p in fleet.ports) - epoch0
+            time.sleep(1.0)
+        time.sleep(6 * fleet.prober_cfg.interval_s)  # post-flip rounds
+        stop.set()
+        loader.join(timeout=60)
+        out["swaps_accepted"] = swaps
+        out["metric_flips"] = flips
+
+        snap = fleet.prober.snapshot()
+        wire_state = snap["probes"].get("wire", {})
+        slo = fleet.prober.slo.snapshot()["objectives"]
+        ok_load = [r for r in records if r.status == 200]
+        out["wire_verdict"] = wire_state.get("verdict")
+        out["correctness_wire_state"] = \
+            slo.get("correctness:wire", {}).get("state")
+        out["probe_rounds"] = fleet.prober._rounds
+        out["load"] = {"ok": len(ok_load),
+                       "errors": len(records) - len(ok_load),
+                       "p95_ms": round(_p95_ms(
+                           [r.latency_s for r in ok_load]), 2)}
+        checks = {
+            "wire_kind_armed": out["wire_kind_armed"],
+            "verified_swap_ge_1": swaps >= 1,
+            "metric_flip_ge_1": flips >= 1,
+            "wire_probe_green": wire_state.get("verdict") == "pass",
+            "correctness_wire_never_paged":
+                out["correctness_wire_state"] == "ok",
+            "binary_load_served": len(ok_load) > 0
+                and len(ok_load) >= 0.9 * max(len(records), 1),
+        }
+        out["checks"] = checks
+        out["pass"] = all(checks.values())
+    finally:
+        fleet.stop()
+        shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
+# ── main ─────────────────────────────────────────────────────────────
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--quick", action="store_true",
+                        help="shorter windows + smaller extract (CI)")
+    parser.add_argument("--nodes", type=int, default=6000)
+    parser.add_argument("--cache-dir", default=os.path.join(
+        REPO, "artifacts", "bench_cache", "wire"))
+    parser.add_argument("--out", default=os.path.join(
+        REPO, "artifacts", "wire.json"))
+    parser.add_argument("--scenario", default=None,
+                        help="run one scenario (debug)")
+    args = parser.parse_args()
+    if args.quick:
+        args.nodes = min(args.nodes, 4000)
+
+    os.environ.setdefault("ROUTEST_FORCE_CPU", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.makedirs(args.cache_dir, exist_ok=True)
+    from routest_tpu.core.cache import enable_compile_cache
+
+    enable_compile_cache(os.path.join(args.cache_dir, "xla"))
+
+    t0 = time.time()
+    scenarios: dict = {}
+    plan = [("micro",
+             lambda: scenario_micro(args.cache_dir, args.quick))]
+    if args.scenario in (None, "probe_parity"):
+        bp = _bench_probing()
+        print("[1/3] extract + overlay cache "
+              f"({args.nodes:,} nodes)…", flush=True)
+        extract = bp.build_extract(args.nodes, args.cache_dir)
+        plan.append(("probe_parity", lambda: scenario_probe_parity(
+            bp, extract, args.cache_dir, args.quick)))
+    for i, (name, run) in enumerate(plan):
+        if args.scenario and name != args.scenario:
+            continue
+        print(f"[{i + 2}/3] scenario {name}…", flush=True)
+        t = time.perf_counter()
+        try:
+            scenarios[name] = run()
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            scenarios[name] = {"scenario": name, "pass": False,
+                               "error": f"{type(e).__name__}: {e}"}
+        scenarios[name]["wall_s"] = round(time.perf_counter() - t, 1)
+        print(f"  {name}: "
+              f"{'PASS' if scenarios[name].get('pass') else 'FAIL'} "
+              f"({scenarios[name]['wall_s']}s)", flush=True)
+
+    try:
+        n_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:
+        n_cpus = os.cpu_count() or 1
+    backend = jax.devices()[0].platform
+    record = {
+        "generated_unix": int(t0),
+        "host": {"cpus": n_cpus, "platform": sys.platform,
+                 "backend": backend},
+        "host_caveat": (
+            f"cpu-backend record on {n_cpus} core(s): absolute rows/s "
+            "and p95s are time-shared-host numbers; judge the "
+            "structural checks (bitwise parity, speedup ratio, "
+            "overhead delta, probe green across flip+swap), not "
+            "wall-ms" if backend != "tpu" else None),
+        "skipped": ("tpu wire: CPU fallback rows — re-record when a "
+                    "tunnel appears (scripts/run_tpu_battery.sh does "
+                    "it automatically)" if backend != "tpu" else None),
+        "config": {
+            "nodes": args.nodes,
+            "speedup_min": SPEEDUP_MIN,
+            "gateway_overhead_p95_ms": GW_OVERHEAD_P95_MS,
+            "sustained_floor_rows_per_s": SUSTAINED_ROWS_PER_S,
+            "cache_dir": args.cache_dir,
+            "quick": bool(args.quick),
+        },
+        "scenarios": scenarios,
+    }
+    if args.scenario:
+        record["partial"] = f"--scenario {args.scenario} (debug run)"
+    record["checks"] = {name: bool(s.get("pass"))
+                        for name, s in scenarios.items()}
+    record["all_pass"] = (bool(record["checks"])
+                          and all(record["checks"].values())
+                          and (args.scenario is not None
+                               or len(scenarios) == 2))
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, default=_jsonable)
+        f.write("\n")
+    print(f"wrote {args.out} "
+          f"(all_pass={record['all_pass']}, "
+          f"{round(time.time() - t0, 1)}s)", flush=True)
+    sys.exit(0 if record["all_pass"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,15 @@ run_step dispatch timeout 2400 python scripts/bench_dispatch.py
 # caches persist under artifacts/bench_cache/region_failover across
 # battery rounds.
 run_step region_failover timeout 2400 python scripts/bench_region_failover.py
+# Binary wire serving end to end (ISSUE 19): the length-prefixed
+# columnar format must answer bitwise-identically to the JSON path
+# through a real gateway, beat it by >=2x rows/s on small batches,
+# add <1ms p95 over a direct channel hop, and sustain >=100k rows/s
+# through one gateway; the prober's wire parity kind must stay green
+# across a metric flip and a verified model swap under open-loop
+# binary load (artifacts/wire.json). Extract + hierarchy + XLA caches
+# persist under artifacts/bench_cache/wire across battery rounds.
+run_step wire timeout 2400 python scripts/bench_wire.py
 # Device efficiency end to end (ISSUE 17): the goodput ledger +
 # throughput-regression watchdog on a live 2-replica fleet — an
 # injected device.compute slowdown and a forced pathological bucket

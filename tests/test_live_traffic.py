@@ -381,7 +381,7 @@ def test_fastlane_key_includes_metric_epoch(small_router, monkeypatch):
         def accepts(self, n):
             return True
 
-        def predict(self, rows, generation, compute, span=None):
+        def predict(self, rows, generation, compute, span=None, blob=None):
             calls.append(generation)
             return compute(rows)
 
