@@ -299,3 +299,155 @@ def chaos_point_collision(rule: Rule, corpus: Corpus) -> Iterator[Finding]:
                 file, line,
                 f"chaos point `{name}` is also injected from {first} — "
                 f"point names must be unique per boundary")
+
+
+# ---------------------------------------------------------------------------
+# Change-ledger kinds ↔ LEDGER_KINDS registry + docs/OBSERVABILITY.md
+
+LEDGER_REL = "routest_tpu/obs/ledger.py"
+LEDGER_KIND_RE = re.compile(r"^[a-z][a-z_]*\.[a-z][a-z_]*$")
+
+
+def _ledger_registered_kinds(corpus: Corpus) -> Set[str]:
+    """Keys of the ``LEDGER_KINDS`` dict literal in obs/ledger.py —
+    the typed registry every ``record_change`` kind must come from."""
+    sf = corpus.file(LEDGER_REL)
+    if sf is None:
+        return set()
+    kinds: Set[str] = set()
+    for node in sf.nodes():
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "LEDGER_KINDS"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                if (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)):
+                    kinds.add(key.value)
+    return kinds
+
+
+def _ledger_kind_sites(corpus: Corpus) -> List[Tuple[str, str, int]]:
+    """(kind, file, line) for every literal kind passed to the change
+    ledger — ``record_change("…")`` helper calls and ``.record("…")``
+    method calls whose kind matches the ledger grammar."""
+    out: List[Tuple[str, str, int]] = []
+    for sf in corpus.files:
+        if sf.relpath == LEDGER_REL:
+            continue  # the registry itself (docstrings, defaults)
+        for node in sf.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            if call_leaf(node) != "record_change":
+                continue
+            a: Optional[ast.AST] = node.args[0] if node.args else None
+            if a is None:
+                for kw in node.keywords:
+                    if kw.arg == "kind":
+                        a = kw.value
+                        break
+            if (isinstance(a, ast.Constant) and isinstance(a.value, str)
+                    and LEDGER_KIND_RE.match(a.value)):
+                out.append((a.value, sf.relpath, a.lineno))
+    return out
+
+
+def _ledger_doc_section(corpus: Corpus) -> Tuple[str, int]:
+    """The "Change ledger" section of docs/OBSERVABILITY.md (text,
+    first-line offset) — the doc→code direction only scans there, so
+    chaos points and metric names elsewhere never false-positive."""
+    doc = corpus.doc("OBSERVABILITY.md")
+    if not doc:
+        return "", 0
+    lines = doc.splitlines()
+    start = end = None
+    for i, line in enumerate(lines):
+        if start is None:
+            if line.startswith("#") and "change ledger" in line.lower():
+                start = i
+        elif line.startswith("## "):
+            end = i
+            break
+    if start is None:
+        return "", 0
+    return "\n".join(lines[start:end]), start
+
+
+@register(
+    "ledger-kind-unregistered", "error",
+    "a change-ledger event kind is recorded in code but missing from "
+    "the LEDGER_KINDS registry in obs/ledger.py — the suspect ranker "
+    "and the /api/changes consumers only know registered kinds",
+    "add the kind (with a one-line description) to LEDGER_KINDS in "
+    "routest_tpu/obs/ledger.py")
+def ledger_kind_unregistered(rule: Rule, corpus: Corpus
+                             ) -> Iterator[Finding]:
+    registered = _ledger_registered_kinds(corpus)
+    if not registered:
+        return
+    for kind, file, line in _ledger_kind_sites(corpus):
+        if kind not in registered:
+            yield rule.finding(
+                file, line,
+                f"ledger kind `{kind}` is recorded here but not "
+                f"registered in LEDGER_KINDS")
+
+
+@register(
+    "ledger-kind-undocumented", "error",
+    "a change-ledger event kind recorded in code has no row in the "
+    "docs/OBSERVABILITY.md change-ledger table — incident responders "
+    "triage suspects by that table",
+    "add the kind to the event-kind table under \"Change ledger & "
+    "incident correlation\" in docs/OBSERVABILITY.md")
+def ledger_kind_undocumented(rule: Rule, corpus: Corpus
+                             ) -> Iterator[Finding]:
+    doc = corpus.doc("OBSERVABILITY.md")
+    if not doc:
+        return
+    seen: Set[str] = set()
+    for kind, file, line in _ledger_kind_sites(corpus):
+        if kind in seen:
+            continue
+        seen.add(kind)
+        if kind not in doc:
+            yield rule.finding(
+                file, line,
+                f"ledger kind `{kind}` is recorded here but not "
+                f"documented in docs/OBSERVABILITY.md")
+
+
+@register(
+    "ledger-kind-stale-doc", "error",
+    "the docs/OBSERVABILITY.md change-ledger table names an event kind "
+    "that the LEDGER_KINDS registry doesn't know — a responder would "
+    "filter /api/changes on a kind that never occurs",
+    "remove the stale row, or register the kind in LEDGER_KINDS in "
+    "routest_tpu/obs/ledger.py")
+def ledger_kind_stale_doc(rule: Rule, corpus: Corpus
+                          ) -> Iterator[Finding]:
+    section, offset = _ledger_doc_section(corpus)
+    if not section:
+        return
+    registered = _ledger_registered_kinds(corpus)
+    if not registered:
+        return
+    seen: Set[str] = set()
+    for i, line in enumerate(section.splitlines()):
+        # Kinds live in the FIRST column of the event-kind table;
+        # prose (the `rtpu.changes` channel, module paths) is exempt.
+        if not line.lstrip().startswith("|"):
+            continue
+        first_cell = line.split("|")[1] if "|" in line else ""
+        for token in re.findall(r"`([a-z][a-z_]*\.[a-z_.]*[a-z])`",
+                                first_cell):
+            if token in seen or not LEDGER_KIND_RE.match(token):
+                continue
+            seen.add(token)
+            if token not in registered:
+                yield rule.finding(
+                    "docs/OBSERVABILITY.md", offset + i + 1,
+                    f"documented ledger kind `{token}` is not in "
+                    f"LEDGER_KINDS")

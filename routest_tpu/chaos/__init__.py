@@ -195,6 +195,7 @@ class FaultPoint:
         skew = 0.0
         exc: Optional[ChaosError] = None
         fired = []
+        first_fired = []
         with self._lock:
             self.calls += 1
             for rule in self.rules:
@@ -204,6 +205,8 @@ class FaultPoint:
                     continue
                 rule.fired += 1
                 fired.append(rule.kind)
+                if rule.fired == 1:
+                    first_fired.append(rule.kind)
                 if rule.kind == "latency":
                     delay_ms += rule.arg_ms
                 elif rule.kind == "skew":
@@ -215,6 +218,14 @@ class FaultPoint:
                                f"injected connection drop at {self.name}"))
         for kind in fired:
             _INJECTIONS.labels(point=self.name, kind=kind).inc()
+        # The change ledger records only each rule's FIRST fire: a
+        # hot-path point at prob 1.0 is one state change (the fault
+        # became live), not thousands of ledger entries.
+        for kind in first_fired:
+            from routest_tpu.obs.ledger import record_change
+
+            record_change("chaos.fire",
+                          detail={"point": self.name, "kind": kind})
         if delay_ms:
             time.sleep(delay_ms / 1000.0)
         if exc is not None:
@@ -240,6 +251,11 @@ class ChaosEngine:
         self._points = {name: FaultPoint(name, rules, seed)
                         for name, rules in parse_spec(self.spec).items()}
         if self.enabled:
+            from routest_tpu.obs.ledger import record_change
+
+            record_change("chaos.arm",
+                          detail={"spec": self.spec, "seed": seed,
+                                  "points": sorted(self._points)})
             _log.warning("chaos_enabled", seed=seed,
                          points=sorted(self._points))
 
@@ -258,8 +274,14 @@ class ChaosEngine:
     def record(self, name: str, kind: str) -> None:
         """Ledger entry for a fault actuated OUTSIDE the engine (e.g.
         ``replica.kill`` — the supervisor kills the process; the engine
-        only counts it)."""
+        only counts it). Externally-actuated faults are rare and each
+        IS a state change, so every one lands in the change ledger."""
         _INJECTIONS.labels(point=name, kind=kind).inc()
+        from routest_tpu.obs.ledger import record_change
+
+        record_change("chaos.fire",
+                      detail={"point": name, "kind": kind,
+                              "actuated": "external"})
 
     def snapshot(self) -> dict:
         """Per-point injection counts (for /api/metrics debugging and

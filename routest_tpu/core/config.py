@@ -476,6 +476,32 @@ class EfficiencyConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class LedgerConfig:
+    """Change ledger + incident correlation
+    (``routest_tpu/obs/ledger.py``). All knobs are ``RTPU_LEDGER_*``
+    env vars. The ledger (``enabled``) is an always-on bounded ring of
+    state-change events (model swaps, metric flips, rollout phases,
+    autoscale actions, chaos, region transitions); ``capacity`` bounds
+    it. ``window_s`` is the incident window the suspect ranker scores
+    over when a page fires and ``max_suspects`` caps the ranking
+    written into each bundle's ``suspects.json``. ``publish`` fans
+    locally-recorded events out on ``channel`` when a bus is attached
+    (the cross-process / cross-region "one timeline" path);
+    ``incidents_kept`` bounds the recorder's rolling incident list
+    behind ``/api/incidents``. ``region`` is stamped onto local
+    events (defaults to this process's ``RTPU_REGION``)."""
+
+    enabled: bool = True
+    capacity: int = 512
+    window_s: float = 900.0
+    max_suspects: int = 5
+    publish: bool = True
+    channel: str = "rtpu.changes"
+    incidents_kept: int = 64
+    region: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class SloConfig:
     """SLO engine (``routest_tpu/obs/slo.py``): per-route objectives
     evaluated over rolling multi-window burn rates (Google SRE workbook
@@ -1055,6 +1081,24 @@ def load_efficiency_config(
         slo_target=_env_num(env, "RTPU_EFF_SLO_TARGET", 0.99, float),
         fast_window_s=_env_num(env, "RTPU_EFF_FAST_S", 60.0, float),
         slow_window_s=_env_num(env, "RTPU_EFF_SLOW_S", 600.0, float),
+    )
+
+
+def load_ledger_config(
+        env: Optional[Mapping[str, str]] = None) -> LedgerConfig:
+    """Just the change-ledger knobs (read lazily by
+    ``routest_tpu/obs/ledger.py`` at first ``get_change_ledger()``)."""
+    env = dict(env if env is not None else os.environ)
+    return LedgerConfig(
+        enabled=env.get("RTPU_LEDGER", "1") != "0",
+        capacity=_env_num(env, "RTPU_LEDGER_CAPACITY", 512, int),
+        window_s=_env_num(env, "RTPU_LEDGER_WINDOW_S", 900.0, float),
+        max_suspects=_env_num(env, "RTPU_LEDGER_MAX_SUSPECTS", 5, int),
+        publish=env.get("RTPU_LEDGER_PUBLISH", "1") != "0",
+        channel=env.get("RTPU_LEDGER_CHANNEL") or "rtpu.changes",
+        incidents_kept=_env_num(env, "RTPU_LEDGER_INCIDENTS_KEPT",
+                                64, int),
+        region=env.get("RTPU_REGION", ""),
     )
 
 

@@ -83,6 +83,7 @@ class MetricCustomizer:
         reported while the previous metric generation keeps serving."""
         from routest_tpu.chaos import ChaosError
         from routest_tpu.chaos import inject as chaos_inject
+        from routest_tpu.obs.ledger import record_change
         from routest_tpu.utils.logging import get_logger
 
         m = _cust_metrics()
@@ -91,6 +92,8 @@ class MetricCustomizer:
             chaos_inject("live.customize")
         except ChaosError as e:
             m["flips"].labels(result="chaos").inc()
+            record_change("live.customize_failed",
+                          detail={"reason": f"chaos: {e}"})
             self.last_result = {"flipped": False, "reason": f"chaos: {e}"}
             return self.last_result
         try:
@@ -111,6 +114,8 @@ class MetricCustomizer:
                 blended, snap.epoch, route=self.route_metric)
         except Exception as e:
             m["flips"].labels(result="failed").inc()
+            record_change("live.customize_failed",
+                          detail={"reason": f"{type(e).__name__}: {e}"})
             get_logger("routest_tpu.live").error(
                 "metric_refresh_failed",
                 error=f"{type(e).__name__}: {e}")
@@ -121,6 +126,9 @@ class MetricCustomizer:
         self.flips += 1
         self.last_flip_unix = time.time()
         m["flips"].labels(result="ok").inc()
+        record_change("live.flip",
+                      detail={"epoch": snap.epoch,
+                              "obs_edges": snap.n_obs_edges})
         m["epoch"].set(snap.epoch)
         m["staleness"].set(0.0)
         m["dur"].observe(dur)

@@ -97,6 +97,13 @@ class FlightRecorder:
         # registry snapshot cannot carry. Same wiring contract as the
         # SLO engines: one slot per component, serving layer registers.
         self.timelines: List = []
+        # Change ledger whose in-window events every bundle ranks into
+        # ``suspects.json`` (ISSUE 20) — one slot, serving layer
+        # registers; None = bundles without suspect attribution.
+        self.change_ledger = None
+        # Rolling page roll-up behind ``/api/incidents``: one entry
+        # per bundle written, with its top suspects.
+        self._incidents: Deque[dict] = collections.deque(maxlen=64)
         self.bundles_written = 0
         self.triggers_suppressed = 0
         reg = get_registry()
@@ -220,6 +227,49 @@ class FlightRecorder:
                 if getattr(t, "component", None) != store.component]
             self.timelines.append(store)
 
+    def register_change_ledger(self, ledger) -> None:
+        """Rank ``ledger``'s in-window events against every trigger's
+        paging scope and ship the result as ``suspects.json`` in the
+        bundle (plus the ``/api/incidents`` roll-up). One slot — the
+        last registered ledger wins, same rule as the timelines."""
+        with self._lock:
+            self.change_ledger = ledger
+            kept = int(getattr(ledger.config, "incidents_kept", 0) or 0)
+            if kept > 0 and kept != self._incidents.maxlen:
+                self._incidents = collections.deque(
+                    self._incidents, maxlen=kept)
+
+    def _rank_suspects(self, reason: str, detail: dict,
+                       now: float) -> Optional[List[dict]]:
+        """Suspect ranking for one trigger, fail-soft: None when no
+        ledger is registered, it is disabled, or it holds no event
+        inside the incident window — the bundle then simply carries no
+        ``suspects.json``, never an error."""
+        ledger = self.change_ledger
+        if ledger is None or not getattr(ledger, "enabled", False):
+            return None
+        from routest_tpu.obs.ledger import rank_suspects, scope_from_detail
+
+        try:
+            suspects = rank_suspects(
+                ledger.events(), now,
+                scope=scope_from_detail({"reason": reason, **detail}),
+                window_s=float(ledger.config.window_s),
+                limit=int(ledger.config.max_suspects))
+        except Exception as e:
+            # Attribution is advisory; a broken ranking must not cost
+            # the bundle itself.
+            _log.error("suspect_ranking_failed", reason=reason,
+                       error=f"{type(e).__name__}: {e}")
+            return None
+        return suspects or None
+
+    def incidents_snapshot(self) -> List[dict]:
+        """Recent pages with their top suspects, oldest first — the
+        ``/api/incidents`` payload body."""
+        with self._lock:
+            return [dict(r) for r in self._incidents]
+
     # ── triggers + bundles ────────────────────────────────────────────
 
     def trigger(self, reason: str, detail: Optional[dict] = None,
@@ -312,6 +362,10 @@ class FlightRecorder:
             events = list(self._events)
             timelines = list(self.timelines)
         spans = get_tracer().buffer.snapshot()
+        # Suspect ranking: the change ledger's in-window events scored
+        # against this trigger's blast radius — the bundle opens with a
+        # cause hypothesis, not just rings.
+        suspects = self._rank_suspects(reason, detail, time.time())
         # Timeline slices: each registered store's recent finest-
         # resolution history — the bundle's "when did it start" axis.
         timeline_doc = None
@@ -329,6 +383,7 @@ class FlightRecorder:
             "config": _config_fingerprint(),
             "counts": {"requests": len(requests), "spans": len(spans),
                        "logs": len(logs), "events": len(events),
+                       "suspects": len(suspects or ()),
                        "timeline_frames": sum(
                            len(t["frames"])
                            for t in (timeline_doc or {}).values())},
@@ -348,10 +403,21 @@ class FlightRecorder:
             with open(os.path.join(path, name), "w") as f:
                 for row in rows:
                     f.write(json.dumps(row, default=str) + "\n")
+        if suspects:
+            with open(os.path.join(path, "suspects.json"), "w") as f:
+                json.dump({"reason": reason, "detail": detail,
+                           "window_s": float(
+                               self.change_ledger.config.window_s),
+                           "suspects": suspects}, f, indent=2,
+                          default=str)
         for name, content in (extra_files or {}).items():
             safe = os.path.basename(name)
             with open(os.path.join(path, safe), "w") as f:
                 f.write(content)
+        incident = {"ts": manifest["written_unix"], "reason": reason,
+                    "detail": detail, "bundle": os.path.basename(path),
+                    "suspects": suspects or []}
+        self._incidents.append(incident)
         return path
 
     # ── introspection ─────────────────────────────────────────────────

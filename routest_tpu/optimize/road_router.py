@@ -50,6 +50,7 @@ from routest_tpu.optimize.hierarchy import (
     tight_pred,
 )
 from routest_tpu.obs.efficiency import get_ledger
+from routest_tpu.obs.ledger import record_change
 from routest_tpu.obs.trace import trace_span
 from routest_tpu.utils.logging import get_logger
 
@@ -881,6 +882,9 @@ class RoadRouter:
                     swaps.labels(result=verdict.pop("result",
                                                     "accepted")).inc()
                     _router_metrics()["model_gen"].set(gen)
+                    record_change("model.road_swap",
+                                  detail={"generation": gen,
+                                          "path": self._gnn_path})
                     get_logger("routest.road").info(
                         "road_model_swapped", generation=gen,
                         path=self._gnn_path, **verdict)

@@ -28,6 +28,7 @@ from werkzeug.wrappers import Response
 from routest_tpu.core.config import Config, load_config, load_wire_config
 from routest_tpu.data.locations import locations_table
 from routest_tpu.obs import get_registry
+from routest_tpu.obs.ledger import record_change
 from routest_tpu.optimize.engine import (MAX_BATCH_PROBLEMS, _parse_problem,
                                          optimize_route,
                                          optimize_route_batch, travel_matrix)
@@ -121,6 +122,22 @@ def create_app(config: Optional[Config] = None,
     from routest_tpu.obs.slo import build_replica_engine
 
     recorder = get_recorder()
+
+    # Change ledger (docs/OBSERVABILITY.md "Change ledger & incident
+    # correlation"): arm this replica's blast-radius context, fan local
+    # events out on the fleet bus (and ingest the fleet's), and hand
+    # the ledger to the recorder so every bundle ranks suspects.
+    from routest_tpu.obs.ledger import (get_change_ledger,
+                                        replica_label as _replica_label)
+
+    app.change_ledger = get_change_ledger()
+    app.change_ledger.set_context(
+        replica=_replica_label(),
+        version=os.environ.get("RTPU_VERSION") or None)
+    if app.change_ledger.enabled:
+        app.change_ledger.attach_bus(state.bus)
+    recorder.register_change_ledger(app.change_ledger)
+
     app.slo = None
     if config.slo.enabled:
         app.slo = build_replica_engine(app.request_stats.registry,
@@ -467,6 +484,10 @@ def create_app(config: Optional[Config] = None,
     app.wire_handlers = (
         {"/api/predict_eta_batch": _wire_eta, "/api/matrix": _wire_matrix}
         if wire_cfg.enabled else {})
+    if wire_cfg.enabled:
+        record_change("wire.enable",
+                      detail={"paths": sorted(app.wire_handlers),
+                              "channel": wire_cfg.channel})
 
     def _wire_negotiated(request, path):
         """None when the request is not wire content-type, else the
@@ -1167,6 +1188,44 @@ def create_app(config: Optional[Config] = None,
                 wd.tick()
             out["watchdog"] = wd.snapshot()
         return out, 200
+
+    @app.route("/api/changes", methods=("GET",))
+    def changes_query(request):
+        # Change-ledger surface (docs/OBSERVABILITY.md "Change ledger
+        # & incident correlation"): newest-first state-change events
+        # with label filtering — ?kind= substring, ?replica=/?version=
+        # /?region=/?bucket= exact, ?since= unix cut, ?limit= cap.
+        def _num(name):
+            raw = request.args.get(name)
+            if not raw:
+                return None
+            try:
+                return float(raw)
+            except ValueError:
+                return None
+
+        limit = _num("limit")
+        out = app.change_ledger.query(
+            kind=request.args.get("kind") or None,
+            replica=request.args.get("replica") or None,
+            version=request.args.get("version") or None,
+            region=request.args.get("region") or None,
+            bucket=request.args.get("bucket") or None,
+            since=_num("since"),
+            limit=int(limit) if limit else None)
+        out["ledger"] = app.change_ledger.snapshot()
+        return out, 200
+
+    @app.route("/api/incidents", methods=("GET",))
+    def incidents_query(request):
+        # Incident roll-up (docs/OBSERVABILITY.md "Change ledger &
+        # incident correlation"): recent flight-recorder pages, each
+        # with the suspect changes ranked against its paging scope.
+        from routest_tpu.obs.recorder import get_recorder as _get_rec
+
+        incidents = _get_rec().incidents_snapshot()
+        return {"enabled": app.change_ledger.enabled,
+                "count": len(incidents), "incidents": incidents}, 200
 
     @app.route("/api/timeline", methods=("GET",))
     def timeline_query(request):

@@ -43,6 +43,13 @@ def main() -> None:
     plan = plan_from_env(env, replicas=max(1, fleet.replicas))
     n = len(plan.slices)
     ports = [fleet.base_port + i for i in range(n)]
+    from routest_tpu.obs.ledger import get_change_ledger, record_change
+
+    record_change("placement.apply",
+                  detail={"platform": plan.platform,
+                          "chips": plan.total_chips,
+                          "layout": plan.layout, "source": plan.source,
+                          "slices": [s.label for s in plan.slices]})
     _log.info("placement_plan", platform=plan.platform,
               chips=plan.total_chips, layout=plan.layout,
               source=plan.source,
@@ -64,6 +71,17 @@ def main() -> None:
     # Version label for the boot fleet (rollouts replace it per-replica;
     # RTPU_VERSION names what THIS deploy is serving).
     version = env.get("RTPU_VERSION") or None
+    # Arm the fleet process's change ledger: version context for the
+    # rollout/autoscale events recorded in THIS process, plus bus
+    # publication so the cross-region LedgerBridge carries gateway-tier
+    # changes alongside replica-recorded ones.
+    ledger = get_change_ledger()
+    if ledger.enabled:
+        ledger.set_context(version=version)
+        if env.get("REDIS_URL"):
+            from routest_tpu.serve.bus import make_bus
+
+            ledger.attach_bus(make_bus(env["REDIS_URL"]))
     supervisor = ReplicaSupervisor(
         ports, env=env,
         probe_interval_s=fleet.probe_interval_s,

@@ -46,6 +46,7 @@ from typing import Deque, Dict, List, Optional
 
 from routest_tpu.core.config import AutoscaleConfig, load_autoscale_config
 from routest_tpu.obs import get_registry
+from routest_tpu.obs.ledger import record_change
 from routest_tpu.utils.logging import get_logger
 
 _log = get_logger("routest_tpu.fleet.autoscaler")
@@ -230,6 +231,10 @@ class Autoscaler:
         self._last_up = time.monotonic()
         self._up_ticks = 0
         self._m_decisions.labels(direction="up").inc()
+        record_change("autoscale.grow",
+                      detail={"reasons": reasons,
+                              "spawned": len(spawned),
+                              "replicas": sig.replicas})
         detail = {"direction": "up", "reasons": reasons,
                   "spawned": spawned, "replicas": sig.replicas,
                   "pending": sig.pending + len(spawned)}
@@ -249,6 +254,8 @@ class Autoscaler:
         self._last_down = time.monotonic()
         self._down_ticks = 0
         self._m_decisions.labels(direction="down").inc()
+        record_change("autoscale.shrink",
+                      detail={"replica": rid, "replicas": sig.replicas})
         self._note({"direction": "down", "replica": rid,
                     "replicas": sig.replicas})
         # Deregister first (drain: no new picks, inflight finishes),

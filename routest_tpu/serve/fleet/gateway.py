@@ -310,6 +310,16 @@ class Gateway:
         from routest_tpu.obs.slo import build_gateway_engine
 
         self._recorder = get_recorder()
+        # Change ledger (docs/OBSERVABILITY.md "Change ledger &
+        # incident correlation"): the gateway process records its own
+        # state changes (rollout phases, autoscale actions, placement)
+        # and serves the fleet-merged /api/changes; registering it on
+        # the recorder makes every gateway page carry suspects.json.
+        from routest_tpu.obs.ledger import get_change_ledger
+
+        self.change_ledger = get_change_ledger()
+        if self.change_ledger.enabled:
+            self._recorder.register_change_ledger(self.change_ledger)
         self.slo = None
         from routest_tpu.core.config import load_slo_config
 
@@ -1143,6 +1153,10 @@ class Gateway:
                     return self._probes()
                 if bare == "/api/efficiency":
                     return self._efficiency()
+                if bare == "/api/changes":
+                    return self._changes()
+                if bare == "/api/incidents":
+                    return self._incidents()
                 if bare == "/api/autoscale":
                     return self._autoscale()
                 if bare == "/api/rollout":
@@ -1245,6 +1259,91 @@ class Gateway:
                     agg["waste_fraction"] = round(
                         1.0 - agg["rows"] / pad, 4) if pad > 0 else 0.0
                 payload = {"fleet": fleet, "replicas": replicas}
+                if gw.region:
+                    payload["region"] = gw.region
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
+
+            def _changes(self):
+                """Fleet change ledger (docs/OBSERVABILITY.md "Change
+                ledger & incident correlation"): the gateway process's
+                own events (rollout phases, autoscale actions,
+                placement) merged with every replica's ``/api/changes``
+                — deduped by event id, newest first — under the same
+                ``kind``/label/``since``/``limit`` filters as the
+                replica endpoint."""
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+
+                def one(name):
+                    return (q.get(name) or [None])[0]
+
+                since = None
+                raw = one("since")
+                if raw:
+                    try:
+                        since = float(raw)
+                    except ValueError:
+                        since = None
+                limit = None
+                raw = one("limit")
+                if raw:
+                    try:
+                        limit = max(1, int(raw))
+                    except ValueError:
+                        limit = None
+                filters = dict(kind=one("kind"), replica=one("replica"),
+                               version=one("version"),
+                               region=one("region"), bucket=one("bucket"),
+                               since=since)
+                led = gw.change_ledger
+                local = led.query(limit=None, **filters)
+                merged = {e.get("id") or id(e): e
+                          for e in local["events"]}
+                replicas = gw._fetch_replica_json("/api/changes")
+                degraded = []
+                for rid, snap in sorted(replicas.items()):
+                    if not isinstance(snap, dict) \
+                            or "events" not in snap:
+                        degraded.append(rid)
+                        continue
+                    for e in snap["events"]:
+                        if isinstance(e, dict):
+                            merged.setdefault(e.get("id") or id(e), e)
+                events = sorted(merged.values(),
+                                key=lambda e: -float(e.get("ts") or 0))
+                if limit is not None:
+                    events = events[:limit]
+                payload = {"enabled": led.enabled,
+                           "count": len(events), "events": events,
+                           "ledger": led.snapshot(),
+                           "degraded": degraded}
+                if gw.region:
+                    payload["region"] = gw.region
+                self._respond(200,
+                              [("Content-Type", "application/json")],
+                              json.dumps(payload, default=str).encode())
+
+            def _incidents(self):
+                """Recent pages with their ranked suspects: the gateway
+                recorder's incident roll-up plus each replica's
+                ``/api/incidents``, newest first."""
+                incidents = list(gw._recorder.incidents_snapshot())
+                for rid, snap in sorted(
+                        gw._fetch_replica_json(
+                            "/api/incidents").items()):
+                    if not isinstance(snap, dict):
+                        continue
+                    for inc in snap.get("incidents") or []:
+                        if isinstance(inc, dict):
+                            incidents.append(dict(inc, replica=rid))
+                incidents.sort(
+                    key=lambda i: -float(i.get("ts") or 0))
+                payload = {"enabled": gw.change_ledger.enabled,
+                           "count": len(incidents),
+                           "incidents": incidents}
                 if gw.region:
                     payload["region"] = gw.region
                 self._respond(200,

@@ -58,6 +58,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from routest_tpu.core.config import RegionConfig
+from routest_tpu.obs.ledger import record_change
 from routest_tpu.utils.logging import get_logger
 
 _log = get_logger("routest_tpu.fleet.geofront")
@@ -197,8 +198,20 @@ class GeoFront:
         self._threads: List[threading.Thread] = []
         self._httpd = None
         self.base = ""
-        self.bridges: list = []       # ProbeBridge pairs, for /api/regions
+        self.bridges: list = []       # probe/ledger bridges, /api/regions
         self.prober = None            # cross-region fan-out prober
+        # Change ledger + recorder (docs/OBSERVABILITY.md "Change
+        # ledger & incident correlation"): region lifecycle events
+        # (failover / kill / rejoin) are recorded HERE — the front is
+        # the only tier that sees them — and every front-side page
+        # (the cross-region prober's) ranks suspects against them.
+        from routest_tpu.obs.ledger import get_change_ledger
+        from routest_tpu.obs.recorder import get_recorder
+
+        self.ledger = get_change_ledger()
+        self.recorder = get_recorder()
+        if self.ledger.enabled:
+            self.recorder.register_change_ledger(self.ledger)
         m = _front_metrics()
         for n in names:
             m["up"].labels(region=n).set(1.0)
@@ -224,6 +237,7 @@ class GeoFront:
                 conn.close()
         except OSError:
             ok = False
+        came_up = went_down = False
         with self._lock:
             if ok:
                 was_down = not st.up
@@ -231,13 +245,22 @@ class GeoFront:
                 st.up = True
                 st.last_ok = time.monotonic()
                 if was_down:
+                    came_up = True
                     _log.warning("region_up", region=r.name)
             else:
                 st.fails += 1
                 if st.up and st.fails >= self.config.unhealthy_after:
                     st.up = False
+                    went_down = True
                     _log.warning("region_down", region=r.name,
                                  fails=st.fails)
+        if went_down:
+            record_change("region.failover", region=r.name,
+                          detail={"fails": st.fails,
+                                  "via": "health_poll"})
+        elif came_up:
+            record_change("region.rejoin", region=r.name,
+                          detail={"via": "health_poll"})
         m["up"].labels(region=r.name).set(1.0 if st.up else 0.0)
         if ok:
             self._poll_staleness(r, st)
@@ -361,6 +384,8 @@ class GeoFront:
         from routest_tpu.chaos import get_chaos
 
         get_chaos().record("region.kill", "kill")
+        record_change("region.kill", region=name,
+                      detail={"base": r.base})
         _log.warning("region_kill", region=name)
         if r.kill is not None:
             r.kill()
@@ -375,6 +400,8 @@ class GeoFront:
         ``rejoin`` callable); health flips up on the first successful
         poll, then the replayer drains its journal."""
         r = self.by_name[name]
+        record_change("region.rejoin", region=name,
+                      detail={"via": "admin"})
         _log.warning("region_rejoin", region=name)
         if r.rejoin is not None:
             r.rejoin()
@@ -393,7 +420,8 @@ class GeoFront:
 
         self.prober = BlackboxProber(
             prober_cfg, gateway_base=self.base or self.regions[0].base,
-            targets_fn=targets, recorder=recorder, oracle=oracle)
+            targets_fn=targets, recorder=recorder or self.recorder,
+            oracle=oracle)
         self.prober.start()
         return self.prober
 
@@ -465,9 +493,15 @@ class GeoFront:
         """Geo-scope ``/api/timeline``: ``scope=region`` merges every
         region's fleet frames into one region-labelled stream (sorted
         by time, NOT averaged — cross-region aggregation would hide
-        exactly the divergence this scope exists to show); other
-        scopes fan out and return each region's payload in place."""
-        sub_scope = "fleet" if scope == "region" else scope
+        exactly the divergence this scope exists to show);
+        ``scope=global`` is the one cross-region curve — same-slot
+        frames from every region merged under the gateway scraper's
+        discipline (counters sum, gauges sum, histogram buckets add
+        and percentiles recompute over the merged distribution), with
+        the front ledger's region lifecycle events (failover / kill /
+        rejoin) attached as annotations; other scopes fan out and
+        return each region's payload in place."""
+        sub_scope = "fleet" if scope in ("region", "global") else scope
         path = f"/api/timeline?scope={sub_scope}"
         if query:
             path += "&" + query
@@ -484,7 +518,86 @@ class GeoFront:
                     frames.append(tagged)
             frames.sort(key=lambda f: f.get("t") or 0)
             out["frames"] = frames
+        elif scope == "global":
+            from routest_tpu.obs.timeline import merge_frames
+
+            # Same-slot merge: frames align across regions because
+            # every TimelineStore cuts windows at wall-clock multiples
+            # of the step — identical t means the same instant.
+            slots: Dict[float, List[dict]] = {}
+            for payload in per.values():
+                if not isinstance(payload, dict):
+                    continue
+                for f in payload.get("frames") or []:
+                    if isinstance(f, dict) and f.get("t") is not None \
+                            and f.get("families") is not None:
+                        slots.setdefault(float(f["t"]), []).append(f)
+            frames = []
+            for t in sorted(slots):
+                merged = merge_frames(slots[t])
+                if merged is not None:
+                    merged["regions"] = len(slots[t])
+                    frames.append(merged)
+            out["frames"] = frames
+            if frames:
+                since = float(frames[0]["t"]) - 1.0
+                out["annotations"] = list(reversed(
+                    self.ledger.query(kind="region.",
+                                      since=since)["events"]))
         return out
+
+    def merged_changes(self, filters: Dict[str, Optional[str]],
+                       since: Optional[float] = None,
+                       limit: Optional[int] = None,
+                       only: Optional[str] = None) -> dict:
+        """Geo-scope ``/api/changes``: the front's own ledger (region
+        lifecycle events) merged with every region gateway's
+        fleet-merged ledger — deduped by event id, newest first."""
+        local = self.ledger.query(since=since, limit=None, **filters)
+        merged: Dict[object, dict] = {e.get("id") or id(e): e
+                                      for e in local["events"]}
+        from urllib.parse import urlencode
+
+        params = {k: v for k, v in filters.items() if v is not None}
+        if since is not None:
+            params["since"] = since
+        path = "/api/changes"
+        if params:
+            path += "?" + urlencode(params)
+        per = self.fetch_region_json(path, only=only)
+        degraded: List[str] = []
+        for name, payload in sorted(per.items()):
+            if not isinstance(payload, dict) \
+                    or "events" not in payload:
+                degraded.append(name)
+                continue
+            for e in payload["events"]:
+                if isinstance(e, dict):
+                    merged.setdefault(e.get("id") or id(e), e)
+        events = sorted(merged.values(),
+                        key=lambda e: -float(e.get("ts") or 0))
+        if limit is not None:
+            events = events[:limit]
+        return {"scope": "geo", "enabled": self.ledger.enabled,
+                "count": len(events), "events": events,
+                "ledger": self.ledger.snapshot(),
+                "degraded_regions": degraded}
+
+    def merged_incidents(self, only: Optional[str] = None) -> dict:
+        """Geo-scope ``/api/incidents``: front-side pages (the
+        cross-region prober's) plus each region's roll-up, newest
+        first, each region incident tagged with its region."""
+        incidents = list(self.recorder.incidents_snapshot())
+        per = self.fetch_region_json("/api/incidents", only=only)
+        for name, payload in sorted(per.items()):
+            if not isinstance(payload, dict):
+                continue
+            for inc in payload.get("incidents") or []:
+                if isinstance(inc, dict):
+                    incidents.append(dict(inc, region=name))
+        incidents.sort(key=lambda i: -float(i.get("ts") or 0))
+        return {"scope": "geo", "enabled": self.ledger.enabled,
+                "count": len(incidents), "incidents": incidents}
 
     def merged_slo(self, only: Optional[str] = None) -> dict:
         """Per-region SLO rollup + the worst state across regions
@@ -555,6 +668,27 @@ class GeoFront:
                 if bare == "/api/slo" and self.command == "GET":
                     return self._respond_json(
                         200, front.merged_slo(only=only))
+                if bare == "/api/changes" and self.command == "GET":
+                    since = limit = None
+                    try:
+                        if q.get("since"):
+                            since = float(q["since"])
+                    except ValueError:
+                        pass
+                    try:
+                        if q.get("limit"):
+                            limit = max(1, int(q["limit"]))
+                    except ValueError:
+                        pass
+                    filters = {k: q.get(k) for k in
+                               ("kind", "replica", "version",
+                                "region", "bucket")}
+                    return self._respond_json(
+                        200, front.merged_changes(
+                            filters, since=since, limit=limit))
+                if bare == "/api/incidents" and self.command == "GET":
+                    return self._respond_json(
+                        200, front.merged_incidents(only=only))
                 if bare == "/api/timeline" and self.command == "GET":
                     from urllib.parse import urlsplit
 
@@ -844,6 +978,18 @@ def main() -> None:
                                  channel=channel)
             bridge.start()
             front.bridges.append(bridge)
+        # The change ledger rides the same ring on its own channel:
+        # every region's fleet sees every other region's deploys,
+        # flips, and scale actions — one timeline, no extra transport.
+        from routest_tpu.obs.ledger import (
+            DEFAULT_CHANNEL as CHANGES_CHANNEL, LedgerBridge)
+
+        for i, src in enumerate(names):
+            dst = names[(i + 1) % len(names)]
+            lbridge = LedgerBridge(src, dst, buses[src], buses[dst],
+                                   channel=CHANGES_CHANNEL)
+            lbridge.start()
+            front.bridges.append(lbridge)
         _log.info("bridges_started", count=len(front.bridges),
                   channel=channel)
     front.serve(rc.front_host, rc.front_port)

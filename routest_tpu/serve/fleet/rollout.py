@@ -49,6 +49,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from routest_tpu.core.config import (RolloutConfig, SloConfig,
                                      load_rollout_config)
 from routest_tpu.obs import get_registry
+from routest_tpu.obs.ledger import record_change
 from routest_tpu.utils.logging import get_logger
 
 _log = get_logger("routest_tpu.fleet.rollout")
@@ -353,6 +354,8 @@ class RolloutController:
         with self._lock:
             previous, self._state = self._state, state
         self._m_state.set(_STATE_LEVEL[state])
+        record_change("rollout.phase", version=self._version,
+                      detail={"from": previous, "to": state})
         self._note({"event": "state", "from": previous, "to": state})
 
     def _note(self, detail: Dict) -> None:

@@ -33,6 +33,7 @@ from routest_tpu.models.eta_mlp import EtaMLP, Params
 from routest_tpu.obs import get_registry
 from routest_tpu.obs.efficiency import get_ledger
 from routest_tpu.obs.export import maybe_device_trace
+from routest_tpu.obs.ledger import record_change
 from routest_tpu.obs.trace import trace_span
 from routest_tpu.serve.deadline import DeadlineExceeded
 from routest_tpu.train.checkpoint import default_model_path, load_model
@@ -1246,6 +1247,10 @@ class EtaService:
             self.loaded_unix = fresh.loaded_unix
             _m_swaps.labels(result="accepted").inc()
             _m_generation.set(self._serving.generation)
+            record_change("model.swap",
+                          detail={"generation": self._serving.generation,
+                                  "fingerprint": self.fingerprint,
+                                  "path": self._path})
             # Cache coherency on reload: correctness already holds (the
             # new snapshot carries a new generation, so old keys can
             # never match) — this drop is memory hygiene, freeing the

@@ -96,7 +96,8 @@ def _wait_ready(lt, proc, base: str, timeout: float = 300.0) -> None:
 MODES = (
     ("off", {"RTPU_OBS_TRACE": "0", "RTPU_RECORDER": "0",
              "RTPU_SLO": "0", "RTPU_TIMELINE": "0",
-             "RTPU_TAIL_SAMPLE": "0", "RTPU_EFF": "0"}),
+             "RTPU_TAIL_SAMPLE": "0", "RTPU_EFF": "0",
+             "RTPU_LEDGER": "0"}),
     ("sampled", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "0.1",
                  "RTPU_RECORDER": "1", "RTPU_SLO": "1",
                  "RTPU_TIMELINE": "1"}),
@@ -109,6 +110,12 @@ MODES = (
     ("tail", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "1.0",
               "RTPU_RECORDER": "1", "RTPU_SLO": "1",
               "RTPU_TIMELINE": "1", "RTPU_TAIL_SAMPLE": "1"}),
+    # always_on minus the change ledger: isolates what recording
+    # state changes costs (ring append + metric touch per change —
+    # the hot request path records nothing) against the <=5% budget.
+    ("ledger_off", {"RTPU_OBS_TRACE": "1", "RTPU_OBS_SAMPLE": "1.0",
+                    "RTPU_RECORDER": "1", "RTPU_SLO": "1",
+                    "RTPU_TIMELINE": "1", "RTPU_LEDGER": "0"}),
 )
 
 
@@ -223,7 +230,7 @@ def main() -> None:
         overhead = (p95("always_on") - p95("off")) / p95("off") * 100.0
         report["p95_overhead_always_on_pct"] = round(overhead, 2)
         report["within_5pct_budget"] = bool(overhead <= 5.0)
-    for mode in ("sampled", "timeline", "tail"):
+    for mode in ("sampled", "timeline", "tail", "ledger_off"):
         if p95("off") and p95(mode):
             report[f"p95_overhead_{mode}_pct"] = round(
                 (p95(mode) - p95("off")) / p95("off") * 100.0, 2)

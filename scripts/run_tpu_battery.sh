@@ -127,6 +127,14 @@ run_step wire timeout 2400 python scripts/bench_wire.py
 # Extract + hierarchy + XLA caches persist under
 # artifacts/bench_cache/efficiency across battery rounds.
 run_step efficiency timeout 2400 python scripts/bench_efficiency.py
+# Incident correlation end to end (ISSUE 20): a bad deploy via the
+# canary state machine, a chaos-jammed customize cycle, and a
+# geo-front region.kill each page with the injected cause ranked
+# suspect #1 in the bundle's suspects.json; a clean window of ≥20
+# legitimate metric flips + ≥2 verified swaps yields zero pages and
+# zero false attributions (artifacts/incidents.json). XLA cache
+# persists under artifacts/bench_cache/incidents across rounds.
+run_step incidents timeout 900 python scripts/bench_incidents.py
 run_step load_test timeout 2400 python scripts/load_test.py --workers 1
 run_step router_scale timeout 3600 python scripts/bench_router_scale.py \
   --osm-nodes 250000 --verify --flat-compare

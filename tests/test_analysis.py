@@ -364,6 +364,81 @@ def test_chaos_point_collision_across_modules(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Change-ledger kinds ↔ LEDGER_KINDS + docs
+
+_LEDGER_STUB = """\
+    LEDGER_KINDS = {
+        "model.swap": "verified serving swap",
+        "live.flip": "live-metric epoch flip",
+    }
+
+    def record_change(kind, **kwargs):
+        pass
+"""
+
+
+def test_ledger_kind_unregistered_both_call_forms(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "obs/ledger.py": _LEDGER_STUB,
+        "serve/x.py": """\
+            from routest_tpu.obs.ledger import record_change
+
+            def f():
+                record_change("model.swap", detail={"generation": 1})
+                record_change("model.retired_kind")
+                record_change(kind="live.flip")
+        """,
+    }, docs={"OBSERVABILITY.md":
+             "`model.swap` `live.flip` `model.retired_kind`"})
+    result = run(corpus, "ledger-kind-unregistered")
+    assert keys(result) == [("routest_tpu/serve/x.py", 5)]
+
+
+def test_ledger_kind_undocumented_exact_line(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "obs/ledger.py": _LEDGER_STUB,
+        "serve/x.py": """\
+            from routest_tpu.obs.ledger import record_change
+
+            def f():
+                record_change("model.swap")
+                record_change("live.flip")
+        """,
+    }, docs={"OBSERVABILITY.md": "## Change ledger\n\n`model.swap`"})
+    result = run(corpus, "ledger-kind-undocumented")
+    assert keys(result) == [("routest_tpu/serve/x.py", 5)]
+
+
+def test_ledger_kind_stale_doc_scans_table_rows_only(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "obs/ledger.py": _LEDGER_STUB,
+        "serve/x.py": """\
+            from routest_tpu.obs.ledger import record_change
+
+            def f():
+                record_change("model.swap")
+        """,
+    }, docs={"OBSERVABILITY.md": """\
+        # Observability
+
+        ## Change ledger & incident correlation
+
+        Events cross regions on the `rtpu.changes` channel.
+
+        | kind | meaning |
+        | --- | --- |
+        | `model.swap` | verified swap |
+        | `model.retired` | gone from the code |
+
+        ## Next section
+    """})
+    result = run(corpus, "ledger-kind-stale-doc")
+    # only the table row with the unregistered kind fires; the prose
+    # mention of the bus channel does not.
+    assert keys(result) == [("docs/OBSERVABILITY.md", 10)]
+
+
+# ---------------------------------------------------------------------------
 # Suppressions & baseline semantics
 
 def test_suppression_same_line_and_line_above(tmp_path):
@@ -549,5 +624,6 @@ def test_rule_catalog_metadata():
                 "env-knob-undocumented", "metric-undocumented",
                 "metric-stale-doc", "api-route-undocumented",
                 "chaos-point-undocumented", "chaos-point-collision",
-                "bad-suppression"):
+                "ledger-kind-unregistered", "ledger-kind-undocumented",
+                "ledger-kind-stale-doc", "bad-suppression"):
         assert rid in rules, rid

@@ -21,15 +21,21 @@ import urllib.request
 import pytest
 
 _DRIVER = """
-import sys, time
+import sys, threading, time
 from routest_tpu.serve.wsgi import App, run_with_graceful_shutdown
 
 app = App()
+slow_started = threading.Event()
 
 @app.route("/slow", methods=("GET",))
 def slow(request):
+    slow_started.set()
     time.sleep(1.0)
     return {"ok": True}, 200
+
+@app.route("/inflight", methods=("GET",))
+def inflight(request):
+    return {"started": slow_started.is_set()}, 200
 
 @app.route("/ping", methods=("GET",))
 def ping(request):
@@ -77,7 +83,23 @@ def test_sigterm_finishes_inflight_then_exits_clean():
 
         t = threading.Thread(target=slow_call)
         t.start()
-        time.sleep(0.3)  # request is in flight
+        # SIGTERM must land while /slow is inside its handler. A fixed
+        # sleep races the thread's connect; poll the driver's own
+        # in-flight flag instead (the handler sets it BEFORE sleeping,
+        # so a positive answer guarantees the request was admitted).
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/inflight",
+                        timeout=1) as r:
+                    if json.loads(r.read()).get("started"):
+                        break
+            except OSError:
+                pass
+            time.sleep(0.02)
+        else:
+            pytest.fail("slow request never reached the handler")
         proc.send_signal(signal.SIGTERM)
         t.join(timeout=30)
         assert result.get("status") == 200, result

@@ -1,0 +1,268 @@
+"""Change ledger + suspect ranking (docs/OBSERVABILITY.md "Change
+ledger & incident correlation"): recording, blast-radius context,
+query filters, bus ingest dedup, the ranking oracle (hand-built
+ledgers with exact expected orders), the cross-region bridge, and the
+flight-recorder integration that ships ``suspects.json``.
+"""
+
+import json
+import os
+import time
+
+from routest_tpu.core.config import LedgerConfig, RecorderConfig
+from routest_tpu.obs.ledger import (ChangeLedger, LedgerBridge,
+                                    configure_change_ledger,
+                                    get_change_ledger, rank_suspects,
+                                    record_change, scope_from_detail)
+from routest_tpu.obs.recorder import FlightRecorder
+from routest_tpu.obs.registry import MetricsRegistry
+
+
+def _ledger(**kw):
+    defaults = dict(enabled=True, capacity=64, window_s=900.0,
+                    max_suspects=5, publish=True,
+                    channel="rtpu.changes", incidents_kept=16,
+                    region="")
+    defaults.update(kw)
+    return ChangeLedger(config=LedgerConfig(**defaults),
+                        registry=MetricsRegistry())
+
+
+# ── recording + query ────────────────────────────────────────────────
+
+def test_record_stamps_context_and_query_filters():
+    led = _ledger(region="east")
+    led.set_context(replica="h:1", version="v2")
+    led.record("model.swap", detail={"generation": 3})
+    led.record("rollout.phase", replica="h:2", version="v3")
+    led.record("live.flip")
+
+    out = led.query()
+    assert out["enabled"] and out["count"] == 3
+    assert out["events"][0]["kind"] == "live.flip"   # newest first
+    # context fills labels the call didn't name; explicit wins
+    swap = out["events"][-1]
+    assert (swap["replica"], swap["version"], swap["region"]) == \
+        ("h:1", "v2", "east")
+    phase = out["events"][1]
+    assert (phase["replica"], phase["version"]) == ("h:2", "v3")
+
+    assert led.query(kind="model")["count"] == 1
+    assert led.query(replica="h:2")["count"] == 1
+    assert led.query(version="v2")["count"] == 2
+    assert led.query(limit=1)["count"] == 1
+    newest_ts = out["events"][0]["ts"]
+    assert led.query(since=newest_ts)["count"] == 0
+
+    snap = led.snapshot()
+    assert snap["events"] == 3
+    assert snap["kinds"]["model.swap"] == 1
+    assert snap["context"]["region"] == "east"
+
+
+def test_capacity_bounds_the_ring():
+    led = _ledger(capacity=4)
+    for i in range(10):
+        led.record("live.flip", detail={"epoch": i})
+    events = led.events()
+    assert len(events) == 4
+    assert events[-1]["detail"]["epoch"] == 9
+
+
+def test_disabled_ledger_records_nothing():
+    led = _ledger(enabled=False)
+    assert led.record("model.swap") is None
+    assert led.query() == {"enabled": False, "count": 0, "events": []}
+
+
+def test_ingest_dedups_own_source_duplicates_and_malformed():
+    led = _ledger()
+    rec = led.record("model.swap")
+    # own events echo back from the bus → dropped by source id
+    assert led.ingest({"change": dict(rec)}) is False
+    foreign = {"kind": "live.flip", "ts": time.time(),
+               "id": "other-host:9/42:1"}
+    assert led.ingest({"change": foreign}) is True
+    assert led.ingest({"change": dict(foreign)}) is False  # duplicate
+    assert led.ingest({"not_a_change": 1}) is False
+    assert led.ingest({"change": {"kind": "x"}}) is False  # no ts
+    assert len(led.events()) == 2
+
+
+# ── scope extraction ─────────────────────────────────────────────────
+
+def test_scope_from_detail_aliases_and_nesting():
+    scope = scope_from_detail({
+        "slo": "availability",
+        "offender": {"rid": "r1", "offending_version": "v9"},
+        "program_bucket": 128,
+    })
+    assert scope == {"replica": "r1", "version": "v9", "bucket": "128"}
+    assert scope_from_detail({"dead_region": "east"}) == \
+        {"region": "east"}
+    assert scope_from_detail(None) == {}
+
+
+# ── ranking oracle ───────────────────────────────────────────────────
+
+def _ev(kind, age_s, now, **labels):
+    labels = {k: v for k, v in labels.items() if v is not None}
+    return {"kind": kind, "ts": now - age_s, **labels}
+
+
+def test_deploy_on_offender_beats_fleet_wide_flip():
+    now = time.time()
+    events = [
+        _ev("rollout.phase", 120.0, now, replica="r1", version="v2"),
+        _ev("live.flip", 10.0, now),   # fleet-wide, much more recent
+    ]
+    ranked = rank_suspects(events, now, scope={"replica": "r1"})
+    assert [s["event"]["kind"] for s in ranked] == \
+        ["rollout.phase", "live.flip"]
+    assert ranked[0]["matched"] == ["replica"]
+    assert ranked[0]["score"] > ranked[1]["score"]
+
+
+def test_mismatched_scope_is_heavily_penalized():
+    now = time.time()
+    events = [
+        _ev("model.swap", 30.0, now, replica="r2"),     # wrong replica
+        _ev("autoscale.grow", 300.0, now),              # unlabeled, old
+    ]
+    ranked = rank_suspects(events, now, scope={"replica": "r1"})
+    assert [s["event"]["kind"] for s in ranked] == \
+        ["autoscale.grow", "model.swap"]
+    assert ranked[1]["mismatched"] == ["replica"]
+
+
+def test_stale_and_future_events_never_rank():
+    now = time.time()
+    events = [
+        _ev("model.swap", 901.0, now),   # outside the 900s window
+        _ev("live.flip", -30.0, now),    # from the future (clock skew)
+        _ev("rollout.phase", 5.0, now),
+    ]
+    ranked = rank_suspects(events, now, scope={}, window_s=900.0)
+    assert [s["event"]["kind"] for s in ranked] == ["rollout.phase"]
+
+
+def test_just_recorded_event_ranks_despite_ts_rounding():
+    # record() rounds ts to 3 decimals, which can land microseconds
+    # AFTER a now taken in the same instant — must clamp, not drop.
+    led = _ledger()
+    led.set_context(replica="h:1")
+    led.record("rollout.phase")
+    ranked = rank_suspects(led.events(), time.time(),
+                           scope={"replica": "h:1"}, window_s=60.0)
+    assert len(ranked) == 1
+    assert ranked[0]["age_s"] >= 0.0
+
+
+def test_limit_caps_suspects_and_empty_ledger_is_empty():
+    now = time.time()
+    events = [_ev("live.flip", float(i + 1), now) for i in range(10)]
+    assert len(rank_suspects(events, now, scope={}, limit=3)) == 3
+    assert rank_suspects([], now, scope={"replica": "r1"}) == []
+
+
+# ── cross-region bridge ──────────────────────────────────────────────
+
+class _FakeBus:
+    def __init__(self):
+        self.published = []
+
+    def publish(self, channel, event):
+        self.published.append((channel, event))
+
+
+def test_bridge_tags_origin_and_suppresses_loops():
+    src, dst = _FakeBus(), _FakeBus()
+    bridge = LedgerBridge("east", "west", src, dst)
+    rec = {"kind": "model.swap", "ts": time.time(), "id": "a:1:1"}
+    assert bridge.handle({"change": rec}) is True
+    channel, out = dst.published[0]
+    assert channel == "rtpu.changes"
+    assert out["origin_region"] == "east"     # stamped on first crossing
+    # stamped with either endpoint → loop, dropped
+    assert bridge.handle({"change": rec, "origin_region": "west"}) is False
+    assert bridge.handle({"change": rec, "origin_region": "east"}) is False
+    # third-region events pass through with their stamp intact
+    assert bridge.handle({"change": rec,
+                          "origin_region": "south"}) is True
+    assert dst.published[-1][1]["origin_region"] == "south"
+    assert bridge.handle({"no_change": 1}) is False
+    assert bridge.forwarded == 2 and bridge.dropped == 3
+
+
+def test_ledger_publishes_with_origin_region():
+    led = _ledger(region="east")
+    bus = _FakeBus()
+    led.attach_bus(bus)
+    led.stop()   # tap thread not needed; publish path is synchronous
+    led.record("model.swap")
+    channel, event = bus.published[0]
+    assert channel == "rtpu.changes"
+    assert event["origin_region"] == "east"
+    assert event["change"]["kind"] == "model.swap"
+
+
+# ── recorder integration ─────────────────────────────────────────────
+
+def _recorder(tmp_path):
+    return FlightRecorder(RecorderConfig(dir=str(tmp_path / "pm"),
+                                         min_interval_s=0.0))
+
+
+def test_bundle_ships_suspects_naming_the_true_cause(tmp_path):
+    rec = _recorder(tmp_path)
+    led = _ledger()
+    rec.register_change_ledger(led)
+    led.record("rollout.phase", replica="r1", version="v2",
+               detail={"from": "canary", "to": "baking"})
+    led.record("live.flip")
+    path = rec.trigger("slo_page", {"slo": "availability",
+                                    "offender": {"rid": "r1"}},
+                       force=True)
+    suspects = json.load(open(os.path.join(path, "suspects.json")))
+    assert suspects["reason"] == "slo_page"
+    ranked = suspects["suspects"]
+    assert ranked[0]["event"]["kind"] == "rollout.phase"
+    assert ranked[0]["matched"] == ["replica"]
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["counts"]["suspects"] == len(ranked)
+    incidents = rec.incidents_snapshot()
+    assert incidents[-1]["reason"] == "slo_page"
+    assert incidents[-1]["bundle"] == os.path.basename(path)
+    assert incidents[-1]["suspects"][0]["event"]["kind"] == \
+        "rollout.phase"
+
+
+def test_empty_ledger_bundle_has_no_suspects_and_no_error(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.register_change_ledger(_ledger())
+    path = rec.trigger("slo_page", {"slo": "latency"}, force=True)
+    assert path is not None
+    assert not os.path.exists(os.path.join(path, "suspects.json"))
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["counts"]["suspects"] == 0
+    assert rec.incidents_snapshot()[-1]["suspects"] == []
+
+
+def test_no_registered_ledger_is_fine(tmp_path):
+    rec = _recorder(tmp_path)
+    path = rec.trigger("manual", force=True)
+    assert path is not None
+    assert not os.path.exists(os.path.join(path, "suspects.json"))
+
+
+# ── process-wide helper ──────────────────────────────────────────────
+
+def test_record_change_helper_uses_installed_ledger():
+    led = _ledger()
+    previous = configure_change_ledger(led)
+    try:
+        record_change("wire.enable", detail={"paths": []})
+        assert get_change_ledger() is led
+        assert led.events()[-1]["kind"] == "wire.enable"
+    finally:
+        configure_change_ledger(previous)
