@@ -102,6 +102,17 @@ _SCOPE_ALIASES = {
 }
 
 
+def event_ts(rec) -> float:
+    """Defensive sort key for merged event / incident lists: a foreign
+    tier's payload may carry a ``ts`` that is missing or non-numeric,
+    and one bad row must not 500 the whole merge — it sorts as 0.0
+    (oldest) instead."""
+    try:
+        return float(rec.get("ts") or 0.0)
+    except (AttributeError, TypeError, ValueError):
+        return 0.0
+
+
 def replica_label() -> str:
     """This process's identity on ledger events: host:port under a
     fleet supervisor (which sets ``PORT`` per replica), host:pid
@@ -200,12 +211,14 @@ class ChangeLedger:
         self._m_events.labels(kind=rec["kind"], origin="local").inc()
         self._m_last.labels(kind=rec["kind"]).set(rec["ts"])
         if bus is not None and self.config.publish:
-            event = {"change": rec}
-            origin = rec.get("region") or self._context.get("region")
-            if origin:
-                event["origin_region"] = origin
+            # No origin_region stamp here — the ProbeBridge discipline
+            # puts it on FIRST bridge crossing (LedgerBridge.handle):
+            # a region's own outbound bridge must see local originals
+            # untagged, or it drops every one of them as a "loop" and
+            # nothing ever replicates. The event's ``region`` label
+            # (blast radius) is unrelated to ring routing.
             try:
-                bus.publish(self.config.channel, event)
+                bus.publish(self.config.channel, {"change": rec})
                 self._m_published.inc()
             except Exception as e:
                 # Degraded-mode buses buffer internally; one that
@@ -223,8 +236,14 @@ class ChangeLedger:
             self._m_dropped.labels(reason="malformed").inc()
             return False
         rec = event["change"]
-        if not isinstance(rec, dict) or "kind" not in rec \
-                or "ts" not in rec:
+        # ``ts`` must be numeric BEFORE the record is admitted: the
+        # metrics below and every downstream merge sort float() it, so
+        # a string ts appended here would detonate later, far from the
+        # bad frame.
+        if not isinstance(rec, dict) \
+                or not isinstance(rec.get("kind"), str) \
+                or not isinstance(rec.get("ts"), (int, float)) \
+                or isinstance(rec.get("ts"), bool):
             self._m_dropped.labels(reason="malformed").inc()
             return False
         eid = rec.get("id")
@@ -256,16 +275,7 @@ class ChangeLedger:
         start a daemon tap ingesting foreign events from the same
         channel (loop-safe: own events drop by source id, ring
         duplicates by event id). Idempotent."""
-        with self._lock:
-            already = self._bus is bus and self._tap_stop is not None
-            self._bus = bus
-        if already or bus is None:
-            return
-        if self._tap_stop is not None:
-            self._tap_stop.set()
-        self._tap_stop = stop = threading.Event()
-
-        def run() -> None:
+        def run(stop: threading.Event) -> None:
             backoff = 0.2
             while not stop.is_set():
                 try:
@@ -282,7 +292,18 @@ class ChangeLedger:
                     while not stop.is_set():
                         data = sub.get(timeout=0.5)
                         if data is not None:
-                            self.ingest(data)
+                            # One malformed frame must not kill the
+                            # tap — ingest() rejects bad shapes, but a
+                            # frame that still raises (hostile nesting,
+                            # broken bus decode) only costs itself.
+                            try:
+                                self.ingest(data)
+                            except Exception as e:
+                                self._m_dropped.labels(
+                                    reason="malformed").inc()
+                                _log.warning(
+                                    "change_tap_ingest_failed",
+                                    error=f"{type(e).__name__}: {e}")
                         elif getattr(sub, "closed", False):
                             _log.warning("change_tap_closed")
                             break
@@ -292,13 +313,27 @@ class ChangeLedger:
                     except OSError:
                         _log.debug("change_tap_close_failed")
 
-        threading.Thread(target=run, daemon=True,
-                         name="change-ledger-tap").start()
+        # Check / stop-swap / start as ONE critical section: two
+        # concurrent attach_bus calls (or attach racing stop) must not
+        # start two taps on the same channel or orphan a stop event.
+        with self._lock:
+            if bus is None:
+                self._bus = None
+                return
+            if self._bus is bus and self._tap_stop is not None:
+                return
+            self._bus = bus
+            if self._tap_stop is not None:
+                self._tap_stop.set()
+            self._tap_stop = stop = threading.Event()
+            threading.Thread(target=run, args=(stop,), daemon=True,
+                             name="change-ledger-tap").start()
 
     def stop(self) -> None:
-        if self._tap_stop is not None:
-            self._tap_stop.set()
-            self._tap_stop = None
+        with self._lock:
+            if self._tap_stop is not None:
+                self._tap_stop.set()
+                self._tap_stop = None
 
     # ── query ─────────────────────────────────────────────────────────
 

@@ -417,7 +417,11 @@ class FlightRecorder:
         incident = {"ts": manifest["written_unix"], "reason": reason,
                     "detail": detail, "bundle": os.path.basename(path),
                     "suspects": suspects or []}
-        self._incidents.append(incident)
+        # Under the lock: register_change_ledger() may concurrently
+        # replace self._incidents with a resized deque, and an append
+        # to the discarded one would vanish from /api/incidents.
+        with self._lock:
+            self._incidents.append(incident)
         return path
 
     # ── introspection ─────────────────────────────────────────────────

@@ -1312,8 +1312,9 @@ class Gateway:
                     for e in snap["events"]:
                         if isinstance(e, dict):
                             merged.setdefault(e.get("id") or id(e), e)
+                from routest_tpu.obs.ledger import event_ts
                 events = sorted(merged.values(),
-                                key=lambda e: -float(e.get("ts") or 0))
+                                key=lambda e: -event_ts(e))
                 if limit is not None:
                     events = events[:limit]
                 payload = {"enabled": led.enabled,
@@ -1339,8 +1340,8 @@ class Gateway:
                     for inc in snap.get("incidents") or []:
                         if isinstance(inc, dict):
                             incidents.append(dict(inc, replica=rid))
-                incidents.sort(
-                    key=lambda i: -float(i.get("ts") or 0))
+                from routest_tpu.obs.ledger import event_ts
+                incidents.sort(key=lambda i: -event_ts(i))
                 payload = {"enabled": gw.change_ledger.enabled,
                            "count": len(incidents),
                            "incidents": incidents}

@@ -58,7 +58,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from routest_tpu.core.config import RegionConfig
-from routest_tpu.obs.ledger import record_change
+from routest_tpu.obs.ledger import event_ts, record_change
 from routest_tpu.utils.logging import get_logger
 
 _log = get_logger("routest_tpu.fleet.geofront")
@@ -574,8 +574,7 @@ class GeoFront:
             for e in payload["events"]:
                 if isinstance(e, dict):
                     merged.setdefault(e.get("id") or id(e), e)
-        events = sorted(merged.values(),
-                        key=lambda e: -float(e.get("ts") or 0))
+        events = sorted(merged.values(), key=lambda e: -event_ts(e))
         if limit is not None:
             events = events[:limit]
         return {"scope": "geo", "enabled": self.ledger.enabled,
@@ -595,7 +594,7 @@ class GeoFront:
             for inc in payload.get("incidents") or []:
                 if isinstance(inc, dict):
                     incidents.append(dict(inc, region=name))
-        incidents.sort(key=lambda i: -float(i.get("ts") or 0))
+        incidents.sort(key=lambda i: -event_ts(i))
         return {"scope": "geo", "enabled": self.ledger.enabled,
                 "count": len(incidents), "incidents": incidents}
 

@@ -194,7 +194,12 @@ def test_bridge_tags_origin_and_suppresses_loops():
     assert bridge.forwarded == 2 and bridge.dropped == 3
 
 
-def test_ledger_publishes_with_origin_region():
+def test_local_publish_forwards_through_own_outbound_bridge():
+    # The composed path that makes replication work at all: a region-
+    # configured ledger's published events must be UNTAGGED (origin is
+    # stamped on first bridge crossing, ProbeBridge discipline) so the
+    # region's own outbound bridge forwards them instead of dropping
+    # every local event as a "loop".
     led = _ledger(region="east")
     bus = _FakeBus()
     led.attach_bus(bus)
@@ -202,8 +207,31 @@ def test_ledger_publishes_with_origin_region():
     led.record("model.swap")
     channel, event = bus.published[0]
     assert channel == "rtpu.changes"
-    assert event["origin_region"] == "east"
+    assert "origin_region" not in event
     assert event["change"]["kind"] == "model.swap"
+    assert event["change"]["region"] == "east"   # blast-radius label stays
+
+    remote = _FakeBus()
+    bridge = LedgerBridge("east", "west", bus, remote)
+    assert bridge.handle(event) is True
+    assert remote.published[0][1]["origin_region"] == "east"
+    # ...and once it comes back around the ring, the stamp kills it
+    assert bridge.handle(remote.published[0][1]) is False
+
+
+def test_ingest_rejects_non_numeric_ts_and_tap_survives():
+    led = _ledger()
+    # a string ts would detonate in float() at metric/merge time —
+    # malformed, never admitted to the ring
+    assert led.ingest({"change": {"kind": "live.flip",
+                                  "ts": "yesterday",
+                                  "id": "h:1/9:1"}}) is False
+    assert led.ingest({"change": {"kind": 7, "ts": time.time(),
+                                  "id": "h:1/9:2"}}) is False
+    assert led.events() == []
+    assert led.ingest({"change": {"kind": "live.flip",
+                                  "ts": time.time(),
+                                  "id": "h:1/9:3"}}) is True
 
 
 # ── recorder integration ─────────────────────────────────────────────
